@@ -1,0 +1,49 @@
+"""tensorflowonspark_tpu: a TPU-native distributed ML orchestration framework.
+
+A brand-new, TPU-first framework with the capabilities of yahoo/TensorFlowOnSpark:
+it lets a data-engine driver (Spark, or the built-in multi-process LocalEngine)
+orchestrate distributed JAX/XLA training and inference on TPU pod slices.
+
+Layer map (mirrors the capability surface of the reference, re-designed for TPU;
+see SURVEY.md for the reference analysis):
+
+- ``utils/``    L0': host/TPU platform utilities (replaces gpu_info.py/util.py/compat.py)
+- ``control/``  L1': rendezvous control plane + per-host feed hub
+                (replaces reservation.py/TFManager.py/marker.py; msgpack-over-TCP,
+                not pickle)
+- ``node.py``   L2': per-executor node runtime (replaces TFSparkNode.py)
+- ``cluster.py``L3': cluster lifecycle API (replaces TFCluster.py)
+- ``datafeed.py`` L4': in-main-fn user API (replaces TFNode.py DataFeed)
+- ``pipeline.py`` L5': Estimator/Model ML pipeline (replaces pipeline.py)
+- ``data/``     TFRecord codec + DataFrame interop (replaces dfutil.py + the
+                tensorflow-hadoop jar + the Scala DFUtil layer)
+- ``engine/``   executor-engine abstraction: Spark adapter + built-in LocalEngine
+- ``parallel/`` TPU-native SPMD: meshes, shardings (dp/tp/pp/sp), collectives,
+                ring attention — capabilities the reference delegated to
+                tf.distribute, rebuilt on jax.sharding/pjit/shard_map
+- ``models/``   flagship model families (MNIST, ResNet, U-Net, Transformer)
+- ``ops/``      Pallas TPU kernels for hot ops
+"""
+
+import logging
+
+# Library convention: never configure the root logger at import time (the
+# reference called logging.basicConfig in its __init__ — deliberate there, but
+# it hijacks the embedding application's logging). Driver entry points call
+# setup_logging() to get the reference's thread/process-annotated format.
+logging.getLogger(__name__).addHandler(logging.NullHandler())
+
+
+def setup_logging(level=logging.INFO):
+  """Opt-in logging setup with per-thread/process annotations.
+
+  Format parity with the reference package init
+  (/root/reference/tensorflowonspark/__init__.py:3).
+  """
+  logging.basicConfig(
+      level=level,
+      format="%(asctime)s %(levelname)s (%(threadName)s-%(process)d) "
+             "%(message)s")
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""L1' control plane: cluster rendezvous + per-host feed hub.
+
+Replaces the reference's ``reservation.py`` (pickle-over-TCP discovery),
+``TFManager.py`` (multiprocessing.BaseManager IPC hub) and ``marker.py``
+(/root/reference/tensorflowonspark/). The wire format here is length-prefixed
+msgpack — structurally identical framing, but without pickle's arbitrary code
+execution on receive.
+"""
+
+from tensorflowonspark_tpu.control.marker import Marker, EndPartition  # noqa: F401
+from tensorflowonspark_tpu.control.rendezvous import (  # noqa: F401
+    Server, Client, Reservations,
+)
+from tensorflowonspark_tpu.control import feedhub  # noqa: F401

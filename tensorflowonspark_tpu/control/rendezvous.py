@@ -1,0 +1,315 @@
+"""Cluster rendezvous: the discovery/control plane.
+
+Capability parity with the reference's ``reservation.py``
+(/root/reference/tensorflowonspark/reservation.py): a driver-side ``Server``
+collects one registration per executor, executors ``register`` and then
+``await_reservations`` until the whole cluster is present, and a ``STOP`` verb
+doubles as the graceful-stop signal for streaming jobs. Differences, by design:
+
+- Wire format is length-prefixed **msgpack**, not pickle (framing parity with
+  reservation.py:68-97, minus arbitrary-code-execution on receive).
+- Registration is **idempotent by executor_id**: a retried task re-registers
+  and replaces its previous entry (reference behavior at TFSparkNode.py:331-340),
+  while true duplicates (two different addresses claiming one executor_id) are
+  surfaced for the cluster layer's duplicate check (TFCluster.py:357-372).
+- The server is also the process rendezvous used to synthesize
+  ``jax.distributed.initialize(coordinator_address, num_processes, process_id)``
+  — the TPU-native analog of synthesizing ``TF_CONFIG``.
+
+Message verbs (parity with reservation.py:130-146): ``REG``, ``QINFO`` (count
+registered), ``QUERY`` (done?), ``LIST`` (full reservation list), ``STOP``.
+
+Env overrides (parity with reservation.py:25-26,190-206):
+``TOS_TPU_SERVER_HOST`` pins the server bind/advertise host;
+``TOS_TPU_SERVER_PORT`` pins the port, accepting either ``"9000"`` or a range
+``"9000-9100"`` from which the first bindable port is taken.
+"""
+
+import logging
+import os
+import select
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+ENV_SERVER_HOST = "TOS_TPU_SERVER_HOST"
+ENV_SERVER_PORT = "TOS_TPU_SERVER_PORT"
+
+_HEADER = struct.Struct(">I")
+
+# rendezvous messages are small metadata dicts; anything larger is a protocol
+# violation (or garbage bytes hitting the port) — refuse before buffering it
+MAX_MESSAGE_BYTES = 4 * 1024 * 1024
+
+
+class MessageSocket(object):
+  """Length-prefixed msgpack messages over a TCP socket.
+
+  Framing parity with the reference's MessageSocket (reservation.py:68-97):
+  4-byte big-endian length + payload.
+  """
+
+  def receive(self, sock: socket.socket) -> dict:
+    header = self._recv_exact(sock, _HEADER.size)
+    (length,) = _HEADER.unpack(header)
+    if length > MAX_MESSAGE_BYTES:
+      raise ConnectionError(
+          "oversized rendezvous message (%d bytes); dropping connection" % length)
+    payload = self._recv_exact(sock, length)
+    return msgpack.unpackb(payload, raw=False)
+
+  def send(self, sock: socket.socket, msg: dict) -> None:
+    payload = msgpack.packb(msg, use_bin_type=True)
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+  @staticmethod
+  def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+      chunk = sock.recv(n - len(buf))
+      if not chunk:
+        raise ConnectionError("socket closed while reading message")
+      buf += chunk
+    return buf
+
+
+class Reservations(object):
+  """Thread-safe store of node reservations, keyed by executor_id.
+
+  Parity: reservation.py:31-65, plus idempotent-replace semantics and
+  duplicate tracking for the driver-side sanity check.
+  """
+
+  def __init__(self, required: int):
+    self.required = required
+    self._lock = threading.RLock()
+    self._table: Dict[int, dict] = {}
+    self.duplicates: List[dict] = []
+
+  def add(self, meta: dict) -> None:
+    executor_id = meta["executor_id"]
+    with self._lock:
+      prev = self._table.get(executor_id)
+      if prev is not None and prev.get("host") != meta.get("host"):
+        # two different hosts claiming one slot: record for the sanity check
+        self.duplicates.append(meta)
+        logger.warning("duplicate reservation for executor %d: %s vs %s",
+                       executor_id, prev.get("host"), meta.get("host"))
+      self._table[executor_id] = meta
+
+  def done(self) -> bool:
+    with self._lock:
+      return len(self._table) >= self.required
+
+  def get(self) -> List[dict]:
+    with self._lock:
+      return [self._table[k] for k in sorted(self._table)]
+
+  def remaining(self) -> int:
+    with self._lock:
+      return max(0, self.required - len(self._table))
+
+
+def _parse_port_spec(spec: str) -> List[int]:
+  """``"9000"`` → [9000]; ``"9000-9003"`` → [9000..9003]."""
+  if "-" in spec:
+    lo, hi = spec.split("-", 1)
+    return list(range(int(lo), int(hi) + 1))
+  return [int(spec)]
+
+
+class Server(MessageSocket):
+  """Driver-side rendezvous server (parity: reservation.py:100-231)."""
+
+  def __init__(self, count: int):
+    assert count > 0
+    self.reservations = Reservations(count)
+    self.done = threading.Event()
+    self._listener: Optional[socket.socket] = None
+    self.addr: Optional[Tuple[str, int]] = None
+
+  def start(self) -> Tuple[str, int]:
+    """Bind (honoring env pinning) and serve on a background thread."""
+    host_env = os.environ.get(ENV_SERVER_HOST)
+    port_env = os.environ.get(ENV_SERVER_PORT)
+    bind_host = host_env if host_env else ""
+    ports = _parse_port_spec(port_env) if port_env else [0]
+
+    sock = None
+    last_err = None
+    for port in ports:
+      try:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((bind_host, port))
+        break
+      except OSError as e:
+        sock.close()
+        sock = None
+        last_err = e
+    if sock is None:
+      raise OSError("unable to bind rendezvous server on ports {}: {}".format(
+          ports, last_err))
+    sock.listen(64)
+
+    from tensorflowonspark_tpu.utils.hostinfo import get_ip_address
+    advertise_host = host_env if host_env else get_ip_address()
+    self.addr = (advertise_host, sock.getsockname()[1])
+    self._listener = sock
+
+    t = threading.Thread(target=self._serve, name="rendezvous-server",
+                         daemon=True)
+    t.start()
+    logger.info("rendezvous server listening at %s", self.addr)
+    return self.addr
+
+  def _serve(self) -> None:
+    conns = [self._listener]
+    while not self.done.is_set():
+      try:
+        readable, _, _ = select.select(conns, [], [], 0.25)
+      except OSError:
+        break
+      for s in readable:
+        if s is self._listener:
+          try:
+            client, _ = self._listener.accept()
+            conns.append(client)
+          except OSError:
+            pass
+        else:
+          try:
+            msg = self.receive(s)
+            self._handle(s, msg)
+          except Exception as e:  # noqa: BLE001 - a bad client (garbage
+            # bytes, truncated msgpack, malformed REG) must never kill the
+            # serve loop; drop only that connection
+            if not isinstance(e, (ConnectionError, OSError)):
+              logger.warning("dropping rendezvous connection after bad "
+                             "message: %s", e)
+            conns.remove(s)
+            s.close()
+    for s in conns:
+      try:
+        s.close()
+      except OSError:
+        pass
+
+  def _handle(self, sock: socket.socket, msg: dict) -> None:
+    mtype = msg.get("type")
+    if mtype == "REG":
+      self.reservations.add(msg["data"])
+      self.send(sock, {"type": "OK"})
+    elif mtype == "QINFO":
+      self.send(sock, {"type": "COUNT",
+                       "registered": self.reservations.required -
+                       self.reservations.remaining(),
+                       "required": self.reservations.required})
+    elif mtype == "QUERY":
+      self.send(sock, {"type": "DONE", "done": self.reservations.done()})
+    elif mtype == "LIST":
+      self.send(sock, {"type": "RESERVATIONS",
+                       "data": self.reservations.get()})
+    elif mtype == "STOP":
+      logger.info("rendezvous server received STOP")
+      self.done.set()
+      self.send(sock, {"type": "OK"})
+    else:
+      self.send(sock, {"type": "ERROR", "error": "unknown verb: %r" % mtype})
+
+  def await_reservations(self, timeout: int = 600, status: Optional[dict] = None):
+    """Block until all nodes registered; raise on timeout or reported error.
+
+    ``status`` is the shared dict the launcher thread writes errors into
+    (parity: tf_status error-abort, reservation.py:113-128 +
+    TFCluster.py:328-330).
+    """
+    deadline = time.time() + timeout
+    while not self.reservations.done():
+      if status and status.get("error"):
+        raise RuntimeError("cluster startup aborted: {}".format(status["error"]))
+      if time.time() > deadline:
+        raise TimeoutError(
+            "timed out waiting for {} node(s) to register after {}s".format(
+                self.reservations.remaining(), timeout))
+      time.sleep(0.1)
+    return self.reservations.get()
+
+  def stop(self) -> None:
+    self.done.set()
+    if self._listener is not None:
+      try:
+        self._listener.close()
+      except OSError:
+        pass
+
+
+class Client(MessageSocket):
+  """Executor-side rendezvous client (parity: reservation.py:234-301)."""
+
+  RETRIES = 3
+
+  def __init__(self, server_addr: Tuple[str, int]):
+    self.server_addr = (server_addr[0], int(server_addr[1]))
+    self._sock = self._connect()
+
+  def _connect(self) -> socket.socket:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.connect(self.server_addr)
+    return s
+
+  def _request(self, msg: dict) -> dict:
+    last = None
+    for attempt in range(self.RETRIES):
+      try:
+        self.send(self._sock, msg)
+        return self.receive(self._sock)
+      except (ConnectionError, OSError) as e:
+        last = e
+        logger.warning("rendezvous send failed (attempt %d): %s", attempt + 1, e)
+        try:
+          self._sock.close()
+        except OSError:
+          pass
+        time.sleep(0.5 * (attempt + 1))
+        try:
+          self._sock = self._connect()
+        except OSError as e2:
+          last = e2
+    raise ConnectionError("unable to reach rendezvous server at {}: {}".format(
+        self.server_addr, last))
+
+  def register(self, reservation: dict) -> None:
+    self._request({"type": "REG", "data": reservation})
+
+  def get_reservations(self) -> List[dict]:
+    return self._request({"type": "LIST"})["data"]
+
+  def await_reservations(self, timeout: int = 600) -> List[dict]:
+    """Poll until the cluster is fully registered (1s poll cadence,
+    parity: reservation.py:290-296)."""
+    deadline = time.time() + timeout
+    while True:
+      if self._request({"type": "QUERY"})["done"]:
+        return self.get_reservations()
+      if time.time() > deadline:
+        raise TimeoutError("timed out awaiting full cluster registration")
+      time.sleep(1)
+
+  def request_stop(self) -> None:
+    try:
+      self._request({"type": "STOP"})
+    except ConnectionError:
+      logger.warning("rendezvous server already gone on STOP")
+
+  def close(self) -> None:
+    try:
+      self._sock.close()
+    except OSError:
+      pass

@@ -1,0 +1,16 @@
+"""L0' host/platform utilities.
+
+Replaces the reference's ``util.py`` (/root/reference/tensorflowonspark/util.py),
+``gpu_info.py`` (GPU discovery via nvidia-smi → here TPU chip/host discovery via
+JAX/libtpu env) and ``compat.py``.
+"""
+
+from tensorflowonspark_tpu.utils.hostinfo import (  # noqa: F401
+    get_ip_address,
+    get_free_port,
+    find_in_path,
+    read_executor_id,
+    write_executor_id,
+)
+from tensorflowonspark_tpu.utils import tpu_info  # noqa: F401
+from tensorflowonspark_tpu.utils.paths import absolute_path  # noqa: F401

@@ -1,0 +1,179 @@
+"""TPU chip/host discovery and per-worker chip allocation.
+
+This is the TPU-native replacement for the reference's ``gpu_info.py``
+(/root/reference/tensorflowonspark/gpu_info.py), which discovered and allocated
+GPUs by parsing ``nvidia-smi`` and exporting ``CUDA_VISIBLE_DEVICES``. On TPU
+there is no ``nvidia-smi``; discovery comes from (in priority order):
+
+1. libtpu/Cloud-TPU environment variables (``TPU_ACCELERATOR_TYPE``,
+   ``TPU_WORKER_HOSTNAMES``, ``TPU_PROCESS_BOUNDS``, ...), which exist on TPU
+   VMs *before* any runtime is initialized, and
+2. ``jax.devices()``, when JAX is importable and initializing it is acceptable
+   (initializing grabs the TPU — so the orchestration layer prefers (1)).
+
+Allocation: where the reference exported ``CUDA_VISIBLE_DEVICES`` for a
+worker's GPU share (gpu_info.py:80-91), we export ``TPU_VISIBLE_CHIPS`` plus
+the ``TPU_PROCESS_*`` multi-process coordinates so several workers can share
+one TPU host, each owning a disjoint set of chips.
+
+All discovery functions are pure / env-driven so they can be unit-tested with
+``unittest.mock`` exactly like the reference's GPU-policy matrix
+(reference tests/test_TFSparkNode.py:49-190).
+"""
+
+import logging
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# Accelerator type → (chips/host, name_cores/chip, jax_devices/chip).
+# The accelerator-type suffix counts TensorCores on v2/v3/v4/v5p (2 cores per
+# chip) and chips on v5e/v6e (1 core per chip). v4+ chips are megacore: JAX
+# exposes 1 device per chip even where the *name* counts 2 cores.
+_ACCEL_INFO = {
+    "v2": (4, 2, 2),
+    "v3": (4, 2, 2),
+    "v4": (4, 2, 1),
+    "v5litepod": (8, 1, 1),
+    "v5e": (8, 1, 1),
+    "v5p": (4, 2, 1),
+    "v6e": (8, 1, 1),
+}
+
+MAX_CHIPS_PER_HOST = 8
+
+
+@dataclass
+class TPUTopology:
+  """Static description of the TPU slice this job runs on."""
+  accelerator_type: str = "unknown"   # e.g. "v5litepod-16"
+  generation: str = "unknown"         # e.g. "v5litepod"
+  num_chips: int = 0                  # total chips in the slice
+  chips_per_host: int = 0
+  cores_per_chip: int = 1             # TensorCores per chip (naming units)
+  devices_per_chip: int = 1           # JAX devices per chip (1 on megacore v4+)
+  num_hosts: int = 0
+  hostnames: List[str] = field(default_factory=list)
+
+  @property
+  def num_devices(self) -> int:
+    """Number of JAX devices the slice exposes."""
+    return self.num_chips * self.devices_per_chip
+
+
+def parse_accelerator_type(accel: str) -> TPUTopology:
+  """Parse a Cloud-TPU accelerator type string like ``v5litepod-16``."""
+  m = re.match(r"(v\d+[a-z]*)-(\d+)", accel)
+  if not m:
+    raise ValueError("unrecognized TPU accelerator type: {!r}".format(accel))
+  gen, size = m.group(1), int(m.group(2))
+  chips_per_host, cores_per_chip, devices_per_chip = _ACCEL_INFO.get(
+      gen, (4, 1, 1))
+  num_chips = max(1, size // cores_per_chip)
+  num_hosts = max(1, num_chips // chips_per_host)
+  if num_chips < chips_per_host:
+    chips_per_host = num_chips
+  return TPUTopology(
+      accelerator_type=accel, generation=gen, num_chips=num_chips,
+      chips_per_host=chips_per_host, cores_per_chip=cores_per_chip,
+      devices_per_chip=devices_per_chip, num_hosts=num_hosts,
+      hostnames=[])
+
+
+def from_env(environ: Optional[Dict[str, str]] = None) -> Optional[TPUTopology]:
+  """Discover topology from Cloud-TPU VM env vars without touching the device.
+
+  Returns None when the env carries no TPU markers (e.g. CPU CI hosts).
+  """
+  env = os.environ if environ is None else environ
+  accel = env.get("TPU_ACCELERATOR_TYPE")
+  if not accel:
+    return None
+  try:
+    topo = parse_accelerator_type(accel)
+  except ValueError:
+    logger.warning("unparseable TPU_ACCELERATOR_TYPE=%r", accel)
+    return None
+  hosts = env.get("TPU_WORKER_HOSTNAMES", "")
+  if hosts:
+    topo.hostnames = [h.strip() for h in hosts.split(",") if h.strip()]
+    topo.num_hosts = len(topo.hostnames)
+  return topo
+
+
+def from_jax() -> Optional[TPUTopology]:
+  """Discover topology by initializing JAX (grabs the TPU — use sparingly)."""
+  try:
+    import jax
+    devices = jax.devices()
+  except Exception as e:  # noqa: BLE001 - any backend failure means "no TPU"
+    logger.debug("jax device discovery failed: %s", e)
+    return None
+  tpus = [d for d in devices if d.platform == "tpu" or "TPU" in str(d.device_kind)]
+  if not tpus:
+    return None
+  kind = str(tpus[0].device_kind)
+  hosts = len({d.process_index for d in tpus})
+  return TPUTopology(
+      accelerator_type=kind, generation=kind, num_chips=len(tpus),
+      chips_per_host=max(1, len(tpus) // hosts), cores_per_chip=1,
+      num_hosts=hosts)
+
+
+def get_topology(environ: Optional[Dict[str, str]] = None,
+                 allow_jax_init: bool = False) -> Optional[TPUTopology]:
+  """Best available topology: env first, optionally JAX as fallback."""
+  topo = from_env(environ)
+  if topo is None and allow_jax_init:
+    topo = from_jax()
+  return topo
+
+
+def is_tpu_available(environ: Optional[Dict[str, str]] = None) -> bool:
+  """True when this host can see TPU hardware (parity: gpu_info.is_gpu_available)."""
+  return get_topology(environ) is not None or os.path.exists("/dev/accel0") \
+      or os.path.exists("/dev/vfio/0")
+
+
+def chip_env_for_worker(num_chips: int, worker_index: int,
+                        workers_per_host: int,
+                        base_port: int = 8476,
+                        host: str = "localhost") -> Dict[str, str]:
+  """Env vars granting ``worker_index`` a disjoint set of chips on this host.
+
+  TPU analog of the reference's deterministic by-worker-index GPU placement
+  (gpu_info.py:80-91): worker *i* of *n* on a host with ``n*num_chips`` chips
+  gets chips ``[i*num_chips, (i+1)*num_chips)``. Exports the libtpu
+  multi-process coordination variables so each worker process initializes only
+  its share.
+  """
+  if num_chips < 1 or worker_index < 0 or workers_per_host < 1:
+    raise ValueError("invalid chip allocation request: num_chips={} "
+                     "worker_index={} workers_per_host={}".format(
+                         num_chips, worker_index, workers_per_host))
+  lo = (worker_index % workers_per_host) * num_chips
+  chips = list(range(lo, lo + num_chips))
+  if chips[-1] >= MAX_CHIPS_PER_HOST:
+    raise ValueError(
+        "worker {} requests chips {} but hosts have at most {} chips".format(
+            worker_index, chips, MAX_CHIPS_PER_HOST))
+  addresses = ",".join(
+      "{}:{}".format(host, base_port + i) for i in range(workers_per_host))
+  local = worker_index % workers_per_host
+  return {
+      "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips),
+      "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,{},1".format(num_chips),
+      "TPU_PROCESS_BOUNDS": "1,{},1".format(workers_per_host),
+      "TPU_PROCESS_ADDRESSES": addresses,
+      "TPU_PROCESS_PORT": str(base_port + local),
+      "CLOUD_TPU_TASK_ID": str(local),
+  }
+
+
+def apply_chip_env(env_updates: Dict[str, str]) -> None:
+  """Apply allocation env (must run before JAX/libtpu initialization)."""
+  os.environ.update(env_updates)
+  logger.info("TPU chip allocation: %s", env_updates)

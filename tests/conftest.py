@@ -1,0 +1,16 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+Mirrors the reference's test strategy (reference tox.ini: a 2-worker Spark
+standalone cluster on one host): multi-device behavior is tested on one host
+by splitting the CPU into 8 virtual XLA devices. Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+# keep subprocesses (LocalEngine executors) on CPU too
+os.environ.setdefault("TOS_TPU_TEST_MODE", "1")

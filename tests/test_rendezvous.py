@@ -1,0 +1,215 @@
+"""L1' rendezvous tests — port of reference tests/test_reservation.py:
+
+reservation counting (:12-29), server/client register+await (:31-52), env
+host/port/port-range overrides (:54-93), concurrent clients (:95-128); plus
+idempotent re-registration and error-abort, which the reference exercised via
+TFCluster integration tests.
+"""
+
+import threading
+import time
+from unittest import mock
+
+import pytest
+
+from tensorflowonspark_tpu.control import rendezvous
+from tensorflowonspark_tpu.control.rendezvous import Client, Reservations, Server
+
+
+def _meta(i, host="h0", **kw):
+  d = {"executor_id": i, "host": host, "port": 4000 + i}
+  d.update(kw)
+  return d
+
+
+class TestReservations:
+  def test_counting(self):
+    r = Reservations(3)
+    assert r.remaining() == 3 and not r.done()
+    r.add(_meta(0))
+    r.add(_meta(1))
+    assert r.remaining() == 1 and not r.done()
+    r.add(_meta(2))
+    assert r.done()
+    assert [m["executor_id"] for m in r.get()] == [0, 1, 2]
+
+  def test_idempotent_reregistration(self):
+    r = Reservations(2)
+    r.add(_meta(0))
+    r.add(_meta(0, port=9999))  # retried task re-registers
+    assert r.remaining() == 1
+    assert r.get()[0]["port"] == 9999
+    assert not r.duplicates
+
+  def test_duplicate_conflict_recorded(self):
+    r = Reservations(2)
+    r.add(_meta(0, host="h0"))
+    r.add(_meta(0, host="h1"))  # different host claims same slot
+    assert len(r.duplicates) == 1
+
+
+class TestServerClient:
+  def test_register_and_await(self):
+    s = Server(2)
+    addr = s.start()
+    try:
+      c0 = Client(addr)
+      c1 = Client(addr)
+      c0.register(_meta(0))
+      assert not s.reservations.done()
+      c1.register(_meta(1))
+      got = s.await_reservations(timeout=5)
+      assert len(got) == 2
+      # client-side await also completes
+      assert len(c0.await_reservations(timeout=5)) == 2
+      c0.close()
+      c1.close()
+    finally:
+      s.stop()
+
+  def test_await_timeout(self):
+    s = Server(2)
+    s.start()
+    try:
+      with pytest.raises(TimeoutError):
+        s.await_reservations(timeout=1)
+    finally:
+      s.stop()
+
+  def test_error_abort(self):
+    s = Server(2)
+    s.start()
+    try:
+      status = {"error": None}
+
+      def fail_later():
+        time.sleep(0.3)
+        status["error"] = "boom on executor 1"
+
+      threading.Thread(target=fail_later, daemon=True).start()
+      with pytest.raises(RuntimeError, match="boom"):
+        s.await_reservations(timeout=30, status=status)
+    finally:
+      s.stop()
+
+  def test_request_stop(self):
+    s = Server(1)
+    addr = s.start()
+    c = Client(addr)
+    c.register(_meta(0))
+    assert not s.done.is_set()
+    c.request_stop()
+    time.sleep(0.5)
+    assert s.done.is_set()
+    c.close()
+
+  def test_concurrent_clients(self):
+    n = 8
+    s = Server(n)
+    addr = s.start()
+    try:
+      def reg(i):
+        c = Client(addr)
+        c.register(_meta(i, host="h%d" % i))
+        c.await_reservations(timeout=10)
+        c.close()
+
+      threads = [threading.Thread(target=reg, args=(i,)) for i in range(n)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join(timeout=15)
+      assert s.reservations.done()
+      assert len(s.reservations.get()) == n
+    finally:
+      s.stop()
+
+
+class TestServerRobustness:
+  def test_malformed_payload_does_not_kill_server(self):
+    import socket
+    import struct
+    s = Server(1)
+    addr = s.start()
+    try:
+      # valid length header, invalid msgpack body (0xc1 is never valid)
+      g = socket.create_connection(("127.0.0.1", addr[1]))
+      g.sendall(struct.pack(">I", 4) + b"\xc1\xc1\xc1\xc1")
+      g.close()
+      time.sleep(0.3)
+      c = Client(("127.0.0.1", addr[1]))
+      c.register(_meta(0))
+      assert s.await_reservations(timeout=5)
+      c.close()
+    finally:
+      s.stop()
+
+  def test_oversized_header_dropped(self):
+    import socket
+    s = Server(1)
+    addr = s.start()
+    try:
+      g = socket.create_connection(("127.0.0.1", addr[1]))
+      g.sendall(b"\xff\xff\xff\xffjunk")
+      g.close()
+      c = Client(("127.0.0.1", addr[1]))
+      c.register(_meta(0))
+      assert s.await_reservations(timeout=5)
+      c.close()
+    finally:
+      s.stop()
+
+
+class TestEnvOverrides:
+  """Parity: reference test_reservation.py:54-93."""
+
+  def test_port_pin(self):
+    from tensorflowonspark_tpu.utils.hostinfo import get_free_port
+    port = get_free_port()
+    with mock.patch.dict("os.environ",
+                         {rendezvous.ENV_SERVER_PORT: str(port)}):
+      s = Server(1)
+      addr = s.start()
+      assert addr[1] == port
+      s.stop()
+
+  def test_port_range(self):
+    from tensorflowonspark_tpu.utils.hostinfo import get_free_port
+    lo = get_free_port()
+    with mock.patch.dict(
+        "os.environ", {rendezvous.ENV_SERVER_PORT: "%d-%d" % (lo, lo + 20)}):
+      s = Server(1)
+      addr = s.start()
+      assert lo <= addr[1] <= lo + 20
+      # a second server must pick a different port in the range
+      s2 = Server(1)
+      addr2 = s2.start()
+      assert addr2[1] != addr[1] and lo <= addr2[1] <= lo + 20
+      s.stop()
+      s2.stop()
+
+  def test_host_pin(self):
+    with mock.patch.dict("os.environ",
+                         {rendezvous.ENV_SERVER_HOST: "127.0.0.1"}):
+      s = Server(1)
+      addr = s.start()
+      assert addr[0] == "127.0.0.1"
+      c = Client(addr)
+      c.register(_meta(0))
+      assert s.await_reservations(timeout=5)
+      c.close()
+      s.stop()
+
+  def test_unbindable_pin_raises(self):
+    import socket
+    blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blocker.bind(("", 0))
+    blocker.listen(1)
+    taken = blocker.getsockname()[1]
+    try:
+      with mock.patch.dict("os.environ",
+                           {rendezvous.ENV_SERVER_PORT: str(taken)}):
+        with pytest.raises(OSError):
+          Server(1).start()
+    finally:
+      blocker.close()

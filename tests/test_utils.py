@@ -1,0 +1,137 @@
+"""L0' unit tests: hostinfo, tpu_info discovery/allocation matrix, paths.
+
+Port of the reference's policy-matrix style (reference
+tests/test_TFSparkNode.py:49-190 for GPU allocation, tests/test_TFNode.py:7-25
+for hdfs_path) onto the TPU modules.
+"""
+
+import os
+import tempfile
+
+import pytest
+
+from tensorflowonspark_tpu.utils import hostinfo, paths, tpu_info
+
+
+class TestHostinfo:
+  def test_get_ip_address(self):
+    ip = hostinfo.get_ip_address()
+    assert isinstance(ip, str) and ip.count(".") == 3
+
+  def test_get_free_port(self):
+    p = hostinfo.get_free_port()
+    assert 0 < p < 65536
+
+  def test_find_in_path(self, tmp_path):
+    f = tmp_path / "present.txt"
+    f.write_text("x")
+    path = os.pathsep.join(["/nonexistent", str(tmp_path)])
+    assert hostinfo.find_in_path(path, "present.txt") == str(f)
+    assert hostinfo.find_in_path(path, "absent.txt") is False
+
+  def test_executor_id_roundtrip(self, tmp_path):
+    hostinfo.write_executor_id(7, str(tmp_path))
+    assert hostinfo.read_executor_id(str(tmp_path)) == 7
+
+  def test_executor_id_missing(self, tmp_path):
+    with pytest.raises(RuntimeError, match="No executor_id"):
+      hostinfo.read_executor_id(str(tmp_path))
+
+
+class TestPaths:
+  """Parity matrix: reference tests/test_TFNode.py hdfs_path tests."""
+
+  def test_absolute_schemes_passthrough(self):
+    for p in ["gs://bucket/x", "hdfs://nn:8020/x", "file:///tmp/x",
+              "viewfs://ns/x", "s3a://b/x"]:
+      assert paths.absolute_path(p, "hdfs://nn:8020") == p
+
+  def test_absolute_local(self):
+    assert paths.absolute_path("/tmp/x", "file://") == "file:///tmp/x"
+
+  def test_absolute_on_default_fs(self):
+    assert paths.absolute_path("/data/x", "gs://bucket") == "gs://bucket/data/x"
+
+  def test_relative_local(self):
+    got = paths.absolute_path("rel/x", "file://", working_dir="/work")
+    assert got == "file:///work/rel/x"
+
+  def test_relative_remote(self):
+    assert paths.absolute_path("rel/x", "gs://bucket") == "gs://bucket/rel/x"
+
+  def test_strip_scheme(self):
+    assert paths.strip_scheme("file:///tmp/x") == "/tmp/x"
+    assert paths.strip_scheme("/tmp/x") == "/tmp/x"
+
+
+class TestTPUInfo:
+  """Mocked discovery/allocation matrix (no real TPU needed)."""
+
+  def test_parse_v5e(self):
+    topo = tpu_info.parse_accelerator_type("v5litepod-16")
+    assert topo.num_chips == 16
+    assert topo.chips_per_host == 8
+    assert topo.num_hosts == 2
+    assert topo.num_devices == 16
+
+  def test_parse_v3(self):
+    topo = tpu_info.parse_accelerator_type("v3-32")
+    # v3-32 = 32 cores = 16 chips, 4 chips/host; 2 JAX devices per chip
+    assert topo.num_chips == 16
+    assert topo.cores_per_chip == 2
+    assert topo.num_hosts == 4
+    assert topo.num_devices == 32
+
+  def test_parse_v4_counts_cores_not_chips(self):
+    # v4-8 = 8 TensorCores = 4 megacore chips on ONE host, 4 JAX devices
+    topo = tpu_info.parse_accelerator_type("v4-8")
+    assert topo.num_chips == 4
+    assert topo.num_hosts == 1
+    assert topo.num_devices == 4
+
+  def test_parse_v5p_counts_cores(self):
+    topo = tpu_info.parse_accelerator_type("v5p-8")
+    assert topo.num_chips == 4
+    assert topo.num_hosts == 1
+    assert topo.num_devices == 4
+
+  def test_parse_invalid(self):
+    with pytest.raises(ValueError):
+      tpu_info.parse_accelerator_type("gpu-a100")
+
+  def test_from_env(self):
+    env = {"TPU_ACCELERATOR_TYPE": "v5litepod-8",
+           "TPU_WORKER_HOSTNAMES": "h0,h1"}
+    topo = tpu_info.from_env(env)
+    assert topo.num_chips == 8
+    assert topo.hostnames == ["h0", "h1"]
+    assert topo.num_hosts == 2
+
+  def test_from_env_absent(self):
+    assert tpu_info.from_env({}) is None
+
+  def test_chip_env_single_worker(self):
+    env = tpu_info.chip_env_for_worker(4, worker_index=0, workers_per_host=1)
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert env["CLOUD_TPU_TASK_ID"] == "0"
+
+  def test_chip_env_multi_worker_disjoint(self):
+    e0 = tpu_info.chip_env_for_worker(2, worker_index=0, workers_per_host=4)
+    e3 = tpu_info.chip_env_for_worker(2, worker_index=3, workers_per_host=4)
+    assert e0["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert e3["TPU_VISIBLE_CHIPS"] == "6,7"
+    assert e0["TPU_PROCESS_PORT"] != e3["TPU_PROCESS_PORT"]
+
+  def test_chip_env_multihost_worker_index_wraps(self):
+    # worker 5 of a 2-worker-per-host layout lands on local slot 1
+    env = tpu_info.chip_env_for_worker(4, worker_index=5, workers_per_host=2)
+    assert env["TPU_VISIBLE_CHIPS"] == "4,5,6,7"
+    assert env["CLOUD_TPU_TASK_ID"] == "1"
+
+  def test_chip_env_overflow_raises(self):
+    with pytest.raises(ValueError, match="at most"):
+      tpu_info.chip_env_for_worker(4, worker_index=3, workers_per_host=4)
+
+  def test_chip_env_invalid(self):
+    with pytest.raises(ValueError):
+      tpu_info.chip_env_for_worker(0, 0, 1)
