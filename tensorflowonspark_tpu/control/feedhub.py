@@ -55,21 +55,26 @@ class FeedQueue(object):
 
     A blocking put of a chunk bigger than the queue bound must not deadlock:
     admit whatever fits (at least one item at a time) and keep going as the
-    consumer drains.
+    consumer drains. Non-blocking puts are all-or-nothing: if the whole
+    chunk cannot be admitted immediately, nothing is enqueued. A timed-out
+    blocking put raises :class:`QueueFull` with ``.admitted`` set to the
+    number of items already enqueued, so callers can avoid re-feeding them.
     """
     items = list(items)
     deadline = None if timeout is None else time.monotonic() + timeout
     pos = 0
     with self._cond:
+      if not block and not self._has_room(len(items)):
+        raise QueueFull(0)
       while pos < len(items):
         room = (len(items) - pos if self._maxsize <= 0
                 else self._maxsize - len(self._items))
         if room <= 0:
           if not block:
-            raise QueueFull()
+            raise QueueFull(pos)
           remaining = None if deadline is None else deadline - time.monotonic()
           if remaining is not None and remaining <= 0:
-            raise QueueFull()
+            raise QueueFull(pos)
           self._cond.wait(remaining if remaining is not None else 1.0)
           continue
         chunk = items[pos:pos + room]
@@ -134,7 +139,12 @@ class FeedQueue(object):
 
 
 class QueueFull(Exception):
-  pass
+  """Raised when a put cannot complete; ``admitted`` counts items that were
+  already enqueued before the failure (0 for non-blocking puts)."""
+
+  def __init__(self, admitted: int = 0):
+    super().__init__("queue full (admitted=%d)" % admitted)
+    self.admitted = admitted
 
 
 class QueueEmpty(Exception):
@@ -220,6 +230,29 @@ class FeedHub(object):
         pass
 
 
+# Hubs held alive per process. The owner of a hub must keep referencing the
+# manager object or BaseManager's finalizer tears the server down; task
+# closures are deserialized with detached globals (cloudpickle), so the
+# holder must be THIS module, which closures reference by import. (Parity
+# role: the TFSparkNode holder class, reference TFSparkNode.py:111-125.)
+_held: Dict[object, "FeedHub"] = {}
+
+
+def hold(key, hub: "FeedHub") -> None:
+  """Keep ``hub`` alive in this process until released."""
+  _held[key] = hub
+
+
+def held(key) -> Optional["FeedHub"]:
+  return _held.get(key)
+
+
+def release(key) -> None:
+  hub = _held.pop(key, None)
+  if hub is not None:
+    hub.shutdown()
+
+
 def start(authkey: bytes, queue_names: Sequence[str],
           mode: str = "local", qmax: int = 1024,
           host: Optional[str] = None) -> FeedHub:
@@ -235,7 +268,12 @@ def start(authkey: bytes, queue_names: Sequence[str],
     host: advertised host for remote mode (defaults to this host's IP).
   """
   bind_host = "127.0.0.1" if mode == "local" else ""
-  mgr = FeedHubManager(address=(bind_host, 0), authkey=authkey)
+  # spawn, not fork: the caller (an engine executor) typically has live
+  # queue-feeder threads, and forking a process that holds their locks can
+  # deadlock the manager child before it ever listens
+  import multiprocessing as mp
+  mgr = FeedHubManager(address=(bind_host, 0), authkey=authkey,
+                       ctx=mp.get_context("spawn"))
   mgr.start(initializer=_init_server, initargs=(list(queue_names), qmax))
   actual = mgr.address
   if mode == "remote":

@@ -133,6 +133,10 @@ class Server(MessageSocket):
     self.done = threading.Event()
     self._listener: Optional[socket.socket] = None
     self.addr: Optional[Tuple[str, int]] = None
+    # round -> set of arrived task ids; sets make re-sent arrivals (client
+    # retries after a lost reply) idempotent
+    self._barrier_arrivals: Dict[int, set] = {}
+    self._barrier_lock = threading.Lock()
 
   def start(self) -> Tuple[str, int]:
     """Bind (honoring env pinning) and serve on a background thread."""
@@ -144,14 +148,16 @@ class Server(MessageSocket):
     sock = None
     last_err = None
     for port in ports:
+      candidate = None
       try:
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((bind_host, port))
+        candidate = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        candidate.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        candidate.bind((bind_host, port))
+        sock = candidate
         break
       except OSError as e:
-        sock.close()
-        sock = None
+        if candidate is not None:
+          candidate.close()
         last_err = e
     if sock is None:
       raise OSError("unable to bind rendezvous server on ports {}: {}".format(
@@ -180,6 +186,9 @@ class Server(MessageSocket):
         if s is self._listener:
           try:
             client, _ = self._listener.accept()
+            # a client that stalls mid-message must not wedge the single
+            # serve thread: bound each blocking read
+            client.settimeout(30.0)
             conns.append(client)
           except OSError:
             pass
@@ -216,6 +225,26 @@ class Server(MessageSocket):
     elif mtype == "LIST":
       self.send(sock, {"type": "RESERVATIONS",
                        "data": self.reservations.get()})
+    elif mtype == "BARRIER":
+      # reusable barrier rounds for gang-scheduled tasks: each task announces
+      # arrival at round r (idempotently, keyed by task id), then polls
+      # BQUERY until everyone arrived
+      rnd = int(msg["round"])
+      with self._barrier_lock:
+        self._barrier_arrivals.setdefault(rnd, set()).add(msg["task_id"])
+        # prune long-completed rounds so streaming jobs syncing per-batch
+        # don't grow the dict unboundedly
+        if len(self._barrier_arrivals) > 16:
+          for old in sorted(self._barrier_arrivals)[:-8]:
+            if old < rnd - 2:
+              del self._barrier_arrivals[old]
+      self.send(sock, {"type": "OK"})
+    elif mtype == "BQUERY":
+      rnd = int(msg["round"])
+      with self._barrier_lock:
+        arrived = len(self._barrier_arrivals.get(rnd, ()))
+      self.send(sock, {"type": "BDONE",
+                       "done": arrived >= int(msg["required"])})
     elif mtype == "STOP":
       logger.info("rendezvous server received STOP")
       self.done.set()
@@ -301,6 +330,28 @@ class Client(MessageSocket):
       if time.time() > deadline:
         raise TimeoutError("timed out awaiting full cluster registration")
       time.sleep(1)
+
+  def barrier_wait(self, round_num: int, required: int,
+                   timeout: float = 600, task_id=None) -> None:
+    """Announce arrival at a barrier round and wait for the full gang.
+
+    ``task_id`` identifies this participant so retried announcements (after
+    a lost reply) stay idempotent on the server.
+    """
+    if task_id is None:
+      import os
+      task_id = "%s:%d" % (socket.gethostname(), os.getpid())
+    self._request({"type": "BARRIER", "round": round_num,
+                   "task_id": task_id})
+    deadline = time.time() + timeout
+    while True:
+      resp = self._request({"type": "BQUERY", "round": round_num,
+                            "required": required})
+      if resp["done"]:
+        return
+      if time.time() > deadline:
+        raise TimeoutError("barrier round %d timed out" % round_num)
+      time.sleep(0.05)
 
   def request_stop(self) -> None:
     try:

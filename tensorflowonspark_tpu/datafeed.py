@@ -1,0 +1,115 @@
+"""L4' user-code API: consume engine-fed data inside the main fn.
+
+Capability parity with the reference's ``TFNode.DataFeed``
+(/root/reference/tensorflowonspark/TFNode.py:234-343):
+
+- ``next_batch(n)`` pulls up to ``n`` items; ``None`` marks end-of-feed
+  (sets ``should_stop``); ``EndPartition`` is skipped in train mode but ends
+  the batch early in inference mode so results stay aligned per partition
+  (reference :278-301);
+- ``batch_results`` pushes inference outputs to the output queue (:307-318);
+- ``terminate()`` flips the hub state to ``'terminating'`` and drains the
+  input queue so blocked feeders finish (:320-343);
+- ``input_mapping`` transposes row-tuples into a dict of named columns
+  (:251,274,294-298).
+
+TPU-first difference: items move through the hub in chunks
+(``get_many``/``put_many``), one manager round-trip per batch rather than per
+row, and ``to_device_arrays`` stages a batch into device HBM.
+"""
+
+import collections
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from tensorflowonspark_tpu.control.marker import EndPartition, Marker
+
+logger = logging.getLogger(__name__)
+
+
+class DataFeed(object):
+  """Pull-based reader over this node's feed hub."""
+
+  def __init__(self, hub, train_mode: bool = True, qname_in: str = "input",
+               qname_out: str = "output",
+               input_mapping: Optional[Dict[str, str]] = None):
+    self.hub = hub
+    self.train_mode = train_mode
+    self.qname_in = qname_in
+    self.qname_out = qname_out
+    self.done_feeding = False
+    # sorted-column order matches the estimator's dataset.select(sorted(...))
+    # convention (reference pipeline.py:414, TFNode.py:251)
+    self.input_tensors = ([input_mapping[col] for col in
+                           sorted(input_mapping)] if input_mapping else None)
+    self._queue_in = hub.get_queue(qname_in)
+    self._queue_out = hub.get_queue(qname_out)
+    self._buffer = collections.deque()
+
+  def next_batch(self, batch_size: int):
+    """Return up to ``batch_size`` items (or a dict of columns when an
+    input_mapping is configured). Blocks until data arrives."""
+    batch: List = []
+    while len(batch) < batch_size:
+      if not self._buffer:
+        got = self._queue_in.get_many(batch_size - len(batch), block=True,
+                                      timeout=1.0)
+        if not got:
+          if self.done_feeding:
+            break
+          continue
+        self._queue_in.task_done(len(got))
+        self._buffer.extend(got)
+      item = self._buffer.popleft()
+      if item is None:
+        logger.info("end-of-feed marker received")
+        self.done_feeding = True
+        break
+      if isinstance(item, (Marker, EndPartition)):
+        if self.train_mode:
+          continue
+        break  # inference: batch ends at the partition boundary
+      batch.append(item)
+
+    if self.input_tensors is None:
+      return batch
+    # transpose rows -> named columns
+    cols: Dict[str, List] = {name: [] for name in self.input_tensors}
+    for row in batch:
+      for name, value in zip(self.input_tensors, row):
+        cols[name].append(value)
+    return cols
+
+  def should_stop(self) -> bool:
+    """True once the end-of-feed marker was consumed (parity :303-305)."""
+    return self.done_feeding
+
+  def batch_results(self, results: Sequence) -> None:
+    """Push a batch of inference results (parity :307-318)."""
+    self._queue_out.put_many(list(results), block=True)
+
+  def terminate(self) -> None:
+    """Request early termination: mark the hub terminating and drain the
+    input queue so blocked feeders can finish (parity :320-343)."""
+    logger.info("terminate() requested; draining input queue")
+    self.hub.set("state", "terminating")
+    self.done_feeding = True
+    empty_rounds = 0
+    while empty_rounds < 3:
+      got = self._queue_in.get_many(512, block=True, timeout=1.0)
+      if got:
+        self._queue_in.task_done(len(got))
+        empty_rounds = 0
+      else:
+        empty_rounds += 1
+
+  # -- TPU staging -----------------------------------------------------------
+
+  def next_batch_arrays(self, batch_size: int, dtype=None):
+    """Like ``next_batch`` but returns stacked numpy arrays, ready for
+    ``jax.device_put`` (host-staging step of the feed plane redesign)."""
+    import numpy as np
+    batch = self.next_batch(batch_size)
+    if isinstance(batch, dict):
+      return {k: np.asarray(v, dtype=dtype) for k, v in batch.items()}
+    return np.asarray(batch, dtype=dtype)

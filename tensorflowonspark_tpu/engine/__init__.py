@@ -1,0 +1,26 @@
+"""Executor-engine abstraction.
+
+The reference is hard-wired to Apache Spark: executors are enumerated by an
+RDD (`sc.parallelize(range(n), n)`) and every cluster operation is a Spark job
+(reference TFCluster.py:301,321). This package abstracts that contract so the
+same cluster/node layers run on:
+
+- ``SparkEngine`` — a thin adapter over pyspark (imported lazily; optional),
+- ``LocalEngine`` — a built-in multi-process engine with Spark's scheduling
+  semantics (persistent single-core executors, one task at a time, free
+  executors pull queued tasks), used for tests and single-host runs the way
+  the reference used a 2-worker Spark standalone cluster (reference tox.ini).
+"""
+
+from tensorflowonspark_tpu.engine.base import Engine, EngineJob  # noqa: F401
+from tensorflowonspark_tpu.engine.local import LocalEngine  # noqa: F401
+
+
+def get_engine(name: str = "local", **kwargs) -> Engine:
+  """Engine factory: ``'local'`` or ``'spark'``."""
+  if name == "local":
+    return LocalEngine(**kwargs)
+  if name == "spark":
+    from tensorflowonspark_tpu.engine.spark import SparkEngine
+    return SparkEngine(**kwargs)
+  raise ValueError("unknown engine: %r" % name)

@@ -1,0 +1,137 @@
+"""Engine interface: what the cluster layer needs from an executor engine.
+
+Derived from how the reference drives Spark (TFCluster.py / TFSparkNode.py /
+TFParallel.py):
+
+- enumerate N persistent executors and run a function once on each
+  (``nodeRDD.foreachPartition`` — node bring-up, shutdown jobs),
+- stream partitioned data through whichever executors are free
+  (``dataRDD.foreachPartition`` — feeding; ``dataRDD.mapPartitions`` —
+  inference with collected results),
+- gang-schedule with placement info (``rdd.barrier().mapPartitions``),
+- replicate a dataset for epochs (``sc.union([rdd]*n)``).
+
+Scheduling semantics the cluster layer RELIES on (Spark parity):
+
+1. An executor runs one task at a time; a task that blocks keeps its executor
+   busy (this is how ps/evaluator slots are kept out of feed scheduling —
+   reference TFCluster.py:12-13).
+2. ``run_on_executors`` places exactly one task on each distinct executor.
+3. Queued tasks go to any executor that becomes free.
+"""
+
+import abc
+import threading
+from typing import Callable, Iterable, List, Optional, Sequence
+
+
+class EngineJob(object):
+  """Handle for an asynchronously running set of tasks."""
+
+  def __init__(self, num_tasks: int):
+    self.num_tasks = num_tasks
+    self.results: List[object] = [None] * num_tasks
+    self.errors: List[Optional[str]] = [None] * num_tasks
+    self._done = 0
+    self._cond = threading.Condition()
+
+  def _task_finished(self, task_id: int, result=None, error: Optional[str] = None):
+    with self._cond:
+      self.results[task_id] = result
+      self.errors[task_id] = error
+      self._done += 1
+      self._cond.notify_all()
+
+  def done(self) -> bool:
+    with self._cond:
+      return self._done >= self.num_tasks
+
+  def first_error(self) -> Optional[str]:
+    with self._cond:
+      for e in self.errors:
+        if e is not None:
+          return e
+      return None
+
+  def wait(self, timeout: Optional[float] = None, raise_on_error: bool = True):
+    """Block until all tasks finish; raise the first task error by default."""
+    with self._cond:
+      import time
+      deadline = None if timeout is None else time.monotonic() + timeout
+      while self._done < self.num_tasks:
+        remaining = None if deadline is None else deadline - time.monotonic()
+        if remaining is not None and remaining <= 0:
+          raise TimeoutError(
+              "engine job timed out with %d/%d tasks finished"
+              % (self._done, self.num_tasks))
+        self._cond.wait(remaining if remaining is not None else 1.0)
+    if raise_on_error:
+      err = self.first_error()
+      if err:
+        raise RuntimeError("engine task failed:\n%s" % err)
+    return self.results
+
+
+class Engine(abc.ABC):
+  """Abstract executor engine (see module docstring for the contract)."""
+
+  @property
+  @abc.abstractmethod
+  def num_executors(self) -> int:
+    ...
+
+  @abc.abstractmethod
+  def run_on_executors(self, fn: Callable[[Iterable], object],
+                       num_tasks: Optional[int] = None) -> EngineJob:
+    """Run ``fn(iter([task_id]))`` once on each of ``num_tasks`` distinct
+    executors (async). Parity: nodeRDD.foreachPartition."""
+
+  @abc.abstractmethod
+  def foreach_partition(self, partitions: Sequence[Iterable],
+                        fn: Callable[[Iterable], object]) -> EngineJob:
+    """Run ``fn(iter(partition))`` for each partition on free executors
+    (async). Parity: dataRDD.foreachPartition."""
+
+  @abc.abstractmethod
+  def map_partitions(self, partitions: Sequence[Iterable],
+                     fn: Callable[[Iterable], Iterable],
+                     timeout: Optional[float] = None) -> List:
+    """Run ``fn`` per partition, collect and concatenate results (blocking).
+    Parity: dataRDD.mapPartitions(...).collect()."""
+
+  @abc.abstractmethod
+  def barrier_run(self, fn: Callable[[Iterable, "BarrierContext"], object],
+                  num_tasks: Optional[int] = None,
+                  timeout: Optional[float] = None) -> List:
+    """Gang-schedule ``fn(iter([task_id]), barrier_ctx)`` on distinct
+    executors; all tasks start together and get placement info. Parity:
+    rdd.barrier().mapPartitions with BarrierTaskContext (TFParallel.py:43-56).
+    Raises if num_tasks exceeds available executors."""
+
+  def default_fs(self) -> str:
+    """Default filesystem URI for path normalization."""
+    return "file://"
+
+  def stop(self) -> None:
+    """Release engine resources (no-op by default)."""
+
+
+class BarrierContext(object):
+  """Placement info + synchronization for barrier tasks.
+
+  Parity: pyspark BarrierTaskContext — ``get_task_infos()`` lists the
+  addresses of all gang members; ``barrier()`` is a global sync point.
+  """
+
+  def __init__(self, task_id: int, addresses: List[str],
+               sync_fn: Optional[Callable[[], None]] = None):
+    self.task_id = task_id
+    self.addresses = addresses
+    self._sync_fn = sync_fn
+
+  def get_task_infos(self) -> List[str]:
+    return list(self.addresses)
+
+  def barrier(self) -> None:
+    if self._sync_fn is not None:
+      self._sync_fn()

@@ -1,0 +1,248 @@
+"""LocalEngine: a built-in multi-process executor engine with Spark semantics.
+
+Replaces the role Spark Standalone played in the reference's test strategy
+(reference tox.ini / tests/run_tests.sh: a 2-worker single-core standalone
+cluster on one host, because Spark *local* mode shares one process and TFoS
+assumes separate executor processes — reference tests/README.md:10).
+
+Semantics implemented (see engine.base for why they matter):
+- N persistent executor processes, spawned (not forked — safe to initialize
+  JAX inside tasks), each with its own working directory and
+  ``TOS_EXECUTOR_SLOT`` env var;
+- one task at a time per executor; a blocked task keeps its executor busy;
+- pinned tasks (node bring-up, barrier gangs) target a specific executor,
+  queued tasks go to whichever executor frees up first;
+- closures serialized with cloudpickle, like Spark serializes task closures.
+"""
+
+import logging
+import multiprocessing as mp
+import os
+import shutil
+import tempfile
+import threading
+import traceback
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import cloudpickle
+
+from tensorflowonspark_tpu.engine.base import BarrierContext, Engine, EngineJob
+
+logger = logging.getLogger(__name__)
+
+_STOP = "__stop_executor__"
+
+
+def _executor_main(slot: int, workdir: str, task_q, result_q, env: Dict[str, str]):
+  """Executor process entry point: run one task at a time, forever."""
+  os.chdir(workdir)
+  os.environ.update(env)
+  os.environ["TOS_EXECUTOR_SLOT"] = str(slot)
+  while True:
+    item = task_q.get()
+    if item == _STOP:
+      break
+    job_id, task_id, fn_bytes, data_bytes = item
+    try:
+      fn = cloudpickle.loads(fn_bytes)
+      data = cloudpickle.loads(data_bytes)
+      result = fn(iter(data))
+      # mapPartitions-style fns may return generators; materialize here,
+      # inside the executor, like Spark does on collect
+      if result is not None and hasattr(result, "__iter__") \
+          and not isinstance(result, (list, tuple, str, bytes, dict)):
+        result = list(result)
+      result_q.put((slot, job_id, task_id, "ok", cloudpickle.dumps(result)))
+    except BaseException:  # noqa: BLE001 - full traceback must reach driver
+      result_q.put((slot, job_id, task_id, "err", traceback.format_exc()))
+
+
+class LocalEngine(Engine):
+  """Multi-process engine; see module docstring."""
+
+  def __init__(self, num_executors: int = 2, workdir: Optional[str] = None,
+               env: Optional[Dict[str, str]] = None):
+    self._num_executors = num_executors
+    self._root = workdir or tempfile.mkdtemp(prefix="tos_tpu_engine_")
+    self._owns_root = workdir is None
+    self._ctx = mp.get_context("spawn")
+    self._result_q = self._ctx.Queue()
+    self._procs = []
+    self._task_qs = []
+    env = dict(env or {})
+    for slot in range(num_executors):
+      wd = os.path.join(self._root, "executor_%d" % slot)
+      os.makedirs(wd, exist_ok=True)
+      tq = self._ctx.Queue()
+      # non-daemonic: executors must be able to spawn children (feed hub
+      # manager processes, background node processes); cleanup is handled by
+      # stop() + the atexit hook below
+      p = self._ctx.Process(target=_executor_main,
+                            args=(slot, wd, tq, self._result_q, env),
+                            daemon=False, name="local-executor-%d" % slot)
+      p.start()
+      self._procs.append(p)
+      self._task_qs.append(tq)
+
+    # scheduler state
+    self._lock = threading.Lock()
+    self._idle = set(range(num_executors))
+    self._pinned: List[deque] = [deque() for _ in range(num_executors)]
+    self._shared: deque = deque()
+    self._jobs: Dict[int, EngineJob] = {}
+    self._next_job_id = 0
+    self._stopped = threading.Event()
+    self._collector = threading.Thread(target=self._collect, daemon=True,
+                                       name="local-engine-collector")
+    self._collector.start()
+    import atexit
+    atexit.register(self.stop)
+
+  # -- Engine interface ------------------------------------------------------
+
+  @property
+  def num_executors(self) -> int:
+    return self._num_executors
+
+  def executor_workdir(self, slot: int) -> str:
+    return os.path.join(self._root, "executor_%d" % slot)
+
+  def run_on_executors(self, fn, num_tasks: Optional[int] = None) -> EngineJob:
+    n = num_tasks if num_tasks is not None else self._num_executors
+    if n > self._num_executors:
+      raise ValueError("requested %d tasks but engine has %d executors"
+                       % (n, self._num_executors))
+    job = self._new_job(n)
+    fn_bytes = cloudpickle.dumps(fn)
+    with self._lock:
+      for i in range(n):
+        self._pinned[i].append((job.job_id, i, fn_bytes,
+                                cloudpickle.dumps([i])))
+      self._schedule_locked()
+    return job
+
+  def foreach_partition(self, partitions: Sequence[Iterable], fn) -> EngineJob:
+    job = self._new_job(len(partitions))
+    fn_bytes = cloudpickle.dumps(fn)
+    with self._lock:
+      for i, part in enumerate(partitions):
+        self._shared.append((job.job_id, i, fn_bytes, cloudpickle.dumps(part)))
+      self._schedule_locked()
+    return job
+
+  def map_partitions(self, partitions, fn, timeout=None) -> List:
+    job = self.foreach_partition(partitions, fn)
+    results = job.wait(timeout=timeout)
+    out = []
+    for r in results:
+      if r is None:
+        continue
+      out.extend(r if isinstance(r, (list, tuple)) else [r])
+    return out
+
+  def barrier_run(self, fn, num_tasks: Optional[int] = None,
+                  timeout: Optional[float] = None) -> List:
+    """Gang-schedule with placement info and a reusable barrier.
+
+    Oversubscription fails fast (parity: Spark barrier mode raising when the
+    gang cannot be scheduled at once — reference tests/test_TFParallel.py).
+    """
+    n = num_tasks if num_tasks is not None else self._num_executors
+    if n > self._num_executors:
+      raise ValueError(
+          "barrier gang of %d cannot be scheduled on %d executors"
+          % (n, self._num_executors))
+    from tensorflowonspark_tpu.control.rendezvous import Client, Server
+    from tensorflowonspark_tpu.utils.hostinfo import get_ip_address
+    server = Server(n)
+    addr = server.start()
+    ip = get_ip_address()
+    addresses = ["%s:%d" % (ip, slot) for slot in range(n)]
+
+    def _barrier_task(it, _fn=fn, _addr=addr, _addresses=addresses, _n=n):
+      task_id = next(iter(it))
+      client = Client((_addr[0], _addr[1]))
+      client.register({"executor_id": task_id, "host": _addresses[task_id]})
+      client.await_reservations(timeout=60)  # gang start line
+
+      state = {"round": 0}
+
+      def sync():
+        state["round"] += 1
+        client.barrier_wait(state["round"], required=_n, timeout=600,
+                            task_id=task_id)
+
+      ctx = BarrierContext(task_id, _addresses, sync_fn=sync)
+      try:
+        return _fn(iter([task_id]), ctx)
+      finally:
+        client.close()
+
+    try:
+      job = self.run_on_executors(_barrier_task, num_tasks=n)
+      return job.wait(timeout=timeout)
+    finally:
+      server.stop()
+
+  def stop(self) -> None:
+    if self._stopped.is_set():
+      return
+    self._stopped.set()
+    for tq in self._task_qs:
+      try:
+        tq.put(_STOP)
+      except Exception:  # noqa: BLE001
+        pass
+    for p in self._procs:
+      p.join(timeout=5)
+      if p.is_alive():
+        p.terminate()
+        p.join(timeout=5)
+    if self._owns_root:
+      shutil.rmtree(self._root, ignore_errors=True)
+
+  # -- internals -------------------------------------------------------------
+
+  def _new_job(self, num_tasks: int) -> EngineJob:
+    job = EngineJob(num_tasks)
+    with self._lock:
+      job.job_id = self._next_job_id
+      self._next_job_id += 1
+      self._jobs[job.job_id] = job
+    return job
+
+  def _schedule_locked(self) -> None:
+    """Assign queued tasks to idle executors (caller holds self._lock)."""
+    for slot in list(self._idle):
+      task = None
+      if self._pinned[slot]:
+        task = self._pinned[slot].popleft()
+      elif self._shared:
+        task = self._shared.popleft()
+      if task is not None:
+        self._idle.discard(slot)
+        self._task_qs[slot].put(task)
+
+  def _collect(self) -> None:
+    while not self._stopped.is_set():
+      try:
+        slot, job_id, task_id, status, payload = self._result_q.get(timeout=0.25)
+      except Exception:  # noqa: BLE001 - queue.Empty or closed queue
+        continue
+      with self._lock:
+        self._idle.add(slot)
+        self._schedule_locked()
+        job = self._jobs.get(job_id)
+      if job is None:
+        continue
+      if status == "ok":
+        job._task_finished(task_id, result=cloudpickle.loads(payload))
+      else:
+        job._task_finished(task_id, error=payload)
+
+  def __del__(self):
+    try:
+      self.stop()
+    except Exception:  # noqa: BLE001
+      pass

@@ -1,0 +1,116 @@
+"""SparkEngine: adapter mapping the Engine contract onto pyspark.
+
+Optional — importable only where pyspark is installed. Maps each Engine
+operation onto the exact Spark idiom the reference used:
+
+- ``run_on_executors``  → ``sc.parallelize(range(n), n).foreachPartition``
+  (reference TFCluster.py:301,321), launched from a daemon thread so it is
+  async like the reference's ``_start`` thread (TFCluster.py:318-336);
+- ``foreach_partition`` → ``rdd.foreachPartition``;
+- ``map_partitions``    → ``rdd.mapPartitions(...).collect()``;
+- ``barrier_run``       → ``rdd.barrier().mapPartitions`` with
+  BarrierTaskContext (reference TFParallel.py:43-74).
+
+``from_rdd`` lets callers hand existing RDDs/DataFrames to cluster.train /
+cluster.inference without materializing them on the driver.
+"""
+
+import logging
+import threading
+from typing import Callable, Iterable, List, Optional, Sequence
+
+from tensorflowonspark_tpu.engine.base import BarrierContext, Engine, EngineJob
+
+logger = logging.getLogger(__name__)
+
+
+class SparkEngine(Engine):
+  """Engine over a live SparkContext (requires pyspark)."""
+
+  def __init__(self, sc=None, num_executors: Optional[int] = None):
+    if sc is None:
+      from pyspark import SparkContext
+      sc = SparkContext.getOrCreate()
+    self.sc = sc
+    if num_executors is None:
+      num_executors = int(sc.getConf().get("spark.executor.instances", "0")) \
+          or sc.defaultParallelism
+    self._num_executors = num_executors
+
+  @property
+  def num_executors(self) -> int:
+    return self._num_executors
+
+  def default_fs(self) -> str:
+    try:
+      return self.sc._jsc.hadoopConfiguration().get("fs.defaultFS")
+    except Exception:  # noqa: BLE001 - no JVM/hadoop conf
+      return "file://"
+
+  def _async_job(self, runner: Callable[[], List], num_tasks: int) -> EngineJob:
+    job = EngineJob(num_tasks)
+    job.job_id = -1
+
+    def _run():
+      try:
+        results = runner()
+        for i in range(num_tasks):
+          r = results[i] if results and i < len(results) else None
+          job._task_finished(i, result=r)
+      except Exception:  # noqa: BLE001 - deliver driver-side traceback
+        import traceback
+        tb = traceback.format_exc()
+        for i in range(num_tasks):
+          if job.errors[i] is None and job.results[i] is None:
+            job._task_finished(i, error=tb)
+
+    threading.Thread(target=_run, daemon=True,
+                     name="spark-engine-job").start()
+    return job
+
+  def run_on_executors(self, fn, num_tasks: Optional[int] = None) -> EngineJob:
+    n = num_tasks if num_tasks is not None else self._num_executors
+    rdd = self.sc.parallelize(range(n), n)
+
+    def runner():
+      rdd.foreachPartition(fn)
+      return [None] * n
+
+    return self._async_job(runner, n)
+
+  def foreach_partition(self, partitions, fn) -> EngineJob:
+    rdd = self._as_rdd(partitions)
+    n = rdd.getNumPartitions()
+
+    def runner():
+      rdd.foreachPartition(fn)
+      return [None] * n
+
+    return self._async_job(runner, n)
+
+  def map_partitions(self, partitions, fn, timeout=None) -> List:
+    rdd = self._as_rdd(partitions)
+    return rdd.mapPartitions(fn).collect()
+
+  def barrier_run(self, fn, num_tasks: Optional[int] = None,
+                  timeout: Optional[float] = None) -> List:
+    n = num_tasks if num_tasks is not None else self._num_executors
+    rdd = self.sc.parallelize(range(n), n)
+
+    def _task(it):
+      from pyspark import BarrierTaskContext
+      btc = BarrierTaskContext.get()
+      infos = [t.address for t in btc.getTaskInfos()]
+      ctx = BarrierContext(btc.partitionId(), infos, sync_fn=btc.barrier)
+      return [fn(it, ctx)]
+
+    return rdd.barrier().mapPartitions(_task).collect()
+
+  def _as_rdd(self, partitions):
+    """Accept an existing RDD, a DataFrame, or driver-side partition lists."""
+    if hasattr(partitions, "rdd"):      # DataFrame
+      return partitions.rdd
+    if hasattr(partitions, "mapPartitions"):  # RDD
+      return partitions
+    return self.sc.parallelize(
+        [row for part in partitions for row in part], max(1, len(partitions)))

@@ -1,0 +1,8 @@
+"""Model families mirroring the reference's example workloads, TPU-first.
+
+The reference shipped its models as examples (MNIST MLP/CNN keras, ResNet
+CIFAR, MobileNetV2 U-Net segmentation — /root/reference/examples/); here they
+are first-class library models in flax, designed for bfloat16 MXU execution
+and pjit/GSPMD sharding, plus a Transformer family (the long-context flagship
+capability the reference lacked).
+"""

@@ -1,0 +1,528 @@
+"""L2' per-executor node runtime.
+
+Capability parity with the reference's ``TFSparkNode.py``
+(/root/reference/tensorflowonspark/TFSparkNode.py), re-designed for TPU:
+
+- device allocation exports TPU chip shares (utils.tpu_info) instead of
+  ``CUDA_VISIBLE_DEVICES`` from nvidia-smi parsing (reference :179-239);
+- the synthesized cluster spec feeds ``jax.distributed.initialize`` (the JAX
+  analog of exporting ``TF_CONFIG``, reference :373-384) — collectives then
+  compile to XLA all-reduce over ICI/DCN rather than TF gRPC;
+- roles: workers run the user main fn in the foreground (FILES input mode) or
+  a background process (ENGINE/SPARK input mode, reference :431-439);
+  ps/evaluator run it in a background process while the foreground blocks on a
+  ``control`` queue until the driver sends ``None`` (reference :441-458);
+- fault propagation parity: a dedicated ``error`` queue per executor;
+  background exceptions captured as tracebacks (reference :423-429), re-raised
+  at shutdown with peek-and-put-back so engine task retries still observe the
+  failure (reference :644-650);
+- retried bring-up tasks re-register idempotently, while a live hub from a
+  concurrent duplicate forces an error (reference :259-265).
+"""
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+import traceback
+from typing import Dict, List, Optional
+
+from tensorflowonspark_tpu.control import feedhub, rendezvous
+from tensorflowonspark_tpu.utils import hostinfo, paths, tpu_info
+
+logger = logging.getLogger(__name__)
+
+JAX_ROLES = ("chief", "master", "worker")  # roles that join the JAX mesh
+BACKGROUND_ROLES = ("ps", "evaluator")     # roles parked on a control queue
+
+HUB_ADDR_FILE = "hub_addr"
+
+
+class TPUNodeContext(object):
+  """Per-node metadata handed to the user main fn as ``ctx``.
+
+  Field parity with the reference's TFNodeContext (TFSparkNode.py:62-108),
+  plus the TPU-native coordinates (``coordinator_address``, ``process_id``,
+  ``num_processes``) needed for ``jax.distributed.initialize``.
+  """
+
+  def __init__(self, executor_id=0, job_name="worker", task_index=0,
+               cluster_spec=None, default_fs="file://", working_dir=".",
+               hub=None, tmp_socket=None, coordinator_address=None,
+               process_id=0, num_processes=1, cluster_info=None):
+    self.executor_id = executor_id
+    self.worker_num = executor_id          # backwards-compat alias
+    self.job_name = job_name
+    self.task_index = task_index
+    self.cluster_spec = cluster_spec or {}
+    self.num_workers = sum(
+        len(v) for k, v in self.cluster_spec.items() if k in JAX_ROLES)
+    self.default_fs = default_fs
+    self.defaultFS = default_fs            # backwards-compat alias
+    self.working_dir = working_dir
+    self.mgr = hub                         # backwards-compat alias
+    self.hub = hub
+    self.tmp_socket = tmp_socket
+    self.coordinator_address = coordinator_address
+    self.process_id = process_id
+    self.num_processes = num_processes
+    self.cluster_info = cluster_info or []
+
+  # -- convenience mirrors (parity: TFSparkNode.py:92-108) -------------------
+
+  def absolute_path(self, path: str) -> str:
+    return paths.absolute_path(path, self.default_fs, self.working_dir)
+
+  def get_data_feed(self, train_mode=True, qname_in="input",
+                    qname_out="output", input_mapping=None):
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    return DataFeed(self.hub, train_mode, qname_in, qname_out, input_mapping)
+
+  def release_port(self) -> None:
+    """Release the reserved coordinator port prior to starting JAX distributed
+    (parity: TFNode.release_port, TFNode.py:214-221)."""
+    if self.tmp_socket is not None:
+      self.tmp_socket.close()
+      self.tmp_socket = None
+
+  def export_model(self, state, export_dir: str) -> str:
+    from tensorflowonspark_tpu.utils import compat
+    return compat.export_model(state, export_dir, self.is_chief)
+
+  @property
+  def is_chief(self) -> bool:
+    return (self.job_name in ("chief", "master")
+            or (self.job_name == "worker" and self.task_index == 0
+                and not any(r in self.cluster_spec for r in ("chief", "master"))))
+
+  def initialize_distributed(self) -> None:
+    """Join the JAX process group (TPU analog of TF reading TF_CONFIG).
+
+    Safe to skip for single-process clusters. ps/evaluator nodes never call
+    this — they are outside the mesh.
+    """
+    if self.num_processes <= 1:
+      logger.info("single-process cluster; skipping jax.distributed")
+      return
+    self.release_port()
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=self.coordinator_address,
+        num_processes=self.num_processes,
+        process_id=self.process_id)
+
+
+def _role_of(executor_id: int, cluster_template: Dict[str, List[int]]):
+  for job_name, ids in cluster_template.items():
+    if executor_id in ids:
+      return job_name, ids.index(executor_id)
+  raise ValueError("executor %d not present in cluster template %r"
+                   % (executor_id, cluster_template))
+
+
+def _jax_process_table(cluster_info: List[dict]):
+  """Rank the mesh-joining nodes: chief/master first, then workers by index.
+
+  Returns (ordered list of node metas, coordinator host:port).
+  """
+  chiefs = [n for n in cluster_info if n["job_name"] in ("chief", "master")]
+  workers = sorted((n for n in cluster_info if n["job_name"] == "worker"),
+                   key=lambda n: n["task_index"])
+  table = chiefs + workers
+  coord = "%s:%d" % (table[0]["host"], table[0]["port"]) if table else None
+  return table, coord
+
+
+def _build_cluster_spec(cluster_info: List[dict]) -> Dict[str, List[str]]:
+  """{job_name: ["host:port", ...]} sorted by task index.
+
+  Rejects duplicate executor ids (parity: TFSparkNode.py:50-53).
+  """
+  seen = set()
+  for n in cluster_info:
+    if n["executor_id"] in seen:
+      raise RuntimeError("duplicate executor_id %d in cluster info"
+                         % n["executor_id"])
+    seen.add(n["executor_id"])
+  spec: Dict[str, List[str]] = {}
+  by_job: Dict[str, List[dict]] = {}
+  for n in cluster_info:
+    by_job.setdefault(n["job_name"], []).append(n)
+  for job, nodes in by_job.items():
+    spec[job] = ["%s:%d" % (n["host"], n["port"])
+                 for n in sorted(nodes, key=lambda n: n["task_index"])]
+  return spec
+
+
+def _spawn_tensorboard(log_dir: str) -> Optional[dict]:
+  """Launch a TensorBoard server subprocess (parity: TFSparkNode.py:292-329).
+
+  Port selection: env ``TENSORBOARD_PORT`` or an ephemeral bind. Returns
+  {'pid','url'} or None when no tensorboard binary is on PATH/PYTHONPATH.
+  """
+  tb_port = os.environ.get("TENSORBOARD_PORT")
+  port = int(tb_port) if tb_port else hostinfo.get_free_port()
+  tb_bin = hostinfo.find_in_path(os.environ.get("PATH", ""), "tensorboard")
+  if not tb_bin:
+    logger.warning("tensorboard binary not found; skipping launch")
+    return None
+  proc = subprocess.Popen(
+      [sys.executable, tb_bin, "--logdir", log_dir, "--port", str(port),
+       "--host", "0.0.0.0"],
+      stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+  url = "http://%s:%d" % (hostinfo.get_ip_address(), port)
+  logger.info("started TensorBoard pid=%d at %s", proc.pid, url)
+  return {"pid": proc.pid, "url": url}
+
+
+def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
+                       hub_addr, authkey: bytes):
+  """Entry point of the background process running the user main fn.
+
+  Reconnects to this executor's feed hub by address (the hub lives in a
+  separate manager process), captures any exception into the ``error`` queue
+  as a traceback (parity: TFSparkNode.py:423-429) and drives the hub state
+  machine to ``'stopped'``.
+  """
+  import cloudpickle
+  hub = feedhub.connect(tuple(hub_addr), authkey)
+  ctx = TPUNodeContext(hub=hub, **ctx_kwargs)
+  try:
+    fn = cloudpickle.loads(fn_bytes)
+    fn(tf_args, ctx)
+  except BaseException:  # noqa: BLE001 - traceback must reach the driver
+    tb = traceback.format_exc()
+    logger.error("background main fn failed:\n%s", tb)
+    try:
+      hub.get_queue("error").put(tb)
+    except Exception:  # noqa: BLE001
+      pass
+  finally:
+    try:
+      hub.set("state", "stopped")
+    except Exception:  # noqa: BLE001
+      pass
+
+
+def make_node_fn(main_fn, tf_args, cluster_meta: dict):
+  """Build the engine task that brings up one cluster node (parity:
+  TFSparkNode.run → _mapfn, TFSparkNode.py:158-465)."""
+  import cloudpickle
+  fn_bytes = cloudpickle.dumps(main_fn)
+
+  def _mapfn(iterator):
+    # 1. learn this task's executor id from its partition (parity :176-177)
+    executor_id = next(iter(iterator))
+    meta = cluster_meta
+    working_dir = os.getcwd()
+    job_name, task_index = _role_of(executor_id, meta["cluster_template"])
+    authkey = meta["authkey"] if isinstance(meta["authkey"], bytes) \
+        else bytes(meta["authkey"])
+
+    # 2. duplicate/stale hub detection (parity :259-265): a live hub in this
+    # working dir means another concurrent node task owns this executor
+    if os.path.exists(os.path.join(working_dir, HUB_ADDR_FILE)):
+      try:
+        with open(os.path.join(working_dir, HUB_ADDR_FILE)) as f:
+          host, port = f.read().strip().split(":")
+        old = feedhub.connect((host, int(port)), authkey)
+        state = old.get("state")
+        if state in ("running", "terminating"):
+          raise RuntimeError(
+              "executor already runs a live node (hub state=%r); failing this "
+              "task so the engine can retry it elsewhere" % state)
+        logger.info("found stale hub (state=%r); reclaiming executor", state)
+      except (ConnectionError, OSError):
+        logger.info("found dead hub address file; reclaiming executor")
+
+    # 3. TPU chip allocation before any JAX/libtpu init (reference allocated
+    # GPUs via nvidia-smi here, :179-239)
+    num_chips = meta.get("chips_per_node", 0)
+    if num_chips and not os.environ.get("TOS_TPU_TEST_MODE"):
+      topo = tpu_info.get_topology()
+      if topo is not None:
+        workers_per_host = max(1, topo.chips_per_host // num_chips)
+        tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
+            num_chips, executor_id, workers_per_host))
+
+    # 4. start the feed hub; remote mode for driver-reachable roles
+    hub_mode = "remote" if job_name in BACKGROUND_ROLES else "local"
+    hub = feedhub.start(authkey, meta["queues"], mode=hub_mode,
+                        qmax=meta.get("qmax", 1024))
+    feedhub.hold(executor_id, hub)
+    hostinfo.write_executor_id(executor_id, working_dir)
+    with open(os.path.join(working_dir, HUB_ADDR_FILE), "w") as f:
+      f.write("%s:%d" % hub.addr)
+
+    # 5. reserve a port for the JAX coordinator / collectives endpoint
+    # (parity with TF GRPC port reservation, :344-352); env pin supported
+    tmp_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    tmp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    tmp_sock.bind(("", int(os.environ.get("TOS_TPU_NODE_PORT", "0"))))
+    port = tmp_sock.getsockname()[1]
+
+    # 6. TensorBoard on chief / worker:0 (parity :292-329)
+    tb_info = None
+    if meta.get("tensorboard") and (
+        job_name in ("chief", "master")
+        or (job_name == "worker" and task_index == 0
+            and not any(j in meta["cluster_template"]
+                        for j in ("chief", "master")))):
+      log_dir = meta.get("log_dir") or os.path.join(working_dir, "tensorboard")
+      os.makedirs(paths.strip_scheme(log_dir), exist_ok=True)
+      tb_info = _spawn_tensorboard(paths.strip_scheme(log_dir))
+      if tb_info:
+        hub.set("tb_pid", tb_info["pid"])
+        hub.set("tb_url", tb_info["url"])
+
+    # 7. register and wait for the whole cluster (parity :332-370)
+    host = hostinfo.get_ip_address()
+    client = rendezvous.Client(tuple(meta["server_addr"]))
+    reservation = {
+        "executor_id": executor_id,
+        "host": host,
+        "job_name": job_name,
+        "task_index": task_index,
+        "port": port,
+        "hub_addr": list(hub.addr),
+        "pid": os.getpid(),
+        "tb_url": tb_info["url"] if tb_info else None,
+    }
+    client.register(reservation)
+    cluster_info = client.await_reservations(
+        timeout=meta.get("reservation_timeout", 600))
+    client.close()
+
+    # 8. synthesize the cluster spec + JAX process coordinates (the TPU
+    # analog of exporting TF_CONFIG, parity :373-384)
+    cluster_spec = _build_cluster_spec(cluster_info)
+    table, coordinator = _jax_process_table(cluster_info)
+    process_id = next((i for i, n in enumerate(table)
+                       if n["executor_id"] == executor_id), -1)
+
+    ctx_kwargs = dict(
+        executor_id=executor_id, job_name=job_name, task_index=task_index,
+        cluster_spec=cluster_spec, default_fs=meta.get("default_fs", "file://"),
+        working_dir=working_dir, coordinator_address=coordinator,
+        process_id=process_id, num_processes=len(table),
+        cluster_info=cluster_info)
+
+    # 9. release-port semantics (parity :400-405): by default the reserved
+    # port is released before the user fn; with release_port=False user code
+    # calls ctx.release_port() itself right before jax.distributed.initialize
+    release_now = meta.get("release_port", True)
+
+    # 10. run the user main fn per role (parity :417-463)
+    if isinstance(tf_args, list):
+      sys.argv = [sys.argv[0] if sys.argv else "main"] + list(tf_args)
+
+    if job_name in BACKGROUND_ROLES or meta["input_mode"] == 1:
+      # background execution; foreground either returns (workers, so feeding
+      # tasks can be scheduled onto this executor) or parks on the control
+      # queue (ps/evaluator) until the driver sends None (parity :431-458)
+      tmp_sock.close()
+      import multiprocessing as mp
+      proc = mp.get_context("spawn").Process(
+          target=_background_runner,
+          args=(fn_bytes, tf_args, ctx_kwargs, list(hub.addr), authkey),
+          daemon=True, name="tos-node-%d" % executor_id)
+      proc.start()
+      hub.set("node_pid", proc.pid)
+      if job_name in BACKGROUND_ROLES:
+        control = hub.get_queue("control")
+        while True:
+          items = control.get_many(1, timeout=1.0)
+          if items and items[0] is None:
+            break
+        hub.set("state", "stopped")
+      return [executor_id]
+    else:
+      # foreground execution (FILES mode workers, parity :459-463)
+      if release_now:
+        tmp_sock.close()
+        tmp_sock = None
+      ctx = TPUNodeContext(hub=hub, tmp_socket=tmp_sock, **ctx_kwargs)
+      try:
+        main_fn(tf_args, ctx)
+        hub.set("state", "stopped")
+      except BaseException:
+        tb = traceback.format_exc()
+        try:
+          hub.get_queue("error").put(tb)
+          hub.set("state", "stopped")
+        except Exception:  # noqa: BLE001
+          pass
+        raise
+      return [executor_id]
+
+  return _mapfn
+
+
+# --- data-plane task factories (parity: TFSparkNode.train/inference) --------
+
+
+def _get_hub(cluster_info: List[dict], executor_id: int, authkey: bytes):
+  """Locate the feed hub of the node that owns this executor working dir
+  (parity: TFSparkNode._get_manager, TFSparkNode.py:128-155)."""
+  for n in cluster_info:
+    if n["executor_id"] == executor_id:
+      return feedhub.connect(tuple(n["hub_addr"]), authkey)
+  raise RuntimeError("no cluster node found for executor %d" % executor_id)
+
+
+def _check_errors(hub, where: str) -> None:
+  """Poll the error queue; re-raise worker tracebacks on the feeder/driver
+  side (parity: TFSparkNode.py:508-515)."""
+  eq = hub.get_queue("error")
+  errs = eq.get_many(16, block=False)
+  if errs:
+    # put back so shutdown's check still sees it (parity :644-650)
+    eq.put_many(errs)
+    raise RuntimeError("worker error detected during %s:\n%s"
+                       % (where, "\n".join(str(e) for e in errs)))
+
+
+def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
+                  chunk_size=256):
+  """Feeder task: push one data partition into the local node's input queue.
+
+  TPU-first redesign of the reference's row-at-a-time loop
+  (TFSparkNode.py:500-502): rows move in chunks via ``put_many``, preserving
+  blocking backpressure and the terminating-state drain semantics
+  (TFSparkNode.py:492-531).
+  """
+  authkey = cluster_meta["authkey"]
+
+  def _train(iterator):
+    executor_id = hostinfo.read_executor_id(os.getcwd())
+    hub = _get_hub(cluster_info, executor_id, authkey)
+    state = hub.get("state")
+    queue = hub.get_queue(qname)
+    if state == "terminating":
+      # user called DataFeed.terminate(): consume and discard the partition
+      # so the engine job completes (parity :492-496)
+      logger.info("node terminating; skipping partition feed")
+      for _ in iterator:
+        pass
+      return [0]
+    rows = 0
+    chunk = []
+    for item in iterator:
+      chunk.append(item)
+      if len(chunk) >= chunk_size:
+        queue.put_many(chunk, block=True, timeout=feed_timeout)
+        rows += len(chunk)
+        chunk = []
+      if rows % (chunk_size * 8) == 0 and rows:
+        _check_errors(hub, "feeding")
+    if chunk:
+      queue.put_many(chunk, block=True, timeout=feed_timeout)
+      rows += len(chunk)
+    # wait until the consumer processed everything, surfacing errors
+    # (parity :504-517)
+    deadline = time.monotonic() + feed_timeout
+    while not queue.join(timeout=1.0):
+      _check_errors(hub, "feeding")
+      if time.monotonic() > deadline:
+        raise TimeoutError(
+            "feed timeout (%ds) waiting for node to consume %d rows"
+            % (feed_timeout, rows))
+    _check_errors(hub, "feeding")
+    logger.info("fed %d rows to executor %d", rows, executor_id)
+    return [rows]
+
+  return _train
+
+
+def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
+                      qname="input", chunk_size=256):
+  """Inference task: feed one partition, collect its results from the output
+  queue (parity: TFSparkNode.inference, TFSparkNode.py:538-599)."""
+  authkey = cluster_meta["authkey"]
+
+  def _inference(iterator):
+    from tensorflowonspark_tpu.control.marker import EndPartition
+    executor_id = hostinfo.read_executor_id(os.getcwd())
+    hub = _get_hub(cluster_info, executor_id, authkey)
+    queue = hub.get_queue(qname)
+    count = 0
+    chunk = []
+    for item in iterator:
+      chunk.append(item)
+      if len(chunk) >= chunk_size:
+        queue.put_many(chunk, block=True, timeout=feed_timeout)
+        count += len(chunk)
+        chunk = []
+    if chunk:
+      queue.put_many(chunk, block=True, timeout=feed_timeout)
+      count += len(chunk)
+    if count == 0:
+      return []  # empty partitions short-circuit (parity :569-570)
+    queue.put(EndPartition(), block=True, timeout=feed_timeout)
+
+    deadline = time.monotonic() + feed_timeout
+    while not queue.join(timeout=1.0):
+      _check_errors(hub, "inference feeding")
+      if time.monotonic() > deadline:
+        raise TimeoutError("feed timeout (%ds) during inference" % feed_timeout)
+
+    # collect exactly `count` results (parity :588-595)
+    out_q = hub.get_queue("output")
+    results = []
+    while len(results) < count:
+      got = out_q.get_many(count - len(results), timeout=feed_timeout)
+      if not got:
+        _check_errors(hub, "inference collection")
+        if time.monotonic() > deadline:
+          raise TimeoutError("timed out collecting inference results")
+        continue
+      results.extend(got)
+      out_q.task_done(len(got))
+    return results
+
+  return _inference
+
+
+def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
+                     queues=("input",)):
+  """Shutdown task: send end-of-feed, await node exit, surface late errors
+  (parity: TFSparkNode.shutdown, TFSparkNode.py:602-656)."""
+  authkey = cluster_meta["authkey"]
+
+  def _shutdown(iterator):
+    for _ in iterator:
+      pass
+    executor_id = hostinfo.read_executor_id(os.getcwd())
+    hub = _get_hub(cluster_info, executor_id, authkey)
+
+    # kill TensorBoard if we started one (parity :619-625)
+    tb_pid = hub.get("tb_pid")
+    if tb_pid:
+      try:
+        os.kill(int(tb_pid), 15)
+      except OSError:
+        pass
+
+    for qname in queues:
+      hub.get_queue(qname).put(None, block=True, timeout=60)
+
+    # wait for the node process to finish (state -> stopped)
+    deadline = time.monotonic() + max(grace_secs, 0) + 600
+    while hub.get("state") not in ("stopped",):
+      if time.monotonic() > deadline:
+        raise TimeoutError("node on executor %d did not stop" % executor_id)
+      time.sleep(0.5)
+    if grace_secs:
+      time.sleep(grace_secs)
+
+    # late-error propagation with peek-and-put-back (parity :644-650)
+    eq = hub.get_queue("error")
+    errs = eq.get_many(16, block=False)
+    if errs:
+      eq.put_many(errs)
+      raise RuntimeError("worker error:\n%s" % "\n".join(str(e) for e in errs))
+    return [executor_id]
+
+  return _shutdown

@@ -7,7 +7,14 @@ by splitting the CPU into 8 virtual XLA devices. Must run before jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# CRITICAL for this container: a sitecustomize hook registers a remote-TPU
+# PJRT plugin whenever PALLAS_AXON_POOL_IPS is set, and xla_bridge initializes
+# it even under JAX_PLATFORMS=cpu — every test process would then dial the
+# single remote TPU for a claim (hanging, and wedging the claim service under
+# concurrency). Tests are CPU-only: drop the trigger before any jax import;
+# child processes inherit this environment.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
   os.environ["XLA_FLAGS"] = (

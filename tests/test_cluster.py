@@ -1,0 +1,205 @@
+"""L2'+L3' integration tests: full cluster lifecycle on the LocalEngine.
+
+Port of the reference's distributed-integration tier
+(reference tests/test_TFCluster.py, run on a 2-worker Spark standalone
+cluster): independent single-node computations (:16-27), ENGINE-mode
+inference round-trip sum(x^2) (:29-48), exception during feeding (:50-68),
+late exception after feeding with grace_secs (:70-91), port
+release/unrelease semantics (:93-121); plus ps/evaluator lifecycle.
+"""
+
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import cluster as tos_cluster
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.engine import LocalEngine
+
+
+@pytest.fixture()
+def engine():
+  e = LocalEngine(num_executors=2)
+  yield e
+  e.stop()
+
+
+def test_independent_jax_nodes(engine):
+  """Each node runs a small real JAX computation (parity :16-27)."""
+
+  def main_fn(args, ctx):
+    import jax.numpy as jnp
+    result = float(jnp.square(jnp.arange(4)).sum())  # 0+1+4+9
+    with open("result.txt", "w") as f:
+      f.write("%d:%s:%f" % (ctx.executor_id, ctx.job_name, result))
+
+  c = tos_cluster.run(engine, main_fn, tf_args=None,
+                      input_mode=InputMode.FILES, reservation_timeout=30)
+  c.shutdown(timeout=120)
+
+  for slot in range(2):
+    path = os.path.join(engine.executor_workdir(slot), "result.txt")
+    assert os.path.exists(path)
+    eid, job, val = open(path).read().split(":")
+    assert job == "worker"
+    assert float(val) == 14.0
+
+
+def test_cluster_spec_and_roles(engine):
+  def main_fn(args, ctx):
+    with open("spec.txt", "w") as f:
+      f.write("%s|%d|%d|%d" % (ctx.job_name, ctx.task_index,
+                               ctx.num_processes, ctx.process_id))
+
+  c = tos_cluster.run(engine, main_fn, master_node="chief",
+                      input_mode=InputMode.FILES, reservation_timeout=30)
+  assert len(c.cluster_info) == 2
+  spec_jobs = sorted(n["job_name"] for n in c.cluster_info)
+  assert spec_jobs == ["chief", "worker"]
+  c.shutdown(timeout=120)
+
+  specs = sorted(open(os.path.join(engine.executor_workdir(s), "spec.txt"))
+                 .read() for s in range(2))
+  assert specs == ["chief|0|2|0", "worker|0|2|1"]
+
+
+def test_inference_roundtrip_sum_squares(engine):
+  """ENGINE-mode inference over 200 rows in 10 partitions (parity :29-48)."""
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+      batch = feed.next_batch(32)
+      if batch:
+        feed.batch_results([x * x for x in batch])
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  data = list(range(200))
+  partitions = [data[i::10] for i in range(10)]
+  results = c.inference(partitions, feed_timeout=60)
+  c.shutdown(timeout=120)
+  assert len(results) == 200
+  assert sum(results) == sum(x * x for x in data)
+
+
+def test_train_feed_and_shutdown(engine):
+  """ENGINE-mode training feed: every row reaches some worker exactly once."""
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+      for x in feed.next_batch(16):
+        total += x
+    with open("total.txt", "w") as f:
+      f.write(str(total))
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  partitions = [[1] * 10, [2] * 10, [3] * 10, [4] * 10]
+  c.train(partitions, num_epochs=2, feed_timeout=60)
+  c.shutdown(timeout=120)
+
+  grand = 0
+  for slot in range(2):
+    path = os.path.join(engine.executor_workdir(slot), "total.txt")
+    grand += int(open(path).read())
+  assert grand == 2 * (10 + 20 + 30 + 40)
+
+
+def test_exception_during_feeding(engine):
+  """A worker failing mid-feed must fail the train job (parity :50-68)."""
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    feed.next_batch(1)
+    raise RuntimeError("intentional worker failure")
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  with pytest.raises((RuntimeError, TimeoutError),
+                     match="worker error|feed timeout"):
+    c.train([[1] * 50 for _ in range(4)], feed_timeout=15)
+  with pytest.raises(RuntimeError):
+    c.shutdown(timeout=120)
+
+
+def test_late_exception_after_feeding(engine):
+  """An error after feeding completes must surface at shutdown with
+  grace_secs (parity :70-91)."""
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+      feed.next_batch(16)
+    raise RuntimeError("intentional late failure")
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  c.train([[1] * 5, [2] * 5], feed_timeout=60)
+  with pytest.raises(RuntimeError, match="late failure|worker error"):
+    c.shutdown(grace_secs=1, timeout=120)
+
+
+def test_port_reservation_semantics(engine):
+  """release_port=False keeps the node port reserved until user code releases
+  it (parity :93-121)."""
+
+  def main_fn(args, ctx):
+    import socket
+    assert ctx.tmp_socket is not None
+    port = ctx.tmp_socket.getsockname()[1]
+    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    bind_failed = False
+    try:
+      probe.bind(("", port))
+    except OSError:
+      bind_failed = True
+    finally:
+      probe.close()
+    ctx.release_port()
+    probe2 = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    probe2.bind(("", port))  # must succeed now
+    probe2.close()
+    with open("ports.txt", "w") as f:
+      f.write(str(bind_failed))
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.FILES,
+                      release_port=False, reservation_timeout=30)
+  c.shutdown(timeout=120)
+  for slot in range(2):
+    content = open(os.path.join(engine.executor_workdir(slot),
+                                "ports.txt")).read()
+    assert content == "True"
+
+
+def test_ps_evaluator_lifecycle():
+  """ps + evaluator sidecars park on the control queue and stop on driver
+  signal (parity: TFSparkNode.py:441-458, TFCluster.py:186-194)."""
+  engine = LocalEngine(num_executors=3)
+  try:
+    def main_fn(args, ctx):
+      with open("role.txt", "w") as f:
+        f.write("%s:%d" % (ctx.job_name, ctx.task_index))
+
+    c = tos_cluster.run(engine, main_fn, num_ps=1, eval_node=True,
+                        input_mode=InputMode.FILES, reservation_timeout=30)
+    jobs = sorted(n["job_name"] for n in c.cluster_info)
+    assert jobs == ["evaluator", "ps", "worker"]
+    c.shutdown(timeout=120)
+    roles = set()
+    for slot in range(3):
+      roles.add(open(os.path.join(engine.executor_workdir(slot),
+                                  "role.txt")).read().split(":")[0])
+    assert roles == {"ps", "evaluator", "worker"}
+  finally:
+    engine.stop()
+
+
+def test_validation_errors(engine):
+  with pytest.raises(AssertionError, match="at least one worker"):
+    tos_cluster.run(engine, lambda a, c: None, num_ps=2,
+                    input_mode=InputMode.FILES)
+  with pytest.raises(ValueError, match="executors"):
+    tos_cluster.run(engine, lambda a, c: None, num_executors=5)

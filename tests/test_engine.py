@@ -1,0 +1,111 @@
+"""LocalEngine tests: Spark-parity scheduling semantics with real processes.
+
+Covers the engine contract the cluster layer depends on: one task per
+executor for run_on_executors, busy executors excluded from shared
+scheduling, error propagation with tracebacks, barrier gang semantics
+(parity: reference tests/test_TFParallel.py:16-51).
+"""
+
+import os
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.engine import LocalEngine
+
+
+def _slot_and_pid(it):
+  consumed = list(it)
+  return (consumed, os.environ["TOS_EXECUTOR_SLOT"], os.getpid())
+
+
+def _square_sum(it):
+  return [sum(x * x for x in it)]
+
+
+def _boom(it):
+  list(it)
+  raise ValueError("deliberate failure for testing")
+
+
+def _sleep_then_slot(it):
+  list(it)
+  time.sleep(1.0)
+  return os.environ["TOS_EXECUTOR_SLOT"]
+
+
+def _barrier_fn(it, ctx):
+  task_id = list(it)[0]
+  infos = ctx.get_task_infos()
+  ctx.barrier()
+  return (task_id, len(infos))
+
+
+class TestLocalEngine:
+  @pytest.fixture(scope="class")
+  def engine(self):
+    e = LocalEngine(num_executors=2)
+    yield e
+    e.stop()
+
+  def test_run_on_executors_distinct_processes(self, engine):
+    results = engine.run_on_executors(_slot_and_pid).wait(timeout=30)
+    slots = sorted(r[1] for r in results)
+    pids = {r[2] for r in results}
+    assert slots == ["0", "1"]
+    assert len(pids) == 2            # real separate processes
+    assert os.getpid() not in pids
+    assert [r[0] for r in sorted(results)] == [[0], [1]]
+
+  def test_map_partitions_collects(self, engine):
+    parts = [[1, 2], [3], [4, 5, 6]]
+    got = engine.map_partitions(parts, _square_sum, timeout=30)
+    assert sorted(got) == [5, 9, 77]
+
+  def test_error_propagates_with_traceback(self, engine):
+    job = engine.foreach_partition([[1], [2]], _boom)
+    with pytest.raises(RuntimeError, match="deliberate failure"):
+      job.wait(timeout=30)
+    assert "ValueError" in job.first_error()
+
+  def test_busy_executor_excluded_from_shared_tasks(self, engine):
+    # pin a slow task onto each executor, then queue shared work; shared
+    # tasks must wait for a free executor, not interleave
+    slow = engine.run_on_executors(_sleep_then_slot, num_tasks=1)
+    t0 = time.time()
+    got = engine.map_partitions([[1]], _square_sum, timeout=30)
+    assert got == [1]
+    slow.wait(timeout=30)
+    assert time.time() - t0 < 5
+
+  def test_executor_workdirs_isolated(self, engine):
+    def write_marker(it):
+      i = list(it)[0]
+      with open("marker.txt", "w") as f:
+        f.write(str(i))
+      return os.getcwd()
+
+    dirs = engine.run_on_executors(write_marker).wait(timeout=30)
+    assert len(set(dirs)) == 2
+    for d in dirs:
+      assert os.path.exists(os.path.join(d, "marker.txt"))
+
+  def test_barrier_run(self, engine):
+    got = engine.barrier_run(_barrier_fn, num_tasks=2, timeout=60)
+    assert sorted(got) == [(0, 2), (1, 2)]
+
+  def test_barrier_oversubscription_raises(self, engine):
+    with pytest.raises(ValueError, match="barrier gang"):
+      engine.barrier_run(_barrier_fn, num_tasks=5)
+
+  def test_run_on_executors_too_many_tasks_raises(self, engine):
+    with pytest.raises(ValueError, match="executors"):
+      engine.run_on_executors(_slot_and_pid, num_tasks=3)
+
+  def test_generator_results_materialized(self, engine):
+    def gen_fn(it):
+      for x in it:
+        yield x + 100
+
+    got = engine.map_partitions([[1, 2]], gen_fn, timeout=30)
+    assert got == [101, 102]
