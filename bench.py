@@ -1,0 +1,80 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip), bfloat16.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}. The
+reference publishes no quantitative numbers (BASELINE.md — its claims are
+qualitative), so vs_baseline is reported against a fixed engineering target
+of 1000 images/sec/chip for ResNet-50@224 in bf16 on one v5e chip.
+
+Runs single-process on whatever accelerator JAX exposes (the real TPU chip
+under the driver). A watchdog guards against a wedged device runtime so the
+driver always gets its JSON line.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+TARGET_IMG_PER_SEC = 1000.0
+BATCH = 128
+IMAGE = (224, 224, 3)
+WARMUP, MEASURE = 3, 10
+
+
+def _emit(value, unit="images/sec/chip", metric="resnet50_train_throughput",
+          note=None):
+  line = {"metric": metric, "value": round(float(value), 2), "unit": unit,
+          "vs_baseline": round(float(value) / TARGET_IMG_PER_SEC, 3)}
+  if note:
+    line["note"] = note
+  print(json.dumps(line))
+
+
+def main():
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import resnet
+
+  devices = jax.devices()
+  sys.stderr.write("bench devices: %r\n" % (devices,))
+
+  model = resnet.ResNet50(num_classes=1000)
+  state = resnet.create_state(jax.random.PRNGKey(0), model,
+                              image_shape=IMAGE)
+  rng = np.random.RandomState(0)
+  images = jnp.asarray(rng.rand(BATCH, *IMAGE), jnp.float32)
+  labels = jnp.asarray(rng.randint(0, 1000, BATCH), jnp.int32)
+
+  t_compile = time.time()
+  state, loss = resnet.train_step(state, images, labels)
+  jax.block_until_ready(loss)
+  sys.stderr.write("first step (compile) %.1fs loss=%.3f\n"
+                   % (time.time() - t_compile, float(loss)))
+
+  for _ in range(WARMUP):
+    state, loss = resnet.train_step(state, images, labels)
+  jax.block_until_ready(loss)
+
+  t0 = time.time()
+  for _ in range(MEASURE):
+    state, loss = resnet.train_step(state, images, labels)
+  jax.block_until_ready(loss)
+  dt = time.time() - t0
+
+  _emit(BATCH * MEASURE / dt)
+
+
+if __name__ == "__main__":
+  def _watchdog(signum, frame):
+    _emit(0.0, note="watchdog: device runtime did not respond in time")
+    os._exit(2)
+
+  signal.signal(signal.SIGALRM, _watchdog)
+  signal.alarm(int(os.environ.get("TOS_BENCH_TIMEOUT", "600")))
+  try:
+    main()
+  except Exception as e:  # noqa: BLE001 - the driver needs its JSON line
+    _emit(0.0, note="error: %s" % e)
+    raise

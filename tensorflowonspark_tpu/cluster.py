@@ -243,7 +243,16 @@ def run(engine: Engine, main_fn, tf_args=None,
   node_job = engine.run_on_executors(node_fn, num_tasks=num_executors)
 
   def _watch_job():
-    node_job.wait(raise_on_error=False)
+    # poll: a single failed bring-up task must surface its traceback
+    # immediately (aborting await_reservations), not after the surviving
+    # tasks run out their reservation timeout
+    import time as _time
+    while not node_job.done():
+      err = node_job.first_error()
+      if err:
+        tf_status["error"] = err
+        return
+      _time.sleep(0.25)
     err = node_job.first_error()
     if err:
       tf_status["error"] = err

@@ -90,7 +90,12 @@ class SparkEngine(Engine):
 
   def map_partitions(self, partitions, fn, timeout=None) -> List:
     rdd = self._as_rdd(partitions)
-    return rdd.mapPartitions(fn).collect()
+    if timeout is None:
+      return rdd.mapPartitions(fn).collect()
+    # honor the bound like LocalEngine: run the collect on a worker thread
+    # and fail if it exceeds the timeout
+    job = self._async_job(lambda: [rdd.mapPartitions(fn).collect()], 1)
+    return job.wait(timeout=timeout)[0]
 
   def barrier_run(self, fn, num_tasks: Optional[int] = None,
                   timeout: Optional[float] = None) -> List:

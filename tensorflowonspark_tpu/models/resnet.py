@@ -1,0 +1,164 @@
+"""ResNet family: ResNet-50 (ImageNet) and CIFAR ResNet-56.
+
+Capability parity with the reference's ResNet-CIFAR example
+(/root/reference/examples/resnet/resnet_cifar_dist.py, which wraps the
+upstream tensorflow/models Keras ResNet-56), built TPU-first:
+
+- bfloat16 conv/matmul compute, float32 params and batch-norm statistics;
+- channels-last NHWC layout (TPU-native);
+- fused jitted train step; batch-norm running stats carried in a flax
+  ``batch_stats`` collection and updated inside the step (cross-device sync
+  via ``axis_name`` is unnecessary under GSPMD data sharding — XLA inserts
+  the reductions for the batch dimension automatically).
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+from flax.training import train_state
+
+
+class TrainStateBN(train_state.TrainState):
+  batch_stats: Any = None
+
+
+class BottleneckBlock(nn.Module):
+  filters: int
+  strides: Tuple[int, int] = (1, 1)
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x, train: bool = False):
+    conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+    norm = partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+    residual = x
+    y = conv(self.filters, (1, 1), name="conv1")(x)
+    y = norm(name="bn1")(y)
+    y = nn.relu(y)
+    y = conv(self.filters, (3, 3), self.strides, name="conv2")(y)
+    y = norm(name="bn2")(y)
+    y = nn.relu(y)
+    y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+    y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+    if residual.shape != y.shape:
+      residual = conv(self.filters * 4, (1, 1), self.strides,
+                      name="proj")(residual)
+      residual = norm(name="bn_proj")(residual)
+    return nn.relu(residual + y.astype(residual.dtype))
+
+
+class BasicBlock(nn.Module):
+  filters: int
+  strides: Tuple[int, int] = (1, 1)
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x, train: bool = False):
+    conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+    norm = partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+    residual = x
+    y = conv(self.filters, (3, 3), self.strides, name="conv1")(x)
+    y = norm(name="bn1")(y)
+    y = nn.relu(y)
+    y = conv(self.filters, (3, 3), name="conv2")(y)
+    y = norm(name="bn2", scale_init=nn.initializers.zeros)(y)
+    if residual.shape != y.shape:
+      residual = conv(self.filters, (1, 1), self.strides,
+                      name="proj")(residual)
+      residual = norm(name="bn_proj")(residual)
+    return nn.relu(residual + y.astype(residual.dtype))
+
+
+class ResNet(nn.Module):
+  """Generic ResNet over NHWC inputs."""
+  stage_sizes: Sequence[int]
+  block_cls: Callable = BottleneckBlock
+  num_classes: int = 1000
+  num_filters: int = 64
+  stem: str = "imagenet"       # "imagenet" (7x7/2 + pool) or "cifar" (3x3)
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x, train: bool = False):
+    conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+    norm = partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+    x = x.astype(self.dtype)
+    if self.stem == "imagenet":
+      x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+               name="stem_conv")(x)
+      x = norm(name="stem_bn")(x)
+      x = nn.relu(x)
+      x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+    else:
+      x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
+      x = norm(name="stem_bn")(x)
+      x = nn.relu(x)
+
+    for i, n_blocks in enumerate(self.stage_sizes):
+      for j in range(n_blocks):
+        strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+        x = self.block_cls(self.num_filters * 2 ** i, strides,
+                           dtype=self.dtype,
+                           name="stage%d_block%d" % (i, j))(x, train=train)
+
+    x = jnp.mean(x, axis=(1, 2))
+    x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+    return x
+
+
+def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+  return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                num_classes=num_classes, dtype=dtype)
+
+
+def ResNet56CIFAR(num_classes: int = 10, dtype=jnp.bfloat16) -> ResNet:
+  """The reference example's model scale (ResNet-56 for CIFAR-10)."""
+  return ResNet(stage_sizes=(9, 9, 9), block_cls=BasicBlock,
+                num_classes=num_classes, num_filters=16, stem="cifar",
+                dtype=dtype)
+
+
+def create_state(rng, model: ResNet, image_shape=(224, 224, 3),
+                 learning_rate: float = 0.1, momentum: float = 0.9):
+  variables = model.init(rng, jnp.zeros((1,) + tuple(image_shape),
+                                        jnp.float32), train=False)
+  tx = optax.sgd(learning_rate, momentum=momentum, nesterov=True)
+  return TrainStateBN.create(
+      apply_fn=model.apply, params=variables["params"], tx=tx,
+      batch_stats=variables.get("batch_stats", {}))
+
+
+@jax.jit
+def train_step(state: TrainStateBN, images, labels):
+  """Fused forward+backward+SGD step with batch-stats update."""
+
+  def _loss(params):
+    logits, mutated = state.apply_fn(
+        {"params": params, "batch_stats": state.batch_stats},
+        images, train=True, mutable=["batch_stats"])
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+    return loss, mutated["batch_stats"]
+
+  (loss, new_stats), grads = jax.value_and_grad(_loss, has_aux=True)(
+      state.params)
+  state = state.apply_gradients(grads=grads)
+  return state.replace(batch_stats=new_stats), loss
+
+
+@jax.jit
+def eval_step(state: TrainStateBN, images, labels):
+  logits = state.apply_fn(
+      {"params": state.params, "batch_stats": state.batch_stats},
+      images, train=False)
+  loss = optax.softmax_cross_entropy_with_integer_labels(
+      logits, labels).mean()
+  acc = (jnp.argmax(logits, -1) == labels).mean()
+  return loss, acc

@@ -1,0 +1,199 @@
+"""Decoder-only Transformer: the long-context flagship model family.
+
+The reference had no attention model at all (SURVEY.md §5); this family is
+the showcase for the framework's TPU-native parallelism: tensor parallelism
+(megatron-style column/row sharding via flax logical axes), FSDP parameter
+sharding, and sequence parallelism through ring attention
+(parallel/ring_attention.py). bfloat16 compute / float32 params+softmax,
+rotary position embeddings, remat-friendly block structure.
+
+Logical axis names map to mesh axes through
+``parallel.sharding.LOGICAL_RULES``:
+  vocab/heads/mlp -> tensor axis, embed -> fsdp axis,
+  batch -> data+fsdp, sequence -> sequence axis.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+from tensorflowonspark_tpu.parallel import ring_attention as ra
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+  vocab_size: int = 32000
+  num_layers: int = 12
+  num_heads: int = 12
+  d_model: int = 768
+  d_ff: int = 3072
+  max_seq_len: int = 2048
+  dtype: Any = jnp.bfloat16
+  remat: bool = True
+  use_ring_attention: bool = False   # set True when seq is mesh-sharded
+
+  @property
+  def head_dim(self) -> int:
+    assert self.d_model % self.num_heads == 0
+    return self.d_model // self.num_heads
+
+
+def _rotary(x, positions):
+  """Rotary position embedding over the last (head_dim) axis."""
+  d = x.shape[-1]
+  half = d // 2
+  freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32)
+                  * (jnp.log(10000.0) / half))
+  angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+  cos = jnp.cos(angles)[:, :, None, :]
+  sin = jnp.sin(angles)[:, :, None, :]
+  x1, x2 = x[..., :half], x[..., half:]
+  return jnp.concatenate(
+      [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+class Attention(nn.Module):
+  cfg: TransformerConfig
+  mesh: Optional[Any] = None
+
+  @nn.compact
+  def __call__(self, x, positions):
+    cfg = self.cfg
+    dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
+        feats, axis=-1, dtype=cfg.dtype, use_bias=False, name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), logical))
+    qkv_shape = (cfg.num_heads, cfg.head_dim)
+    q = dense(qkv_shape, ("embed", "heads", "kv"), "q")(x)
+    k = dense(qkv_shape, ("embed", "heads", "kv"), "k")(x)
+    v = dense(qkv_shape, ("embed", "heads", "kv"), "v")(x)
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+
+    if cfg.use_ring_attention and self.mesh is not None:
+      out = ra.ring_attention(q, k, v, self.mesh, causal=True)
+    else:
+      out = ra.full_attention(q, k, v, causal=True)
+
+    out = nn.DenseGeneral(
+        cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, use_bias=False,
+        name="out",
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), ("heads", "kv", "embed")))(out)
+    return out
+
+
+class MLPBlock(nn.Module):
+  cfg: TransformerConfig
+
+  @nn.compact
+  def __call__(self, x):
+    cfg = self.cfg
+    h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
+                 kernel_init=nn.with_logical_partitioning(
+                     nn.initializers.lecun_normal(), ("embed", "mlp")))(x)
+    h = nn.gelu(h)
+    return nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False,
+                    name="down",
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.lecun_normal(), ("mlp", "embed")))(h)
+
+
+class Block(nn.Module):
+  cfg: TransformerConfig
+  mesh: Optional[Any] = None
+
+  @nn.compact
+  def __call__(self, x, positions):
+    cfg = self.cfg
+    y = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln1")(x)
+    x = x + Attention(cfg, self.mesh, name="attn")(y, positions)
+    y = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln2")(x)
+    x = x + MLPBlock(cfg, name="mlp")(y)
+    return nn.with_logical_constraint(x, ("batch", "sequence", "embed"))
+
+
+class Transformer(nn.Module):
+  """Causal LM. Input: int32 token ids [batch, seq]; output: logits."""
+  cfg: TransformerConfig
+  mesh: Optional[Any] = None
+
+  @nn.compact
+  def __call__(self, tokens):
+    cfg = self.cfg
+    positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+    emb = nn.Embed(
+        cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed",
+        embedding_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")))
+    x = emb(tokens)
+    x = nn.with_logical_constraint(x, ("batch", "sequence", "embed"))
+
+    block = Block
+    if cfg.remat:
+      block = nn.remat(Block, static_argnums=())
+    for i in range(cfg.num_layers):
+      x = block(cfg, self.mesh, name="layer_%d" % i)(x, positions)
+
+    x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f")(x)
+    # tied output projection (attend to the embedding table)
+    logits = emb.attend(x.astype(cfg.dtype))
+    return logits.astype(jnp.float32)
+
+
+def causal_lm_loss(logits, tokens):
+  """Next-token cross-entropy (shifted); ignores the final position."""
+  import optax
+  targets = tokens[:, 1:]
+  logits = logits[:, :-1]
+  return optax.softmax_cross_entropy_with_integer_labels(
+      logits, targets).mean()
+
+
+def _init_fns(rng, cfg: TransformerConfig, mesh, learning_rate, seq_len,
+              init_batch: int = 1):
+  """(params_init_fn, make_state_fn) pair for parallel.sharding init."""
+  import optax
+  from flax.training import train_state
+
+  model = Transformer(cfg, mesh)
+  tokens = jnp.zeros((init_batch, seq_len), jnp.int32)
+
+  def params_init():
+    return model.init(rng, tokens)["params"]  # Partitioned-boxed
+
+  def make_state(params):
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+    return train_state.TrainState.create(apply_fn=model.apply,
+                                         params=params, tx=tx)
+
+  return params_init, make_state
+
+
+def create_state(rng, cfg: TransformerConfig,
+                 learning_rate: float = 3e-4, seq_len: int = 128):
+  """Single-device TrainState (params unboxed, unsharded)."""
+  from flax.core import meta
+  params_init, make_state = _init_fns(rng, cfg, None, learning_rate, seq_len)
+  return make_state(meta.unbox(params_init()))
+
+
+def create_sharded_state(rng, cfg: TransformerConfig, mesh,
+                         learning_rate: float = 3e-4, seq_len: int = 128):
+  """TrainState initialized directly onto the mesh (TP/FSDP layouts applied
+  at init — large models never materialize replicated).
+
+  Returns (state, state_sharding).
+  """
+  from tensorflowonspark_tpu.parallel import sharding as sh
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  # the init trace must itself be shardable: batch covers the data axes
+  init_batch = mesh_lib.axis_size(mesh, mesh_lib.AXIS_DATA,
+                                  mesh_lib.AXIS_FSDP)
+  params_init, make_state = _init_fns(rng, cfg, mesh, learning_rate, seq_len,
+                                      init_batch=init_batch)
+  return sh.init_sharded_state(params_init, make_state, mesh)

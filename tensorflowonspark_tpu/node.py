@@ -93,9 +93,7 @@ class TPUNodeContext(object):
 
   @property
   def is_chief(self) -> bool:
-    return (self.job_name in ("chief", "master")
-            or (self.job_name == "worker" and self.task_index == 0
-                and not any(r in self.cluster_spec for r in ("chief", "master"))))
+    return is_chief(self.job_name, self.task_index, self.cluster_spec)
 
   def initialize_distributed(self) -> None:
     """Join the JAX process group (TPU analog of TF reading TF_CONFIG).
@@ -112,6 +110,16 @@ class TPUNodeContext(object):
         coordinator_address=self.coordinator_address,
         num_processes=self.num_processes,
         process_id=self.process_id)
+
+
+def is_chief(job_name: str, task_index: int, roles) -> bool:
+  """Chief = the chief/master node, or worker:0 when no chief exists.
+
+  ``roles`` is any container of job names (cluster spec or template).
+  """
+  return (job_name in ("chief", "master")
+          or (job_name == "worker" and task_index == 0
+              and not any(r in roles for r in ("chief", "master"))))
 
 
 def _role_of(executor_id: int, cluster_template: Dict[str, List[int]]):
@@ -221,8 +229,12 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
     authkey = meta["authkey"] if isinstance(meta["authkey"], bytes) \
         else bytes(meta["authkey"])
 
-    # 2. duplicate/stale hub detection (parity :259-265): a live hub in this
-    # working dir means another concurrent node task owns this executor
+    # 2. duplicate/stale hub detection (parity :259-265): a hub in this
+    # working dir that answers with our authkey and reports itself live means
+    # another concurrent node task (same cluster) owns this executor — fail
+    # so the engine retries elsewhere. Anything else (dead socket, stale
+    # 'stopped' hub, or an AuthenticationError from a *previous* cluster's
+    # hub with a different key) is reclaimed, releasing the old manager.
     if os.path.exists(os.path.join(working_dir, HUB_ADDR_FILE)):
       try:
         with open(os.path.join(working_dir, HUB_ADDR_FILE)) as f:
@@ -234,20 +246,14 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
               "executor already runs a live node (hub state=%r); failing this "
               "task so the engine can retry it elsewhere" % state)
         logger.info("found stale hub (state=%r); reclaiming executor", state)
-      except (ConnectionError, OSError):
-        logger.info("found dead hub address file; reclaiming executor")
+      except RuntimeError:
+        raise
+      except Exception as e:  # noqa: BLE001 - dead/foreign hub -> reclaim
+        logger.info("found unreachable/foreign hub (%s); reclaiming executor",
+                    type(e).__name__)
+      feedhub.release(executor_id)
 
-    # 3. TPU chip allocation before any JAX/libtpu init (reference allocated
-    # GPUs via nvidia-smi here, :179-239)
-    num_chips = meta.get("chips_per_node", 0)
-    if num_chips and not os.environ.get("TOS_TPU_TEST_MODE"):
-      topo = tpu_info.get_topology()
-      if topo is not None:
-        workers_per_host = max(1, topo.chips_per_host // num_chips)
-        tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
-            num_chips, executor_id, workers_per_host))
-
-    # 4. start the feed hub; remote mode for driver-reachable roles
+    # 3. start the feed hub; remote mode for driver-reachable roles
     hub_mode = "remote" if job_name in BACKGROUND_ROLES else "local"
     hub = feedhub.start(authkey, meta["queues"], mode=hub_mode,
                         qmax=meta.get("qmax", 1024))
@@ -265,11 +271,8 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
 
     # 6. TensorBoard on chief / worker:0 (parity :292-329)
     tb_info = None
-    if meta.get("tensorboard") and (
-        job_name in ("chief", "master")
-        or (job_name == "worker" and task_index == 0
-            and not any(j in meta["cluster_template"]
-                        for j in ("chief", "master")))):
+    if meta.get("tensorboard") and is_chief(job_name, task_index,
+                                            meta["cluster_template"]):
       log_dir = meta.get("log_dir") or os.path.join(working_dir, "tensorboard")
       os.makedirs(paths.strip_scheme(log_dir), exist_ok=True)
       tb_info = _spawn_tensorboard(paths.strip_scheme(log_dir))
@@ -294,6 +297,23 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
     cluster_info = client.await_reservations(
         timeout=meta.get("reservation_timeout", 600))
     client.close()
+
+    # 7.5 TPU chip allocation (replaces nvidia-smi GPU allocation,
+    # parity :179-239). Runs AFTER reservation so the host-local worker
+    # index comes from the actual host population in cluster_info (parity
+    # with the reference's cluster-spec-derived local index, :386-388) —
+    # executor ids are NOT contiguous per host, so id % workers_per_host
+    # would double-claim chips.
+    num_chips = meta.get("chips_per_node", 0)
+    if num_chips and not os.environ.get("TOS_TPU_TEST_MODE"):
+      topo = tpu_info.get_topology()
+      if topo is not None:
+        cohosted = sorted(n["executor_id"] for n in cluster_info
+                          if n["host"] == host)
+        local_index = cohosted.index(executor_id)
+        workers_per_host = max(1, topo.chips_per_host // num_chips)
+        tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
+            num_chips, local_index, workers_per_host))
 
     # 8. synthesize the cluster spec + JAX process coordinates (the TPU
     # analog of exporting TF_CONFIG, parity :373-384)
@@ -345,7 +365,7 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
         tmp_sock = None
       ctx = TPUNodeContext(hub=hub, tmp_socket=tmp_sock, **ctx_kwargs)
       try:
-        main_fn(tf_args, ctx)
+        cloudpickle.loads(fn_bytes)(tf_args, ctx)
         hub.set("state", "stopped")
       except BaseException:
         tb = traceback.format_exc()

@@ -1,0 +1,27 @@
+"""TPU-native SPMD parallelism.
+
+The reference delegated all distributed math to ``tf.distribute`` strategies
+configured through the TF_CONFIG it synthesized (reference
+TFSparkNode.py:376-384; strategy matrix in SURVEY.md §2.3). This package is
+the TPU-first replacement: explicit device meshes + shardings compiled by
+XLA/GSPMD to collectives over ICI/DCN.
+
+- ``mesh``        — standard mesh axes (data/fsdp/tensor/sequence/pipeline/
+                    expert), device factoring, multi-host awareness
+- ``sharding``    — NamedSharding helpers + train-step factory (the analog of
+                    MultiWorkerMirroredStrategy: sync data parallelism, plus
+                    TP/FSDP the reference never had)
+- ``collectives`` — shard_map-level collective helpers (psum/all_gather/
+                    reduce_scatter/ring permute)
+- ``ring_attention`` — sequence/context parallelism for long sequences
+                    (blockwise online-softmax attention with KV blocks
+                    rotating around the ICI ring)
+- ``pipeline_parallel`` — GPipe-style microbatched stage parallelism
+- ``runner``      — independent-parallel barrier runner (parity:
+                    TFParallel.py)
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec, build_mesh, AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQUENCE,
+    AXIS_PIPELINE, AXIS_EXPERT,
+)

@@ -1,0 +1,54 @@
+"""shard_map-level collective helpers.
+
+The reference's training collectives were TF gRPC ring all-reduce inside
+MultiWorkerMirroredStrategy plus an optional ``grpc+verbs`` RDMA path
+(reference TFNode.py:129-131; SURVEY.md §2.4). The TPU equivalents are XLA
+collectives over ICI/DCN; these helpers wrap the ``jax.lax`` primitives for
+use inside ``shard_map`` sections, keeping axis names consistent with
+``parallel.mesh``.
+"""
+
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum_mean(x, axis_name: str):
+  """All-reduce average over a mesh axis (gradient sync primitive)."""
+  return lax.pmean(x, axis_name)
+
+
+def all_reduce(x, axis_name: str):
+  return lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+  return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+  return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ring_permute(x, axis_name: str, shift: int = 1):
+  """Rotate shards around the mesh-axis ring (neighbor exchange on ICI)."""
+  n = lax.axis_size(axis_name)
+  perm = [(i, (i + shift) % n) for i in range(n)]
+  return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+  """Ulysses-style head/sequence exchange."""
+  return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                        concat_axis=concat_axis, tiled=True)
+
+
+def shard_map_fn(fn: Callable, mesh, in_specs, out_specs,
+                 check_vma: bool = False):
+  """Thin wrapper over jax.shard_map bound to a mesh."""
+  from jax import shard_map
+  return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_vma)

@@ -1,0 +1,106 @@
+"""Device-mesh construction with the framework's standard axis names.
+
+The capability here replaces the reference's cluster-spec-driven strategy
+selection (SURVEY.md §2.3): instead of choosing a tf.distribute strategy, a
+user picks a mesh shape over the named axes below and annotates shardings;
+XLA inserts the collectives.
+
+Axis conventions (orderered outer→inner so that the innermost axes map to
+the fastest ICI loops):
+
+- ``data``      batch sharding (pure DP; gradients all-reduced)
+- ``fsdp``      batch + parameter sharding (ZeRO-style)
+- ``pipeline``  layer-stage sharding
+- ``expert``    MoE expert sharding
+- ``sequence``  sequence/context sharding (ring attention)
+- ``tensor``    within-layer parameter sharding (megatron-style TP)
+"""
+
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_PIPELINE = "pipeline"
+AXIS_EXPERT = "expert"
+AXIS_SEQUENCE = "sequence"
+AXIS_TENSOR = "tensor"
+
+# outer→inner order; tensor innermost (highest-bandwidth neighbor exchanges)
+CANONICAL_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_PIPELINE, AXIS_EXPERT,
+                   AXIS_SEQUENCE, AXIS_TENSOR)
+
+
+@dataclass
+class MeshSpec:
+  """Requested parallelism degrees; -1 on one axis means "absorb the rest"."""
+  data: int = -1
+  fsdp: int = 1
+  pipeline: int = 1
+  expert: int = 1
+  sequence: int = 1
+  tensor: int = 1
+
+  def degrees(self) -> Dict[str, int]:
+    return {AXIS_DATA: self.data, AXIS_FSDP: self.fsdp,
+            AXIS_PIPELINE: self.pipeline, AXIS_EXPERT: self.expert,
+            AXIS_SEQUENCE: self.sequence, AXIS_TENSOR: self.tensor}
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence] = None,
+               axis_names: Optional[Sequence[str]] = None):
+  """Build a ``jax.sharding.Mesh`` over all (or given) devices.
+
+  Exactly one axis may be -1; it absorbs whatever device count remains after
+  the explicit axes divide in. Axes of degree 1 are kept in the mesh so
+  sharding rules can always reference every canonical axis.
+  """
+  import jax
+  from jax.sharding import Mesh
+
+  spec = spec or MeshSpec()
+  devices = list(devices if devices is not None else jax.devices())
+  n = len(devices)
+
+  degrees = spec.degrees()
+  wildcard = [a for a, d in degrees.items() if d == -1]
+  if len(wildcard) > 1:
+    raise ValueError("at most one mesh axis may be -1, got %r" % wildcard)
+  explicit = math.prod(d for d in degrees.values() if d != -1)
+  if wildcard:
+    if n % explicit != 0:
+      raise ValueError(
+          "explicit axes %r use %d-way parallelism which does not divide %d "
+          "devices" % (degrees, explicit, n))
+    degrees[wildcard[0]] = n // explicit
+  elif explicit != n:
+    raise ValueError("mesh %r needs %d devices, have %d"
+                     % (degrees, explicit, n))
+
+  names = tuple(axis_names or CANONICAL_ORDER)
+  shape = tuple(degrees[a] for a in names)
+  mesh_devices = np.asarray(devices).reshape(shape)
+  mesh = Mesh(mesh_devices, names)
+  logger.info("built mesh %s over %d device(s)",
+              dict(zip(names, shape)), n)
+  return mesh
+
+
+def data_axes(mesh) -> tuple:
+  """All axes a data batch is sharded over (data + fsdp)."""
+  return tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh.axis_names)
+
+
+def axis_size(mesh, *axes: str) -> int:
+  size = 1
+  for a in axes:
+    if a in mesh.axis_names:
+      size *= mesh.shape[a]
+  return size

@@ -1,0 +1,128 @@
+"""Ring attention: sequence/context parallelism for long sequences.
+
+A first-class capability of this framework that the reference lacked
+entirely (SURVEY.md §5 "Long-context / sequence parallelism: absent") — on
+TPU it is what makes the ``sequence`` mesh axis real: Q stays resident per
+shard while K/V blocks rotate around the ICI ring (``lax.ppermute``), with a
+numerically-stable online-softmax accumulation so the result is exactly
+full attention over the global sequence.
+
+Compute cost per device: n_steps × block attention; communication overlaps
+with compute because each step's ppermute of the *next* KV block is
+independent of the current block's math (XLA schedules the overlap).
+
+Layout: [batch, seq, heads, head_dim] with seq sharded over the
+``sequence`` axis; inside the shard_map body every ref sees its local
+sequence block.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
+  """One online-softmax accumulation step against a single KV block.
+
+  q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; o: [B, Sq, H, D].
+  Positions are global offsets so causal masking works across shards.
+  """
+  qf = q.astype(jnp.float32)
+  kf = k.astype(jnp.float32)
+  scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale  # [B,H,Sq,Sk]
+
+  if causal:
+    q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (q.shape[1], k.shape[1]), 0)
+    k_pos = kv_offset + lax.broadcasted_iota(jnp.int32, (q.shape[1], k.shape[1]), 1)
+    mask = (k_pos <= q_pos)[None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+
+  m_block = jnp.max(scores, axis=-1)                      # [B,H,Sq]
+  m_new = jnp.maximum(m, m_block)
+  # guard fully-masked rows (m_new == NEG_INF) against NaNs
+  m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+  p = jnp.exp(scores - m_safe[..., None])
+  p = jnp.where(scores <= NEG_INF, 0.0, p)
+  correction = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - m_safe))
+  l_new = l * correction + jnp.sum(p, axis=-1)
+  pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+  o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+  return m_new, l_new, o_new
+
+
+def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
+  """shard_map body: full attention with KV blocks rotating around the ring."""
+  n = lax.axis_size(axis_name)
+  my = lax.axis_index(axis_name)
+  b, s_local, h, d = q.shape
+  scale = 1.0 / (d ** 0.5)
+
+  m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+  l0 = jnp.zeros((b, h, s_local), jnp.float32)
+  o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+  q_offset = my * s_local
+
+  def body(step, carry):
+    k_blk, v_blk, m, l, o = carry
+    src = (my - step) % n                 # whose block we hold this step
+    kv_offset = src * s_local
+    m, l, o = _block_attn(q, k_blk, v_blk, m, l, o, q_offset, kv_offset,
+                          causal, scale)
+    # rotate kv to the next neighbor (ICI ring); last rotation is unused but
+    # keeps the loop shape static for XLA
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_blk = lax.ppermute(k_blk, axis_name, perm)
+    v_blk = lax.ppermute(v_blk, axis_name, perm)
+    return k_blk, v_blk, m, l, o
+
+  _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+  l = jnp.where(l == 0.0, 1.0, l)         # fully-masked rows -> zeros
+  out = o / l.transpose(0, 2, 1)[..., None]
+  return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, causal: bool = True,
+                   axis_name: str = mesh_lib.AXIS_SEQUENCE,
+                   batch_axes=None):
+  """Exact full attention over a sequence sharded across ``axis_name``.
+
+  Args:
+    q, k, v: [batch, seq, heads, head_dim], seq sharded over ``axis_name``.
+    mesh: the device mesh.
+    causal: apply a global causal mask.
+    batch_axes: mesh axes dim 0 is sharded over (defaults to data+fsdp).
+
+  Returns attention output with the same sharding as ``q``.
+  """
+  from jax import shard_map
+
+  batch_axes = batch_axes if batch_axes is not None else \
+      mesh_lib.data_axes(mesh)
+  spec = P(batch_axes or None, axis_name, mesh_lib.AXIS_TENSOR
+           if mesh_lib.AXIS_TENSOR in mesh.axis_names else None, None)
+  fn = functools.partial(_ring_attn_local, axis_name=axis_name,
+                         causal=causal)
+  return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)(q, k, v)
+
+
+def full_attention(q, k, v, causal: bool = True):
+  """Single-device reference implementation (for tests and small models)."""
+  b, s, h, d = q.shape
+  scale = 1.0 / (d ** 0.5)
+  scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                      k.astype(jnp.float32)) * scale
+  if causal:
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+  probs = jax.nn.softmax(scores, axis=-1)
+  out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+  return out.astype(q.dtype)

@@ -15,6 +15,14 @@ import os
 # child processes inherit this environment.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The plugin's register() (already executed by sitecustomize in THIS
+# process) force-sets jax.config jax_platforms="axon,cpu", overriding the
+# env var — undo that so in-process jax stays CPU-only too.
+try:
+  import jax
+  jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 - no jax yet means nothing to undo
+  pass
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
   os.environ["XLA_FLAGS"] = (

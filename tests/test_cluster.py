@@ -197,6 +197,47 @@ def test_ps_evaluator_lifecycle():
     engine.stop()
 
 
+def test_engine_reuse_two_clusters(engine):
+  """A second cluster on the same engine must reclaim the previous run's
+  stale hubs (different authkey) instead of failing bring-up."""
+
+  def main_fn(args, ctx):
+    with open("gen.txt", "a") as f:
+      f.write("x")
+
+  for generation in range(2):
+    c = tos_cluster.run(engine, main_fn, input_mode=InputMode.FILES,
+                        reservation_timeout=30)
+    c.shutdown(timeout=120)
+  for slot in range(2):
+    content = open(os.path.join(engine.executor_workdir(slot),
+                                "gen.txt")).read()
+    assert content == "xx"
+
+
+def test_early_bringup_failure_surfaces_fast():
+  """A node failing before registration must abort run() with its traceback
+  well before the reservation timeout."""
+  import time
+
+  def main_fn(args, ctx):
+    pass
+
+  # sabotage node bring-up inside the executors: the pinned node port is
+  # unparseable, so every node task raises before registering
+  bad = LocalEngine(num_executors=2,
+                    env={"TOS_TPU_NODE_PORT": "notaport"})
+  try:
+    t0 = time.time()
+    with pytest.raises(RuntimeError,
+                       match="(?s)cluster startup aborted.*notaport"):
+      tos_cluster.run(bad, main_fn, input_mode=InputMode.FILES,
+                      reservation_timeout=300)
+    assert time.time() - t0 < 60
+  finally:
+    bad.stop()
+
+
 def test_validation_errors(engine):
   with pytest.raises(AssertionError, match="at least one worker"):
     tos_cluster.run(engine, lambda a, c: None, num_ps=2,
