@@ -1,0 +1,16 @@
+"""Data interop: TFRecord codec, tf.Example wire codec, schema tools.
+
+Replaces the reference's dfutil.py + the tensorflow-hadoop jar + the Scala
+DFUtil/SimpleTypeParser layer (SURVEY.md §2.2) with a JVM-free stack:
+a native C++ record codec (masked CRC32C framing), a dependency-free
+protobuf wire codec for ``tf.train.Example``, schema inference with
+binary/type hints, and a ``struct<name:type,...>`` hint-string parser.
+"""
+
+from tensorflowonspark_tpu.data.tfrecord import (  # noqa: F401
+    TFRecordReader, TFRecordWriter, native_available,
+)
+from tensorflowonspark_tpu.data.example_codec import (  # noqa: F401
+    encode_example, decode_example,
+)
+from tensorflowonspark_tpu.data.schema import parse_schema  # noqa: F401

@@ -72,9 +72,11 @@ class SparkEngine(Engine):
     n = num_tasks if num_tasks is not None else self._num_executors
     rdd = self.sc.parallelize(range(n), n)
 
+    def _wrap(it):
+      yield fn(it)  # preserve per-task return values (LocalEngine parity)
+
     def runner():
-      rdd.foreachPartition(fn)
-      return [None] * n
+      return rdd.mapPartitions(_wrap).collect()
 
     return self._async_job(runner, n)
 

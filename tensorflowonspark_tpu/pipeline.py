@@ -1,0 +1,296 @@
+"""L5' ML pipeline: Estimator/Model API over the cluster layer.
+
+Capability parity with the reference's ``pipeline.py``
+(/root/reference/tensorflowonspark/pipeline.py), without requiring Spark ML:
+
+- ``Namespace`` + ``TFParams.merge_args_params`` reproduce the layered
+  config merge (:299-351);
+- the ``Has*`` param mixins exist with the same names and setter/getter
+  surface (:52-296), generated over a lightweight Params base;
+- ``TFEstimator.fit`` launches a real cluster in ENGINE input mode, feeds
+  the dataset sorted by input-mapping columns, shuts down with a grace
+  period, and returns a ``TFModel`` (:354-435);
+- ``TFModel.transform`` runs independent single-node inference per
+  executor with a per-process model cache (:438-647), loading an exported
+  bundle (orbax state + cloudpickled predict fn) instead of a TF
+  SavedModel signature;
+- ``yield_batch`` batches rows for the predict fn (:691-713).
+
+The model artifact is a *bundle* directory:
+  ``<export_dir>/model/``     orbax checkpoint of the params pytree
+  ``<export_dir>/predict.pkl`` cloudpickled ``predict_fn(params, batch)``
+where ``batch`` is a dict of stacked numpy arrays keyed by input tensor
+names, and the fn returns a dict keyed by output tensor names.
+"""
+
+import argparse
+import json
+import logging
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from tensorflowonspark_tpu import cluster as cluster_lib
+from tensorflowonspark_tpu.cluster import InputMode
+
+logger = logging.getLogger(__name__)
+
+
+class Namespace(dict):
+  """argparse-compatible bag of arguments (parity: pipeline.py:299-339).
+
+  Accepts a dict, an ``argparse.Namespace``, a list of argv strings, or
+  another Namespace; attribute and item access are interchangeable.
+  """
+
+  def __init__(self, d=None):
+    super().__init__()
+    if d is None:
+      return
+    if isinstance(d, (list, tuple)):
+      self["argv"] = list(d)
+    elif isinstance(d, argparse.Namespace):
+      self.update(vars(d))
+    elif isinstance(d, dict):
+      self.update(d)
+    else:
+      raise TypeError("unsupported Namespace source: %r" % type(d))
+
+  def __getattr__(self, name):
+    try:
+      return self[name]
+    except KeyError:
+      raise AttributeError(name)
+
+  def __setattr__(self, name, value):
+    self[name] = value
+
+
+# --- lightweight Spark-ML-style Params --------------------------------------
+
+
+class Params(object):
+  """Minimal Params base: declared params become get/set pairs."""
+
+  _params: Dict[str, object]
+
+  def __init__(self):
+    self._params = {}
+
+  def _declare(self, name: str, default=None):
+    self._params.setdefault(name, default)
+
+  def _set(self, **kwargs):
+    for k, v in kwargs.items():
+      self._params[k] = v
+    return self
+
+  def _get(self, name: str):
+    return self._params.get(name)
+
+
+def _mixin(name: str, param: str, default=None, cap: Optional[str] = None):
+  """Build a Has<X> mixin exposing set<X>/get<X> (parity: the ~17 Has*
+  mixins at reference pipeline.py:52-296)."""
+  cap = cap or "".join(p.capitalize() for p in param.split("_"))
+
+  def setter(self, value):
+    self._declare(param, default)
+    return self._set(**{param: value})
+
+  def getter(self):
+    self._declare(param, default)
+    return self._get(param)
+
+  return type(name, (object,), {"set" + cap: setter, "get" + cap: getter,
+                                "_param_name": param,
+                                "_param_default": default})
+
+
+HasBatchSize = _mixin("HasBatchSize", "batch_size", 100)
+HasClusterSize = _mixin("HasClusterSize", "cluster_size", 1)
+HasNumPS = _mixin("HasNumPS", "num_ps", 0, cap="NumPS")
+HasInputMapping = _mixin("HasInputMapping", "input_mapping")
+HasOutputMapping = _mixin("HasOutputMapping", "output_mapping")
+HasInputMode = _mixin("HasInputMode", "input_mode", InputMode.ENGINE)
+HasMasterNode = _mixin("HasMasterNode", "master_node", "chief")
+HasModelDir = _mixin("HasModelDir", "model_dir")
+HasExportDir = _mixin("HasExportDir", "export_dir")
+HasEpochs = _mixin("HasEpochs", "epochs", 1)
+HasGraceSecs = _mixin("HasGraceSecs", "grace_secs", 30)
+HasReservationTimeout = _mixin("HasReservationTimeout",
+                               "reservation_timeout", 600)
+HasFeedTimeout = _mixin("HasFeedTimeout", "feed_timeout", 600)
+HasTensorboard = _mixin("HasTensorboard", "tensorboard", False)
+HasSignatureDefKey = _mixin("HasSignatureDefKey", "signature_def_key",
+                            "serving_default")
+HasChipsPerNode = _mixin("HasChipsPerNode", "chips_per_node", 0)
+HasProtocol = _mixin("HasProtocol", "protocol", "grpc")
+
+
+class TFParams(Params, HasBatchSize, HasClusterSize, HasNumPS,
+               HasInputMapping, HasOutputMapping, HasInputMode,
+               HasMasterNode, HasModelDir, HasExportDir, HasEpochs,
+               HasGraceSecs, HasReservationTimeout, HasFeedTimeout,
+               HasTensorboard, HasSignatureDefKey, HasChipsPerNode,
+               HasProtocol):
+  """All pipeline params (parity: reference TFParams, pipeline.py:342-351)."""
+
+  def merge_args_params(self, args) -> Namespace:
+    """Overlay set params onto a Namespace of args."""
+    merged = Namespace(args)
+    merged.update(self._params)
+    return merged
+
+
+# --- model bundle -----------------------------------------------------------
+
+
+def export_bundle(params, predict_fn, export_dir: str,
+                  is_chief: bool = True) -> str:
+  """Write the model bundle (orbax params + pickled predict fn)."""
+  import cloudpickle
+  from tensorflowonspark_tpu.utils import compat
+
+  target = compat.export_model(params, export_dir, is_chief)
+  with open(os.path.join(target, "predict.pkl"), "wb") as f:
+    cloudpickle.dump(predict_fn, f)
+  return target
+
+
+# per-executor-process bundle cache (parity: pipeline.py:495-499)
+_bundle_cache: Dict[str, tuple] = {}
+
+
+def load_bundle(export_dir: str):
+  """Load (params, predict_fn), cached per process."""
+  import cloudpickle
+  from tensorflowonspark_tpu.utils import compat
+
+  key = os.path.abspath(export_dir)
+  if key not in _bundle_cache:
+    params = compat.import_model(export_dir)
+    with open(os.path.join(export_dir, "predict.pkl"), "rb") as f:
+      predict_fn = cloudpickle.load(f)
+    _bundle_cache[key] = (params, predict_fn)
+    logger.info("loaded model bundle from %s", export_dir)
+  return _bundle_cache[key]
+
+
+def yield_batch(iterable: Iterable, batch_size: int,
+                num_tensors: int = 1):
+  """Group rows into lists-of-columns batches (parity: pipeline.py:691-713).
+
+  Yields lists of ``num_tensors`` column lists.
+  """
+  cols: List[List] = [[] for _ in range(num_tensors)]
+  count = 0
+  for row in iterable:
+    if num_tensors == 1 and not isinstance(row, (tuple, list)):
+      row = (row,)
+    for i in range(num_tensors):
+      cols[i].append(row[i])
+    count += 1
+    if count >= batch_size:
+      yield cols
+      cols = [[] for _ in range(num_tensors)]
+      count = 0
+  if count > 0:
+    yield cols
+
+
+# --- Estimator / Model ------------------------------------------------------
+
+
+class TFEstimator(TFParams):
+  """Trains a model on a cluster and produces a TFModel.
+
+  ``train_fn(args, ctx)`` is the user main function; it should consume the
+  DataFeed and, on the chief, call ``pipeline.export_bundle`` with
+  ``args.export_dir``.
+  """
+
+  def __init__(self, train_fn, tf_args=None, export_fn=None):
+    super().__init__()
+    self.train_fn = train_fn
+    self.tf_args = tf_args if tf_args is not None else {}
+    self.export_fn = export_fn
+
+  def fit(self, engine, partitions: Sequence) -> "TFModel":
+    """Launch a cluster, feed the dataset, return the trained TFModel
+    (parity: TFEstimator._fit, pipeline.py:395-435)."""
+    args = self.merge_args_params(self.tf_args)
+    cluster_size = args.get("cluster_size") or engine.num_executors
+    logger.info("fitting TFEstimator on %d executor(s)", cluster_size)
+
+    input_mode = args.get("input_mode", InputMode.ENGINE)
+    cluster = cluster_lib.run(
+        engine, self.train_fn, tf_args=args,
+        num_executors=cluster_size,
+        num_ps=args.get("num_ps", 0),
+        tensorboard=bool(args.get("tensorboard")),
+        input_mode=input_mode,
+        log_dir=args.get("model_dir"),
+        master_node=args.get("master_node", "chief"),
+        reservation_timeout=args.get("reservation_timeout", 600),
+        chips_per_node=args.get("chips_per_node", 0))
+    if input_mode == InputMode.ENGINE:
+      cluster.train(partitions, num_epochs=args.get("epochs", 1),
+                    feed_timeout=args.get("feed_timeout", 600))
+    # FILES mode: the main fn reads its own data; nothing to feed
+    cluster.shutdown(grace_secs=args.get("grace_secs", 30))
+
+    model = TFModel(self.tf_args)
+    model._params.update(self._params)
+    return model
+
+
+class TFModel(TFParams):
+  """Batch inference with independent per-executor model instances
+  (parity: TFModel, pipeline.py:438-647)."""
+
+  def __init__(self, tf_args=None):
+    super().__init__()
+    self.tf_args = tf_args if tf_args is not None else {}
+
+  def transform(self, engine, partitions: Sequence) -> List:
+    """Run the exported bundle over partitioned rows; returns result rows.
+
+    Rows are tuples ordered by ``sorted(input_mapping)`` columns; outputs
+    are tuples ordered by ``sorted(output_mapping)`` tensor names
+    (column-mapping parity: pipeline.py:463-492).
+    """
+    args = self.merge_args_params(self.tf_args)
+    export_dir = args.get("export_dir") or args.get("model_dir")
+    if not export_dir:
+      raise ValueError("TFModel requires export_dir (or model_dir)")
+    input_mapping = args.get("input_mapping") or {}
+    output_mapping = args.get("output_mapping") or {}
+    batch_size = args.get("batch_size", 100)
+
+    input_tensors = [input_mapping[c] for c in sorted(input_mapping)] \
+        if input_mapping else None
+    output_tensors = sorted(output_mapping) if output_mapping else None
+
+    def _transform_partition(iterator):
+      import numpy as np
+      params, predict_fn = load_bundle(export_dir)
+      results = []
+      n_cols = len(input_tensors) if input_tensors else 1
+      for cols in yield_batch(iterator, batch_size, n_cols):
+        if input_tensors:
+          batch = {name: np.asarray(col)
+                   for name, col in zip(input_tensors, cols)}
+        else:
+          batch = {"input": np.asarray(cols[0])}
+        out = predict_fn(params, batch)
+        if not isinstance(out, dict):
+          out = {"output": out}
+        names = output_tensors or sorted(out)
+        arrays = [np.asarray(out[n]) for n in names]
+        for i in range(len(arrays[0])):
+          row = tuple(a[i].tolist() for a in arrays)
+          results.append(row[0] if len(row) == 1 else row)
+      return results
+
+    return engine.map_partitions(partitions, _transform_partition,
+                                 timeout=args.get("feed_timeout", 600))

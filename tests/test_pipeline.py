@@ -1,0 +1,210 @@
+"""Pipeline tests: Namespace/params merging, full Estimator fit →
+bundle export → Model transform regression, the independent-parallel
+runner, and the inference CLI.
+
+Port of the reference's tests/test_pipeline.py (Namespace merging :48-87;
+the y = 3.14·x1 + 1.618·x2 fit/transform regression :89-172) and
+tests/test_TFParallel.py (:16-51), plus the Scala Inference CLI semantics.
+"""
+
+import argparse
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.engine import LocalEngine
+from tensorflowonspark_tpu import pipeline
+from tensorflowonspark_tpu.pipeline import Namespace, TFEstimator, TFModel
+
+W_TRUE = (3.14, 1.618)
+
+
+class TestNamespace:
+  def test_from_dict_and_attr_access(self):
+    ns = Namespace({"a": 1, "b": "x"})
+    assert ns.a == 1 and ns["b"] == "x"
+    ns.c = 3
+    assert ns["c"] == 3
+
+  def test_from_argparse(self):
+    parsed = argparse.ArgumentParser().parse_args([])
+    parsed.foo = 42
+    assert Namespace(parsed).foo == 42
+
+  def test_from_argv_list(self):
+    assert Namespace(["--lr", "0.1"]).argv == ["--lr", "0.1"]
+
+  def test_merge_args_params(self):
+    est = TFEstimator(lambda a, c: None, {"batch_size": 1, "keep": "yes"})
+    est.setBatchSize(64).setEpochs(3)
+    merged = est.merge_args_params(est.tf_args)
+    assert merged.batch_size == 64      # param overrides arg
+    assert merged.epochs == 3
+    assert merged.keep == "yes"
+
+  def test_param_defaults(self):
+    m = TFModel()
+    assert m.getBatchSize() == 100      # parity: reference default
+    assert m.getMasterNode() == "chief"
+
+
+def linreg_train_fn(args, ctx):
+  """Distributed linear regression on fed data; chief exports the bundle."""
+  import jax
+  import jax.numpy as jnp
+
+  feed = ctx.get_data_feed(train_mode=True,
+                           input_mapping={"features": "x", "label": "y"})
+  w = jnp.zeros((2,))
+  b = jnp.zeros(())
+
+  @jax.jit
+  def step(w, b, x, y):
+    def loss_fn(wb):
+      w_, b_ = wb
+      pred = x @ w_ + b_
+      return jnp.mean((pred - y) ** 2)
+
+    loss, (gw, gb) = jax.value_and_grad(loss_fn)((w, b))
+    return w - 0.1 * gw, b - 0.1 * gb, loss
+
+  while not feed.should_stop():
+    batch = feed.next_batch(32)
+    if not batch["x"]:
+      continue
+    x = jnp.asarray(batch["x"], jnp.float32)
+    y = jnp.asarray(batch["y"], jnp.float32).reshape(-1)
+    for _ in range(10):
+      w, b, loss = step(w, b, x, y)
+
+  if ctx.is_chief:
+    def predict_fn(params, batch):
+      import numpy as np
+      return {"pred": np.asarray(batch["x"], "float32") @ params["w"]
+              + params["b"]}
+
+    pipeline.export_bundle({"w": np.asarray(w), "b": np.asarray(b)},
+                           predict_fn, args["export_dir"],
+                           is_chief=True)
+
+
+def _make_dataset(n=512, seed=0):
+  rng = np.random.RandomState(seed)
+  x = rng.rand(n, 2).astype("float32")
+  y = x @ np.asarray(W_TRUE, "float32")
+  return [( [float(a), float(b)], float(t)) for (a, b), t in zip(x, y)]
+
+
+class TestEstimatorModel:
+  def test_fit_transform_regression(self, tmp_path):
+    """Parity with the reference regression: prediction on [1,1] must be
+    ≈ 3.14 + 1.618 to 2 decimals (reference test_pipeline.py:89-172)."""
+    engine = LocalEngine(num_executors=2)
+    try:
+      export_dir = str(tmp_path / "export")
+      rows = _make_dataset()
+      partitions = [rows[i::4] for i in range(4)]
+
+      est = TFEstimator(linreg_train_fn, {"export_dir": export_dir})
+      est.setEpochs(10).setGraceSecs(1).setReservationTimeout(30)
+      model = est.fit(engine, partitions)
+      assert os.path.exists(os.path.join(export_dir, "predict.pkl"))
+
+      model.setExportDir(export_dir) \
+           .setInputMapping({"features": "x"}) \
+           .setOutputMapping({"pred": "prediction"}) \
+           .setBatchSize(16)
+      test_rows = [([1.0, 1.0],), ([0.0, 0.0],), ([2.0, 0.0],)]
+      preds = model.transform(engine, [test_rows])
+      assert len(preds) == 3
+      np.testing.assert_allclose(preds[0], sum(W_TRUE), atol=0.05)
+      np.testing.assert_allclose(preds[1], 0.0, atol=0.05)
+      np.testing.assert_allclose(preds[2], 2 * W_TRUE[0], atol=0.1)
+    finally:
+      engine.stop()
+
+
+class TestParallelRunner:
+  def test_barrier_run_with_placement(self):
+    from tensorflowonspark_tpu.parallel import runner
+    engine = LocalEngine(num_executors=2)
+    try:
+      def fn(args, ctx):
+        return (ctx.executor_id, len(ctx.cluster_spec["worker"]),
+                os.getpid())
+
+      results = runner.run(engine, fn, num_tasks=2, use_barrier=True,
+                           timeout=60)
+      assert sorted(r[:2] for r in results) == [(0, 2), (1, 2)]
+      assert len({r[2] for r in results}) == 2
+    finally:
+      engine.stop()
+
+  def test_non_barrier_run(self):
+    from tensorflowonspark_tpu.parallel import runner
+    engine = LocalEngine(num_executors=2)
+    try:
+      results = runner.run(engine, lambda a, c: c.executor_id,
+                           num_tasks=2, use_barrier=False, timeout=60)
+      assert sorted(results) == [0, 1]
+    finally:
+      engine.stop()
+
+  def test_barrier_oversubscription_raises(self):
+    from tensorflowonspark_tpu.parallel import runner
+    engine = LocalEngine(num_executors=2)
+    try:
+      with pytest.raises(ValueError, match="barrier gang"):
+        runner.run(engine, lambda a, c: None, num_tasks=4)
+    finally:
+      engine.stop()
+
+
+class TestInferenceCLI:
+  def test_end_to_end(self, tmp_path):
+    from tensorflowonspark_tpu import inference_cli
+    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.data.schema import parse_schema
+
+    # bundle: y = x1 + 10*x2
+    def predict_fn(params, batch):
+      x = np.asarray(batch["x"], "float32")
+      return {"pred": x @ params["w"]}
+
+    export_dir = str(tmp_path / "model")
+    pipeline.export_bundle({"w": np.asarray([1.0, 10.0], "float32")},
+                           predict_fn, export_dir)
+
+    schema = parse_schema("struct<features:array<float>>")
+    rows = [([1.0, 2.0],), ([3.0, 4.0],)]
+    data_dir = str(tmp_path / "data")
+    dfutil.save_as_tfrecords([rows], schema, data_dir)
+
+    out = str(tmp_path / "preds.jsonl")
+    rc = inference_cli.main([
+        "--export_dir", export_dir,
+        "--input", data_dir,
+        "--schema_hint", "struct<features:array<float>>",
+        "--input_mapping", json.dumps({"features": "x"}),
+        "--output_mapping", json.dumps({"pred": "y"}),
+        "--output", out,
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in open(out)]
+    assert [l["y"] for l in lines] == [21.0, 43.0]
+
+  def test_bad_mapping_errors(self, tmp_path):
+    from tensorflowonspark_tpu import inference_cli
+    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.data.schema import parse_schema
+    schema = parse_schema("struct<a:float>")
+    data_dir = str(tmp_path / "d")
+    dfutil.save_as_tfrecords([[(1.0,)]], schema, data_dir)
+    with pytest.raises(SystemExit, match="not in schema"):
+      inference_cli.main([
+          "--export_dir", str(tmp_path), "--input", data_dir,
+          "--schema_hint", "struct<a:float>",
+          "--input_mapping", json.dumps({"nope": "x"}),
+          "--output", str(tmp_path / "o.jsonl")])
