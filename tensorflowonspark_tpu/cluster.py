@@ -52,7 +52,8 @@ class TPUCluster(object):
 
   def __init__(self, engine: Engine, cluster_info: List[dict],
                cluster_meta: dict, server: rendezvous.Server,
-               input_mode: int, node_job, tf_status: dict):
+               input_mode: int, node_job, tf_status: dict,
+               driver_ps_procs: Sequence = ()):
     self.engine = engine
     self.cluster_info = cluster_info
     self.cluster_meta = cluster_meta
@@ -61,6 +62,7 @@ class TPUCluster(object):
     self.node_job = node_job
     self.tf_status = tf_status
     self.queues = cluster_meta["queues"]
+    self.driver_ps_procs = list(driver_ps_procs)
 
   # -- data plane ------------------------------------------------------------
 
@@ -79,6 +81,43 @@ class TPUCluster(object):
     fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
                                 feed_timeout=feed_timeout, qname=qname)
     self.engine.foreach_partition(parts, fn).wait()
+
+  def train_stream(self, batch_stream, feed_timeout: float = 600,
+                   qname: str = "input") -> int:
+    """Feed an unbounded stream of partitioned datasets (micro-batches).
+
+    The analog of the reference's Spark Streaming support
+    (DStream.foreachRDD feeding, TFCluster.py:83-85): each item of
+    ``batch_stream`` is a list of partitions fed as one round. A graceful
+    stop request (``request_stop()``, or a remote
+    ``rendezvous.Client(addr).request_stop()`` — parity with
+    examples/utils/stop_streaming.py) ends the loop after the current
+    round. Returns the number of rounds fed.
+    """
+    assert self.input_mode == InputMode.ENGINE, \
+        "train_stream() requires InputMode.ENGINE/SPARK"
+    rounds = 0
+    for partitions in batch_stream:
+      # feed first, check after: a batch already pulled from the source is
+      # never discarded (sources may commit offsets on yield)
+      self.train(partitions, num_epochs=1, feed_timeout=feed_timeout,
+                 qname=qname)
+      rounds += 1
+      if self.server.done.is_set():
+        logger.info("stop signal received; ending stream after %d rounds",
+                    rounds)
+        break
+    return rounds
+
+  def request_stop(self) -> None:
+    """Signal streaming feeds to stop after the current round."""
+    self.server.done.set()
+
+  @property
+  def server_addr(self):
+    """Rendezvous address — remote processes can send the streaming stop
+    signal here via ``rendezvous.Client(addr).request_stop()``."""
+    return self.server.addr
 
   def inference(self, data_partitions: Sequence, feed_timeout: float = 600,
                 qname: str = "input") -> List:
@@ -139,6 +178,14 @@ class TPUCluster(object):
         logger.warning("failed to stop %s:%d: %s", n["job_name"],
                        n["task_index"], e)
 
+    # driver-hosted ps processes exit once their control queue gets None
+    for p in self.driver_ps_procs:
+      p.join(timeout=60)
+      if p.is_alive():
+        logger.warning("driver ps process %s did not exit; terminating",
+                       p.name)
+        p.terminate()
+
     # wait for the node bring-up job itself (foreground workers return when
     # the user fn finishes); propagate node errors
     self.node_job.wait(raise_on_error=False)
@@ -167,7 +214,8 @@ class TPUCluster(object):
 def run(engine: Engine, main_fn, tf_args=None,
         num_executors: Optional[int] = None, num_ps: int = 0,
         tensorboard: bool = False, input_mode: int = InputMode.FILES,
-        log_dir: Optional[str] = None, master_node: Optional[str] = None,
+        log_dir: Optional[str] = None, driver_ps_nodes: bool = False,
+        master_node: Optional[str] = None,
         reservation_timeout: float = 600,
         queues: Sequence[str] = ("input", "output", "error", "control"),
         eval_node: bool = False, release_port: bool = True,
@@ -177,11 +225,18 @@ def run(engine: Engine, main_fn, tf_args=None,
   Signature parity with the reference's ``TFCluster.run``
   (TFCluster.py:215-245), with the engine abstraction in place of a
   SparkContext and TPU chip allocation in place of GPU counts.
+  ``driver_ps_nodes`` hosts the ps nodes on the driver machine so every
+  engine executor keeps its accelerator for workers (parity :229,298-316;
+  FILES input mode only, like the reference).
   """
   num_executors = num_executors or engine.num_executors
-  if num_executors > engine.num_executors:
+  if driver_ps_nodes and input_mode != InputMode.FILES:
+    raise ValueError("driver_ps_nodes requires InputMode.FILES/TENSORFLOW "
+                     "(parity with the reference)")
+  engine_nodes = num_executors - (num_ps if driver_ps_nodes else 0)
+  if engine_nodes > engine.num_executors:
     raise ValueError("cluster of %d nodes needs %d executors but engine has %d"
-                     % (num_executors, num_executors, engine.num_executors))
+                     % (num_executors, engine_nodes, engine.num_executors))
 
   # role template (parity: TFCluster.py:256-271): ps nodes first, then
   # master/chief, evaluator, workers
@@ -240,15 +295,41 @@ def run(engine: Engine, main_fn, tf_args=None,
   # (b) reservation failures surface through tf_status (parity :318-336)
   tf_status: Dict[str, Optional[str]] = {"error": None}
   node_fn = node_mod.make_node_fn(main_fn, tf_args, cluster_meta)
-  node_job = engine.run_on_executors(node_fn, num_tasks=num_executors)
+
+  driver_ps_procs = []
+  if driver_ps_nodes and num_ps:
+    # ps nodes run on the driver machine in their own processes/workdirs
+    import cloudpickle
+    import multiprocessing as mp
+    import tempfile
+    mapfn_bytes = cloudpickle.dumps(node_fn)
+    ctx_mp = mp.get_context("spawn")
+    for ps_id in cluster_template["ps"]:
+      wd = tempfile.mkdtemp(prefix="tos_driver_ps_%d_" % ps_id)
+      p = ctx_mp.Process(target=node_mod.driver_node_main,
+                         args=(mapfn_bytes, ps_id, wd),
+                         name="driver-ps-%d" % ps_id)
+      p.start()
+      driver_ps_procs.append(p)
+    engine_ids = [i for i in executors if i not in cluster_template["ps"]]
+  else:
+    engine_ids = executors
+
+  node_job = engine.run_on_executors(node_fn, num_tasks=len(engine_ids),
+                                     task_payloads=engine_ids)
 
   def _watch_job():
     # poll: a single failed bring-up task must surface its traceback
     # immediately (aborting await_reservations), not after the surviving
-    # tasks run out their reservation timeout
+    # tasks run out their reservation timeout; driver-hosted ps processes
+    # get the same treatment (a crashed child has a nonzero exitcode)
     import time as _time
     while not node_job.done():
       err = node_job.first_error()
+      for p in driver_ps_procs:
+        if p.exitcode not in (None, 0):
+          err = err or ("driver ps process %s exited with code %s during "
+                        "bring-up" % (p.name, p.exitcode))
       if err:
         tf_status["error"] = err
         return
@@ -265,11 +346,15 @@ def run(engine: Engine, main_fn, tf_args=None,
         timeout=reservation_timeout, status=tf_status)
   except Exception:
     server.stop()
+    for p in driver_ps_procs:
+      p.terminate()
     raise
 
   # duplicate-node sanity check (parity: TFCluster.py:357-372)
   if server.reservations.duplicates:
     server.stop()
+    for p in driver_ps_procs:
+      p.terminate()
     raise RuntimeError(
         "duplicate node reservations detected (reused executors?): %r"
         % server.reservations.duplicates)
@@ -278,4 +363,4 @@ def run(engine: Engine, main_fn, tf_args=None,
               [(n["executor_id"], n["job_name"], n["task_index"])
                for n in cluster_info])
   return TPUCluster(engine, cluster_info, cluster_meta, server, input_mode,
-                    node_job, tf_status)
+                    node_job, tf_status, driver_ps_procs=driver_ps_procs)

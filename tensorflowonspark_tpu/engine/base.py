@@ -82,9 +82,11 @@ class Engine(abc.ABC):
 
   @abc.abstractmethod
   def run_on_executors(self, fn: Callable[[Iterable], object],
-                       num_tasks: Optional[int] = None) -> EngineJob:
-    """Run ``fn(iter([task_id]))`` once on each of ``num_tasks`` distinct
-    executors (async). Parity: nodeRDD.foreachPartition."""
+                       num_tasks: Optional[int] = None,
+                       task_payloads: Optional[Sequence] = None) -> EngineJob:
+    """Run ``fn(iter([payload]))`` once on each of ``num_tasks`` distinct
+    executors (async); payloads default to the task indices. Parity:
+    nodeRDD.foreachPartition."""
 
   @abc.abstractmethod
   def foreach_partition(self, partitions: Sequence[Iterable],

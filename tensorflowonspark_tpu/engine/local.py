@@ -108,17 +108,23 @@ class LocalEngine(Engine):
   def executor_workdir(self, slot: int) -> str:
     return os.path.join(self._root, "executor_%d" % slot)
 
-  def run_on_executors(self, fn, num_tasks: Optional[int] = None) -> EngineJob:
+  def run_on_executors(self, fn, num_tasks: Optional[int] = None,
+                       task_payloads=None) -> EngineJob:
     n = num_tasks if num_tasks is not None else self._num_executors
     if n > self._num_executors:
       raise ValueError("requested %d tasks but engine has %d executors"
                        % (n, self._num_executors))
+    payloads = list(task_payloads) if task_payloads is not None \
+        else list(range(n))
+    if len(payloads) != n:
+      raise ValueError("task_payloads has %d entries for %d tasks"
+                       % (len(payloads), n))
     job = self._new_job(n)
     fn_bytes = cloudpickle.dumps(fn)
     with self._lock:
       for i in range(n):
         self._pinned[i].append((job.job_id, i, fn_bytes,
-                                cloudpickle.dumps([i])))
+                                cloudpickle.dumps([payloads[i]])))
       self._schedule_locked()
     return job
 

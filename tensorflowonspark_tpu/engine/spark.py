@@ -68,9 +68,15 @@ class SparkEngine(Engine):
                      name="spark-engine-job").start()
     return job
 
-  def run_on_executors(self, fn, num_tasks: Optional[int] = None) -> EngineJob:
+  def run_on_executors(self, fn, num_tasks: Optional[int] = None,
+                       task_payloads=None) -> EngineJob:
     n = num_tasks if num_tasks is not None else self._num_executors
-    rdd = self.sc.parallelize(range(n), n)
+    payloads = list(task_payloads) if task_payloads is not None \
+        else list(range(n))
+    if len(payloads) != n:
+      raise ValueError("task_payloads has %d entries for %d tasks"
+                       % (len(payloads), n))
+    rdd = self.sc.parallelize(payloads, n)
 
     def _wrap(it):
       yield fn(it)  # preserve per-task return values (LocalEngine parity)

@@ -106,6 +106,15 @@ class TPUNodeContext(object):
       return
     self.release_port()
     import jax
+    try:
+      # CPU backends need an explicit cross-process collectives transport;
+      # on TPU this knob doesn't exist and collectives ride ICI natively
+      jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - unknown config name on this backend
+      pass
+    logger.info("joining jax process group: coordinator=%s rank=%d/%d",
+                self.coordinator_address, self.process_id,
+                self.num_processes)
     jax.distributed.initialize(
         coordinator_address=self.coordinator_address,
         num_processes=self.num_processes,
@@ -378,6 +387,18 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
       return [executor_id]
 
   return _mapfn
+
+
+def driver_node_main(mapfn_bytes: bytes, executor_id: int,
+                     workdir: str) -> None:
+  """Entry point for a node hosted on the DRIVER machine (driver_ps_nodes,
+  parity: reference TFCluster.py:298-316): runs the same bring-up mapfn a
+  regular executor would, in its own working directory."""
+  import cloudpickle
+  os.makedirs(workdir, exist_ok=True)
+  os.chdir(workdir)
+  mapfn = cloudpickle.loads(mapfn_bytes)
+  mapfn(iter([executor_id]))
 
 
 # --- data-plane task factories (parity: TFSparkNode.train/inference) --------

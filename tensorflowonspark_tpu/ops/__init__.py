@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference had no custom kernels (all math delegated to TF); on TPU the
+few ops XLA cannot fuse optimally are written in Pallas:
+
+- ``flash_attention`` — fused blockwise attention (softmax never
+  materializes the full score matrix in HBM); the intra-block engine under
+  ring attention's sequence parallelism.
+"""
+
+from tensorflowonspark_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,51 @@
+"""Profiling/tracing helpers: the JAX-native TensorBoard story.
+
+The reference's only tracing facility was launching TensorBoard as a
+subprocess on chief/worker:0 (reference TFSparkNode.py:292-329 — that part
+lives in node.py here). This module adds what TPU users actually profile
+with: the JAX profiler — a programmatic trace context writing XProf/
+perfetto data TensorBoard can render, and an on-demand capture server.
+"""
+
+import contextlib
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_server = None
+
+
+def start_server(port: int = 9999):
+  """Start the JAX profiler capture server (connect with TensorBoard's
+  profile tab or `xprof`); idempotent per process."""
+  global _server
+  if _server is None:
+    import jax
+    _server = jax.profiler.start_server(port)
+    logger.info("JAX profiler server listening on port %d", port)
+  return _server
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: int = 2):
+  """Trace a region into ``log_dir`` (viewable in TensorBoard).
+
+  Usage::
+
+      with profiler.trace("/tmp/tb"):
+          state, loss = train_step(state, batch)
+          jax.block_until_ready(loss)
+  """
+  import jax
+  os.makedirs(log_dir, exist_ok=True)
+  with jax.profiler.trace(log_dir):
+    yield
+  logger.info("profile trace written to %s", log_dir)
+
+
+def annotate(name: str):
+  """Named region annotation for traces (shows up on the timeline)."""
+  import jax
+  return jax.profiler.TraceAnnotation(name)

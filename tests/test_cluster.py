@@ -238,6 +238,71 @@ def test_early_bringup_failure_surfaces_fast():
     bad.stop()
 
 
+def test_train_stream_with_stop_signal(engine):
+  """Streaming feed rounds end on the graceful stop signal (parity:
+  DStream feeding + stop_streaming, reference TFCluster.py:83-85,150-152)."""
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+      for x in feed.next_batch(16):
+        total += x
+    with open("stream_total.txt", "w") as f:
+      f.write(str(total))
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+
+  def stream():
+    for round_no in range(100):       # "unbounded" source
+      if round_no == 3:
+        # a remote client sends the stop signal (stop_streaming parity)
+        from tensorflowonspark_tpu.control.rendezvous import Client
+        Client(tuple(c.server_addr)).request_stop()
+      yield [[1] * 10, [1] * 10]
+
+  rounds = c.train_stream(stream(), feed_timeout=60)
+  assert rounds <= 4
+  c.shutdown(timeout=120)
+  grand = sum(int(open(os.path.join(engine.executor_workdir(s),
+                                    "stream_total.txt")).read())
+              for s in range(2))
+  assert grand == rounds * 20
+
+
+def test_driver_ps_nodes():
+  """ps nodes hosted on the driver machine (parity: TFCluster.py:298-316):
+  cluster_size = engine executors + num_ps."""
+  engine = LocalEngine(num_executors=2)
+  try:
+    def main_fn(args, ctx):
+      with open("role.txt", "w") as f:
+        f.write("%s:%d" % (ctx.job_name, ctx.task_index))
+
+    c = tos_cluster.run(engine, main_fn, num_executors=3, num_ps=1,
+                        driver_ps_nodes=True,
+                        input_mode=InputMode.FILES, reservation_timeout=30)
+    jobs = sorted(n["job_name"] for n in c.cluster_info)
+    assert jobs == ["ps", "worker", "worker"]
+    assert len(c.driver_ps_procs) == 1
+    c.shutdown(timeout=120)
+    assert not c.driver_ps_procs[0].is_alive()
+    # both engine executors ran workers (ps lived on the driver)
+    for slot in range(2):
+      role = open(os.path.join(engine.executor_workdir(slot),
+                               "role.txt")).read()
+      assert role.startswith("worker")
+  finally:
+    engine.stop()
+
+
+def test_driver_ps_requires_files_mode(engine):
+  with pytest.raises(ValueError, match="driver_ps_nodes"):
+    tos_cluster.run(engine, lambda a, c: None, num_ps=1,
+                    driver_ps_nodes=True, input_mode=InputMode.ENGINE)
+
+
 def test_validation_errors(engine):
   with pytest.raises(AssertionError, match="at least one worker"):
     tos_cluster.run(engine, lambda a, c: None, num_ps=2,

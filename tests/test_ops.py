@@ -1,0 +1,51 @@
+"""Pallas kernel tests (interpret mode on CPU; the same kernels compile to
+MXU/VPU code on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.ops import flash_attention
+from tensorflowonspark_tpu.parallel import ring_attention as ra
+
+
+class TestFlashAttention:
+  @pytest.mark.parametrize("causal", [True, False])
+  def test_matches_reference(self, causal):
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 128, 4, 32
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+               for _ in range(3))
+    ref = ra.full_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, blk_q=32, blk_k=32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+  def test_single_block(self):
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
+               for _ in range(3))
+    ref = ra.full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+  def test_bfloat16_inputs(self):
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.randn(1, 64, 2, 16), jnp.bfloat16)
+               for _ in range(3))
+    ref = ra.full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, blk_q=32, blk_k=32,
+                          interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+  def test_indivisible_seq_raises(self):
+    q = jnp.zeros((1, 100, 2, 8))
+    with pytest.raises(AssertionError, match="not divisible"):
+      flash_attention(q, q, q, blk_q=32, blk_k=32, interpret=True)
