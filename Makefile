@@ -1,0 +1,24 @@
+# Developer entry points (CI parity with the reference's tox/screwdriver
+# test+lint jobs, minus the Spark standalone bring-up — LocalEngine spawns
+# its own executor processes).
+
+PY ?= python
+
+.PHONY: test native bench dryrun clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) __graft_entry__.py 8
+
+clean:
+	rm -rf tensorflowonspark_tpu/data/_tfrecord_native.so \
+	  $(shell find . -name __pycache__ -type d)

@@ -103,6 +103,28 @@ class DataFeed(object):
       else:
         empty_rounds += 1
 
+  def next_batch_synced(self, batch_size: int):
+    """``next_batch`` with global step agreement across jax processes.
+
+    Synchronous SPMD training deadlocks if one worker's feed runs dry while
+    others enter a collective. Before handing out a batch, all processes
+    vote "I have a full batch"; if anyone is short, EVERY process stops
+    (returning a batch signalling stop via ``should_stop()``). At most one
+    partial batch per worker is discarded at end-of-data — the principled
+    replacement for the reference's train-90%-of-steps workaround
+    (examples/mnist/keras/mnist_spark.py:58-64).
+    """
+    from tensorflowonspark_tpu.parallel.collectives import \
+        all_processes_agree
+    batch = self.next_batch(batch_size)
+    n = len(batch[self.input_tensors[0]]) if isinstance(batch, dict) \
+        else len(batch)
+    ok = n == batch_size and not self.done_feeding
+    if not all_processes_agree(ok):
+      self.done_feeding = True
+      return {k: [] for k in batch} if isinstance(batch, dict) else []
+    return batch
+
   # -- TPU staging -----------------------------------------------------------
 
   def next_batch_arrays(self, batch_size: int, dtype=None):

@@ -35,6 +35,9 @@ class TransformerConfig:
   dtype: Any = jnp.bfloat16
   remat: bool = True
   use_ring_attention: bool = False   # set True when seq is mesh-sharded
+  # "auto": Pallas flash attention on TPU, dense elsewhere; or force
+  # "flash" / "dense"
+  attention_impl: str = "auto"
 
   @property
   def head_dim(self) -> int:
@@ -77,7 +80,17 @@ class Attention(nn.Module):
     if cfg.use_ring_attention and self.mesh is not None:
       out = ra.ring_attention(q, k, v, self.mesh, causal=True)
     else:
-      out = ra.full_attention(q, k, v, causal=True)
+      impl = cfg.attention_impl
+      if impl == "auto":
+        seq = q.shape[1]
+        divisible = seq % min(128, seq) == 0
+        impl = ("flash" if jax.default_backend() == "tpu" and divisible
+                else "dense")
+      if impl == "flash":
+        from tensorflowonspark_tpu.ops import flash_attention
+        out = flash_attention(q, k, v, causal=True)
+      else:
+        out = ra.full_attention(q, k, v, causal=True)
 
     out = nn.DenseGeneral(
         cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, use_bias=False,

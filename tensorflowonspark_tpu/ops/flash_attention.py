@@ -62,15 +62,50 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
   o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
-                                             "interpret"))
+def _dense_reference(q, k, v, causal):
+  """Dense attention used for the backward pass (differentiable); the
+  single source of truth for the math lives in parallel.ring_attention."""
+  from tensorflowonspark_tpu.parallel.ring_attention import full_attention
+  return full_attention(q, k, v, causal=causal)
+
+
 def flash_attention(q, k, v, causal: bool = True, blk_q: int = 128,
                     blk_k: int = 128, interpret: bool = False):
   """Fused attention. q/k/v: [batch, seq, heads, head_dim].
 
+  Forward runs the Pallas kernel; the backward pass currently recomputes
+  through the dense reference (a fused backward kernel is future work —
+  training still benefits from the fused forward under remat).
   ``blk_q``/``blk_k`` are clamped to the sequence length; seq must be
   divisible by the resulting blocks.
   """
+  # keyword args are normalized here: custom_vjp wants positionals
+  return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret):
+  return _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+  out = _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
+  return out, (q, k, v)
+
+
+def _flash_bwd(causal, blk_q, blk_k, interpret, residuals, g):
+  q, k, v = residuals
+  _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal),
+                   q, k, v)
+  return vjp(g)
+
+
+_flash_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
+                                             "interpret"))
+def _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret):
   b, s, h, d = q.shape
   blk_q = min(blk_q, s)
   blk_k = min(blk_k, s)

@@ -1,0 +1,90 @@
+"""Checkpoint/resume: a thin manager over orbax with step tracking.
+
+The reference delegated checkpointing to user TF callbacks and only
+provided path plumbing + an export grace window (SURVEY.md §5
+checkpoint/resume). This module keeps that division of labor but gives the
+JAX path a ready-made manager: periodic saves keyed by step, latest-step
+restore for resume-after-preemption, retention, and chief-only writes.
+"""
+
+import logging
+import os
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointManager(object):
+  """Periodic save / latest restore of a train-state pytree.
+
+  Usage::
+
+      mgr = CheckpointManager(args.model_dir, save_interval_steps=100)
+      state, start_step = mgr.restore_or(state)     # resume if possible
+      for step in range(start_step, num_steps):
+          state, loss = train_step(state, batch)
+          mgr.save(step, state, is_chief=ctx.is_chief)
+      mgr.wait()
+  """
+
+  def __init__(self, directory: str, save_interval_steps: int = 100,
+               max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+    from tensorflowonspark_tpu.utils import paths
+
+    self.directory = os.path.abspath(paths.strip_scheme(directory))
+    os.makedirs(self.directory, exist_ok=True)
+    self.save_interval_steps = save_interval_steps
+    self._mgr = ocp.CheckpointManager(
+        self.directory,
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps))
+
+  def save(self, step: int, state: Any, is_chief: bool = True,
+           force: bool = False) -> bool:
+    """Save if the step hits the interval.
+
+    Role handling depends on the process topology: in a jax.distributed
+    process group, orbax's save is a COLLECTIVE — every process must call
+    it (orbax writes from the primary host only), so ``is_chief`` is
+    ignored there. For independent single-process nodes (no process
+    group), only the chief writes (parity with chief-only export,
+    reference compat.py:10-17).
+    """
+    import jax
+    if not is_chief and jax.process_count() <= 1:
+      return False
+    import orbax.checkpoint as ocp
+    saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                           force=force)
+    if saved:
+      logger.info("checkpoint saved at step %d", step)
+    return saved
+
+  def latest_step(self) -> Optional[int]:
+    return self._mgr.latest_step()
+
+  def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+    """Restore the given (or latest) step into the template's structure."""
+    import orbax.checkpoint as ocp
+    step = step if step is not None else self._mgr.latest_step()
+    if step is None:
+      raise FileNotFoundError("no checkpoints in %s" % self.directory)
+    return self._mgr.restore(step,
+                             args=ocp.args.StandardRestore(state_template))
+
+  def restore_or(self, state: Any):
+    """(state, next_step): restored latest if present, else the input."""
+    step = self._mgr.latest_step()
+    if step is None:
+      return state, 0
+    logger.info("resuming from checkpoint step %d", step)
+    return self.restore(state), step + 1
+
+  def wait(self) -> None:
+    """Block until async saves land (call before process exit)."""
+    self._mgr.wait_until_finished()
+
+  def close(self) -> None:
+    self._mgr.close()

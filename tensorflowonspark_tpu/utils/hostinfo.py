@@ -57,6 +57,23 @@ def find_in_path(path: str, file_name: str):
   return False
 
 
+def single_node_env(num_chips: int = 0, worker_index: int = 0,
+                    workers_per_host: int = 1) -> None:
+  """Prepare this process's env for standalone single-node execution.
+
+  Parity with the reference's ``util.single_node_env`` (util.py:21-49,
+  which expanded the Hadoop classpath and set GPU visibility for one-off
+  tasks): on TPU the equivalent is claiming a chip share for this process
+  before any JAX/libtpu initialization.
+  """
+  from tensorflowonspark_tpu.utils import tpu_info
+  if num_chips and not os.environ.get("TOS_TPU_TEST_MODE"):
+    topo = tpu_info.get_topology()
+    if topo is not None:
+      tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
+          num_chips, worker_index, workers_per_host))
+
+
 def write_executor_id(num: int, working_dir: str = ".") -> None:
   """Persist this executor's id to a file in the executor working dir.
 

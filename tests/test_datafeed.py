@@ -1,0 +1,107 @@
+"""DataFeed unit tests against a real local feed hub.
+
+Port of the reference's tests/test_TFNode.py:27-58 (batch/end-of-feed
+semantics against a real local TFManager) plus EndPartition inference
+semantics, input_mapping transposition, terminate drain, and array
+staging.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.control import feedhub
+from tensorflowonspark_tpu.control.marker import EndPartition
+from tensorflowonspark_tpu.datafeed import DataFeed
+
+
+@pytest.fixture()
+def hub():
+  h = feedhub.start(b"k", ["input", "output", "error"], mode="local")
+  yield h
+  h.shutdown()
+
+
+class TestDataFeed:
+  def test_batches_and_end_of_feed(self, hub):
+    q = hub.get_queue("input")
+    q.put_many(list(range(10)) + [None])
+    feed = DataFeed(hub, train_mode=True)
+    assert feed.next_batch(4) == [0, 1, 2, 3]
+    assert not feed.should_stop()
+    assert feed.next_batch(4) == [4, 5, 6, 7]
+    last = feed.next_batch(4)
+    assert last == [8, 9]
+    assert feed.should_stop()
+    assert feed.next_batch(4) == []
+
+  def test_end_partition_skipped_in_train_mode(self, hub):
+    q = hub.get_queue("input")
+    q.put_many([1, 2, EndPartition(), 3, 4, None])
+    feed = DataFeed(hub, train_mode=True)
+    assert feed.next_batch(10) == [1, 2, 3, 4]
+
+  def test_end_partition_ends_batch_in_inference(self, hub):
+    q = hub.get_queue("input")
+    q.put_many([1, 2, EndPartition(), 3, None])
+    feed = DataFeed(hub, train_mode=False)
+    assert feed.next_batch(10) == [1, 2]     # batch aligned to partition
+    assert feed.next_batch(10) == [3]
+    assert feed.should_stop()
+
+  def test_input_mapping_columns(self, hub):
+    q = hub.get_queue("input")
+    q.put_many([(1, "a"), (2, "b"), None])
+    feed = DataFeed(hub, input_mapping={"col_x": "x", "col_y": "y"})
+    batch = feed.next_batch(5)
+    assert batch == {"x": [1, 2], "y": ["a", "b"]}
+
+  def test_batch_results_roundtrip(self, hub):
+    feed = DataFeed(hub, train_mode=False)
+    feed.batch_results([10, 20, 30])
+    out = hub.get_queue("output")
+    assert out.get_many(5) == [10, 20, 30]
+
+  def test_terminate_drains_and_flags(self, hub):
+    q = hub.get_queue("input")
+    q.put_many(list(range(500)))
+    feed = DataFeed(hub)
+    feed.next_batch(10)
+    feed.terminate()
+    assert feed.should_stop()
+    assert hub.get("state") == "terminating"
+    assert q.qsize() == 0          # drained so blocked feeders can finish
+    assert q.join(timeout=2)       # and every item was accounted
+
+  def test_next_batch_arrays(self, hub):
+    q = hub.get_queue("input")
+    q.put_many([([1.0, 2.0],), ([3.0, 4.0],), None])
+    feed = DataFeed(hub, input_mapping={"features": "x"})
+    arrays = feed.next_batch_arrays(5, dtype="float32")
+    np.testing.assert_allclose(arrays["x"], [[1, 2], [3, 4]])
+
+  def test_blocking_next_batch_waits_for_feeder(self, hub):
+    feed = DataFeed(hub)
+    got = []
+
+    def consumer():
+      got.extend(feed.next_batch(3))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.3)
+    hub.get_queue("input").put_many([7, 8, 9])
+    t.join(timeout=5)
+    assert got == [7, 8, 9]
+
+  def test_synced_batch_single_process(self, hub):
+    # with one jax process the vote degenerates to the local condition
+    q = hub.get_queue("input")
+    q.put_many([1, 2, 3, None])
+    feed = DataFeed(hub)
+    assert feed.next_batch_synced(2) == [1, 2]
+    # only one row left -> everyone (of 1) agrees to stop; partial dropped
+    assert feed.next_batch_synced(2) == []
+    assert feed.should_stop()
