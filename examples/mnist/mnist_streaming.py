@@ -62,7 +62,7 @@ if __name__ == "__main__":
         images, labels = mnist.synthetic_dataset(256, seed=round_no)
         rows = list(zip(images.tolist(), labels.tolist()))
         round_no += 1
-        if round_no == args.rounds:
+        if round_no >= args.rounds:
           # signal BEFORE yielding the final round: train_stream feeds it,
           # sees the flag, and stops at exactly --rounds rounds.
           # (any process with the rendezvous address can do this)

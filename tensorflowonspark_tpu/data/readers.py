@@ -51,6 +51,12 @@ def read_tfrecord_examples(paths: Sequence[str], schema=None,
   if not repeat:
     yield from _once()
     return
+  if not paths:
+    # an empty shard (num_shards > file count) must not busy-spin forever;
+    # synchronous multi-worker jobs should size shards to workers instead
+    raise ValueError(
+        "repeat=True with an empty path list would spin forever; this "
+        "worker's file shard is empty (more workers than files?)")
   while True:
     yield from _once()
 

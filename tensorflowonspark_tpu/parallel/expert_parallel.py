@@ -1,0 +1,94 @@
+"""Expert parallelism: a mixture-of-experts FFN sharded over the
+``expert`` mesh axis.
+
+Beyond-parity capability (SURVEY.md §2.3: "Expert parallelism: No"): each
+device of the ``expert`` axis holds a disjoint slice of the expert stack;
+tokens are dispatched with one-hot combine weights (Shazeer-style einsum
+dispatch) and partial expert outputs are combined with a single ``psum``
+over the expert axis. Top-1 routing; gating runs replicated (it is a tiny
+matmul), expert FFNs run sharded.
+
+The dense dispatch keeps every token on every expert shard (masked), which
+is exact and simple; an all-to-all token exchange is the future
+communication-optimal variant.
+"""
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+
+def init_moe_params(rng, num_experts: int, d_model: int, d_ff: int,
+                    dtype=jnp.float32) -> Dict[str, jax.Array]:
+  kg, k1, k2 = jax.random.split(rng, 3)
+  scale_in = 1.0 / (d_model ** 0.5)
+  return {
+      "w_gate": jax.random.normal(kg, (d_model, num_experts), dtype) * scale_in,
+      "w_up": jax.random.normal(k1, (num_experts, d_model, d_ff), dtype)
+              * scale_in,
+      "w_down": jax.random.normal(k2, (num_experts, d_ff, d_model), dtype)
+                * (1.0 / (d_ff ** 0.5)),
+  }
+
+
+def _route(params, x):
+  """Top-1 routing: [T, E] combine weights (gate prob on the argmax)."""
+  logits = x.astype(jnp.float32) @ params["w_gate"].astype(jnp.float32)
+  probs = jax.nn.softmax(logits, axis=-1)
+  top = jnp.argmax(probs, axis=-1)
+  onehot = jax.nn.one_hot(top, probs.shape[-1], dtype=probs.dtype)
+  return onehot * jnp.max(probs, axis=-1, keepdims=True)
+
+
+def moe_ffn_reference(params, x):
+  """Single-device reference: x [T, D] -> [T, D]."""
+  combine = _route(params, x)                          # [T, E]
+  xf = x.astype(jnp.float32)
+  h = jax.nn.relu(jnp.einsum("te,td,edf->etf", combine, xf,
+                             params["w_up"].astype(jnp.float32)))
+  out = jnp.einsum("etf,efd->etd", h,
+                   params["w_down"].astype(jnp.float32))
+  return jnp.einsum("etd,te->td", out, combine).astype(x.dtype)
+
+
+def _moe_local(x, combine, w_up, w_down):
+  """shard_map body: local expert slice. x [T,D] replicated over expert;
+  combine [T,E_local]; w_up [E_local,D,F]; w_down [E_local,F,D]."""
+  xf = x.astype(jnp.float32)
+  h = jax.nn.relu(jnp.einsum("te,td,edf->etf", combine, xf,
+                             w_up.astype(jnp.float32)))
+  out = jnp.einsum("etf,efd->etd", h, w_down.astype(jnp.float32))
+  partial = jnp.einsum("etd,te->td", out, combine)
+  return lax.psum(partial, mesh_lib.AXIS_EXPERT).astype(x.dtype)
+
+
+def moe_ffn(params, x, mesh):
+  """Expert-sharded MoE FFN. x: [tokens, d_model] (shard tokens over the
+  data axes as usual); expert weights sharded over the expert axis."""
+  from jax import shard_map
+
+  combine = _route(params, x)                          # [T, E] replicated
+  batch_axes = mesh_lib.data_axes(mesh) or None
+  fn = shard_map(
+      _moe_local, mesh=mesh,
+      in_specs=(P(batch_axes), P(batch_axes, mesh_lib.AXIS_EXPERT),
+                P(mesh_lib.AXIS_EXPERT), P(mesh_lib.AXIS_EXPERT)),
+      out_specs=P(batch_axes), check_vma=False)
+  return fn(x, combine, params["w_up"], params["w_down"])
+
+
+def shard_moe_params(params, mesh):
+  """Place MoE params: gate replicated, expert stacks sharded."""
+  from jax.sharding import NamedSharding
+  put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))  # noqa: E731
+  return {
+      "w_gate": put(params["w_gate"], P()),
+      "w_up": put(params["w_up"], P(mesh_lib.AXIS_EXPERT)),
+      "w_down": put(params["w_down"], P(mesh_lib.AXIS_EXPERT)),
+  }

@@ -136,6 +136,43 @@ class TestPipelineParallel:
                                atol=1e-4, rtol=1e-4)
 
 
+class TestExpertParallel:
+  def test_matches_reference(self, devices):
+    from tensorflowonspark_tpu.parallel import expert_parallel as EP
+
+    mesh = M.build_mesh(M.MeshSpec(data=2, expert=4), devices=devices)
+    params = EP.init_moe_params(jax.random.PRNGKey(0), num_experts=8,
+                                d_model=16, d_ff=32)
+    x = jnp.asarray(np.random.RandomState(0).randn(24, 16), jnp.float32)
+    ref = EP.moe_ffn_reference(params, x)
+    sharded = EP.shard_moe_params(params, mesh)
+    out = jax.jit(lambda p, x: EP.moe_ffn(p, x, mesh))(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+  def test_expert_weights_actually_sharded(self, devices):
+    from tensorflowonspark_tpu.parallel import expert_parallel as EP
+    mesh = M.build_mesh(M.MeshSpec(expert=8), devices=devices)
+    params = EP.shard_moe_params(
+        EP.init_moe_params(jax.random.PRNGKey(0), 8, 16, 32), mesh)
+    assert len(params["w_up"].sharding.device_set) == 8
+
+  def test_differentiable(self, devices):
+    from tensorflowonspark_tpu.parallel import expert_parallel as EP
+    mesh = M.build_mesh(M.MeshSpec(expert=4), devices=devices[:4])
+    params = EP.init_moe_params(jax.random.PRNGKey(1), 4, 8, 16)
+    x = jnp.asarray(np.random.RandomState(1).randn(6, 8), jnp.float32)
+
+    g_ref = jax.grad(lambda p: jnp.sum(
+        EP.moe_ffn_reference(p, x) ** 2))(params)
+    sharded = EP.shard_moe_params(params, mesh)
+    g_shard = jax.jit(jax.grad(lambda p: jnp.sum(
+        EP.moe_ffn(p, x, mesh) ** 2)))(sharded)
+    np.testing.assert_allclose(np.asarray(g_shard["w_up"]),
+                               np.asarray(g_ref["w_up"]),
+                               atol=1e-4, rtol=1e-4)
+
+
 class TestShardedTrainStep:
   def test_transformer_trains_sharded(self, devices):
     """Full dp+sp+tp train loop: loss must decrease on a tiny corpus."""
