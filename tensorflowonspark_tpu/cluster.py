@@ -219,7 +219,9 @@ def run(engine: Engine, main_fn, tf_args=None,
         reservation_timeout: float = 600,
         queues: Sequence[str] = ("input", "output", "error", "control"),
         eval_node: bool = False, release_port: bool = True,
-        chips_per_node: int = 0, qmax: int = 1024) -> TPUCluster:
+        chips_per_node: int = 0, qmax: int = 1024,
+        feed_transport: str = "queue",
+        shm_capacity: int = 64 * 1024 * 1024) -> TPUCluster:
   """Start a cluster and run ``main_fn(tf_args, ctx)`` on every node.
 
   Signature parity with the reference's ``TFCluster.run``
@@ -289,6 +291,10 @@ def run(engine: Engine, main_fn, tf_args=None,
       "release_port": release_port,
       "chips_per_node": chips_per_node,
       "qmax": qmax,
+      # "queue" (manager-proxy, works everywhere) or "shm" (native
+      # shared-memory ring for the input stream; single host or per-host)
+      "feed_transport": feed_transport,
+      "shm_capacity": max(shm_capacity, 8 * 1024 * 1024),
   }
 
   # launch node bring-up asynchronously so that (a) feeding can start and

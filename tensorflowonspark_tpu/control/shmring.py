@@ -1,0 +1,281 @@
+"""Python wrapper for the native shared-memory ring buffer.
+
+The high-throughput alternative to the manager-proxy feed queues
+(control/feedhub.py): serialized batches move through POSIX shared memory
+(native/shmring.cpp) with no per-row IPC round-trips — the TPU-first
+redesign of the reference's feed-plane bottleneck (SURVEY.md §3.2,
+row-at-a-time pickled puts at TFSparkNode.py:500-502).
+
+Topology: single producer (the feeder task) / single consumer (the node's
+data loader) per ring, which is exactly what the engine guarantees.
+Batches are serialized with cloudpickle (numpy arrays supported).
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), os.pardir, "data",
+                        "_shmring_native.so")
+_SRC_PATH = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                         "native", "shmring.cpp")
+_lib = None
+_lib_tried = False
+
+
+def _load():
+  global _lib, _lib_tried
+  if _lib_tried:
+    return _lib
+  _lib_tried = True
+  so = os.path.abspath(_SO_PATH)
+  if not os.path.exists(so) and os.path.exists(_SRC_PATH):
+    try:
+      subprocess.run(["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                      "-o", so, os.path.abspath(_SRC_PATH)],
+                     check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+      logger.warning("shmring native build failed: %s", e)
+      return None
+  if not os.path.exists(so):
+    return None
+  lib = ctypes.CDLL(so)
+  lib.tos_ring_create.restype = ctypes.c_void_p
+  lib.tos_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+  lib.tos_ring_open.restype = ctypes.c_void_p
+  lib.tos_ring_open.argtypes = [ctypes.c_char_p]
+  lib.tos_ring_write.restype = ctypes.c_int
+  lib.tos_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint32, ctypes.c_int]
+  lib.tos_ring_read.restype = ctypes.c_int64
+  lib.tos_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32, ctypes.c_int]
+  lib.tos_ring_close_write.argtypes = [ctypes.c_void_p]
+  lib.tos_ring_pending.restype = ctypes.c_uint64
+  lib.tos_ring_pending.argtypes = [ctypes.c_void_p]
+  lib.tos_ring_free.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int]
+  _lib = lib
+  return _lib
+
+
+def available() -> bool:
+  return _load() is not None
+
+
+# rings held alive per process (same lifetime pattern as feedhub.hold);
+# freed explicitly at shutdown or by the atexit sweep — POSIX shm persists
+# past process death, so leaked segments would eat /dev/shm (RAM) until
+# reboot
+_held = {}
+_atexit_registered = False
+
+
+def hold(key, ring: "ShmRing") -> None:
+  global _atexit_registered
+  _held[key] = ring
+  if not _atexit_registered:
+    import atexit
+    atexit.register(release_all)
+    _atexit_registered = True
+
+
+def held(key) -> Optional["ShmRing"]:
+  return _held.get(key)
+
+
+def release(key) -> None:
+  """Free (and unlink) a held ring."""
+  ring = _held.pop(key, None)
+  if ring is not None:
+    ring.free()
+
+
+def release_all() -> None:
+  for key in list(_held):
+    release(key)
+
+
+class RingClosed(Exception):
+  pass
+
+
+class RingTimeout(Exception):
+  pass
+
+
+_open_cache = {}
+
+
+def open_cached(name: str) -> "ShmRing":
+  """Open a ring once per process (mmap reuse across feeder tasks)."""
+  if name not in _open_cache:
+    _open_cache[name] = ShmRing.open(name)
+  return _open_cache[name]
+
+
+class RingQueueAdapter(object):
+  """FeedQueue-compatible facade over a ShmRing.
+
+  Exposes the subset of the feed-queue API the feeder tasks and DataFeed
+  use (``put``/``put_many``/``get_many``/``task_done``/``join``), so the
+  queue and shared-memory transports share one code path. Items travel as
+  chunk batches; ``task_done`` is a no-op (the ring's tail pointer IS the
+  consumption acknowledgment) and ``join`` waits for the ring to drain.
+  """
+
+  def __init__(self, ring: "ShmRing"):
+    self._ring = ring
+    import collections
+    self._buffer = collections.deque()
+
+  # keep any single ring payload comfortably below the ring capacity so a
+  # write can always be placed after a drain (a record larger than roughly
+  # half the ring can wedge against the wrap-around padding)
+  MAX_PAYLOAD = 4 * 1024 * 1024
+
+  # producer side ------------------------------------------------------------
+
+  def put_many(self, items, block: bool = True, timeout=None) -> None:
+    items = list(items)
+    t = None if (block and timeout is None) else (timeout if block else 0.0)
+    import cloudpickle
+    payload = cloudpickle.dumps(items)
+    if len(payload) > self.MAX_PAYLOAD and len(items) > 1:
+      # split oversized chunks so large rows stream through (parity with
+      # FeedQueue.put_many spilling through bounded queues)
+      half = len(items) // 2
+      self.put_many(items[:half], block=block, timeout=timeout)
+      self.put_many(items[half:], block=block, timeout=timeout)
+      return
+    self._ring.put_payload(payload, timeout=t)
+
+  def put(self, item, block: bool = True, timeout=None) -> None:
+    self.put_many([item], block=block, timeout=timeout)
+
+  def join(self, timeout=None) -> bool:
+    import time as _time
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while self._ring.pending_bytes() > 0:
+      if deadline is not None and _time.monotonic() > deadline:
+        return False
+      _time.sleep(0.005)
+    return True
+
+  # consumer side ------------------------------------------------------------
+
+  def get_many(self, max_items: int, block: bool = True, timeout=None):
+    if not self._buffer:
+      try:
+        got = self._ring.get_batch(
+            timeout=(timeout if timeout is not None else
+                     (None if block else 0.0)))
+        self._buffer.extend(got)
+      except (RingTimeout, RingClosed):
+        return []
+    out = []
+    while self._buffer and len(out) < max_items:
+      out.append(self._buffer.popleft())
+    return out
+
+  def task_done(self, n: int = 1) -> None:
+    pass
+
+  def qsize(self) -> int:
+    return len(self._buffer) + (1 if self._ring.pending_bytes() else 0)
+
+  def empty(self) -> bool:
+    return self.qsize() == 0
+
+
+class ShmRing(object):
+  """One endpoint of a shared-memory batch ring."""
+
+  def __init__(self, name: str, handle, lib, owner: bool):
+    self.name = name
+    self._h = handle
+    self._lib = lib
+    self._owner = owner
+    self._buf = ctypes.create_string_buffer(1 << 20)
+
+  # -- constructors ----------------------------------------------------------
+
+  @classmethod
+  def create(cls, name: str, capacity: int = 64 * 1024 * 1024) -> "ShmRing":
+    lib = _load()
+    if lib is None:
+      raise RuntimeError("native shmring unavailable (no toolchain?)")
+    h = lib.tos_ring_create(name.encode(), capacity)
+    if not h:
+      raise OSError("failed to create shm ring %r" % name)
+    return cls(name, h, lib, owner=True)
+
+  @classmethod
+  def open(cls, name: str) -> "ShmRing":
+    lib = _load()
+    if lib is None:
+      raise RuntimeError("native shmring unavailable (no toolchain?)")
+    h = lib.tos_ring_open(name.encode())
+    if not h:
+      raise OSError("failed to open shm ring %r" % name)
+    return cls(name, h, lib, owner=False)
+
+  # -- batch API -------------------------------------------------------------
+
+  def put_batch(self, batch, timeout: Optional[float] = None) -> None:
+    """Serialize and enqueue one batch (a list of rows / arrays pytree)."""
+    self.put_payload(cloudpickle.dumps(batch), timeout=timeout)
+
+  def put_payload(self, payload: bytes,
+                  timeout: Optional[float] = None) -> None:
+    """Enqueue an already-serialized batch."""
+    rc = self._lib.tos_ring_write(
+        self._h, payload, len(payload),
+        -1 if timeout is None else int(timeout * 1000))
+    if rc == 0:
+      return
+    if rc == 1:
+      raise RingTimeout("ring %r write timed out" % self.name)
+    if rc == 2:
+      raise RingClosed("ring %r is closed" % self.name)
+    raise ValueError("batch of %d bytes exceeds ring capacity"
+                     % len(payload))
+
+  def get_batch(self, timeout: Optional[float] = None):
+    """Dequeue one batch; raises RingClosed when drained after close."""
+    t = -1 if timeout is None else int(timeout * 1000)
+    while True:
+      n = self._lib.tos_ring_read(self._h, self._buf, len(self._buf), t)
+      if n >= 0:
+        return cloudpickle.loads(self._buf.raw[:n])
+      if n == -1:
+        raise RingTimeout("ring %r read timed out" % self.name)
+      if n == -2:
+        raise RingClosed("ring %r closed and drained" % self.name)
+      # -3: record larger than our scratch — grow and retry
+      self._buf = ctypes.create_string_buffer(len(self._buf) * 2)
+
+  def close_write(self) -> None:
+    """Producer signals end-of-stream (consumer drains then RingClosed)."""
+    self._lib.tos_ring_close_write(self._h)
+
+  def pending_bytes(self) -> int:
+    return self._lib.tos_ring_pending(self._h)
+
+  def free(self) -> None:
+    if self._h:
+      self._lib.tos_ring_free(self._h, self.name.encode(),
+                              1 if self._owner else 0)
+      self._h = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.free()

@@ -42,7 +42,10 @@ class DataFeed(object):
     # convention (reference pipeline.py:414, TFNode.py:251)
     self.input_tensors = ([input_mapping[col] for col in
                            sorted(input_mapping)] if input_mapping else None)
-    self._queue_in = hub.get_queue(qname_in)
+    # the input stream rides the shared-memory ring when the node
+    # advertises one (feed_transport='shm'); output/control stay on the hub
+    from tensorflowonspark_tpu.node import input_channel
+    self._queue_in = input_channel(hub, qname_in)
     self._queue_out = hub.get_queue(qname_out)
     self._buffer = collections.deque()
 

@@ -267,6 +267,22 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
     hub = feedhub.start(authkey, meta["queues"], mode=hub_mode,
                         qmax=meta.get("qmax", 1024))
     feedhub.hold(executor_id, hub)
+    if meta.get("feed_transport") == "shm":
+      # high-throughput input path: serialized chunks ride a native
+      # shared-memory ring instead of manager-proxy queues; control/error/
+      # output queues stay on the hub
+      from tensorflowonspark_tpu.control import shmring
+      if shmring.available():
+        ring_name = "/tos_feed_%x_%d" % (meta["id"] & 0xFFFFFFFF,
+                                         executor_id)
+        ring = shmring.ShmRing.create(ring_name,
+                                      meta.get("shm_capacity",
+                                               64 * 1024 * 1024))
+        shmring.hold(executor_id, ring)
+        hub.set("ring_name", ring_name)
+      else:
+        logger.warning("feed_transport='shm' requested but native ring "
+                       "unavailable; falling back to queue transport")
     hostinfo.write_executor_id(executor_id, working_dir)
     with open(os.path.join(working_dir, HUB_ADDR_FILE), "w") as f:
       f.write("%s:%d" % hub.addr)
@@ -413,6 +429,18 @@ def _get_hub(cluster_info: List[dict], executor_id: int, authkey: bytes):
   raise RuntimeError("no cluster node found for executor %d" % executor_id)
 
 
+def input_channel(hub, qname: str = "input"):
+  """The node's input stream: the shared-memory ring when the node
+  advertises one (feed_transport='shm'), else the hub queue. Both expose
+  the same put/get/join surface (control.shmring.RingQueueAdapter)."""
+  if qname == "input":
+    ring_name = hub.get("ring_name")
+    if ring_name:
+      from tensorflowonspark_tpu.control import shmring
+      return shmring.RingQueueAdapter(shmring.open_cached(ring_name))
+  return hub.get_queue(qname)
+
+
 def _check_errors(hub, where: str) -> None:
   """Poll the error queue; re-raise worker tracebacks on the feeder/driver
   side (parity: TFSparkNode.py:508-515)."""
@@ -440,7 +468,7 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
     executor_id = hostinfo.read_executor_id(os.getcwd())
     hub = _get_hub(cluster_info, executor_id, authkey)
     state = hub.get("state")
-    queue = hub.get_queue(qname)
+    queue = input_channel(hub, qname)
     if state == "terminating":
       # user called DataFeed.terminate(): consume and discard the partition
       # so the engine job completes (parity :492-496)
@@ -456,8 +484,11 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
         queue.put_many(chunk, block=True, timeout=feed_timeout)
         rows += len(chunk)
         chunk = []
-      if rows % (chunk_size * 8) == 0 and rows:
-        _check_errors(hub, "feeding")
+        # poll the error queue every 8th flushed chunk — at the flush
+        # point only (a per-item check would re-fire hundreds of times
+        # while `rows` sits on the boundary value)
+        if (rows // chunk_size) % 8 == 0:
+          _check_errors(hub, "feeding")
     if chunk:
       queue.put_many(chunk, block=True, timeout=feed_timeout)
       rows += len(chunk)
@@ -487,7 +518,7 @@ def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
     from tensorflowonspark_tpu.control.marker import EndPartition
     executor_id = hostinfo.read_executor_id(os.getcwd())
     hub = _get_hub(cluster_info, executor_id, authkey)
-    queue = hub.get_queue(qname)
+    queue = input_channel(hub, qname)
     count = 0
     chunk = []
     for item in iterator:
@@ -547,7 +578,7 @@ def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
         pass
 
     for qname in queues:
-      hub.get_queue(qname).put(None, block=True, timeout=60)
+      input_channel(hub, qname).put(None, block=True, timeout=60)
 
     # wait for the node process to finish (state -> stopped)
     deadline = time.monotonic() + max(grace_secs, 0) + 600
@@ -557,6 +588,12 @@ def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
       time.sleep(0.5)
     if grace_secs:
       time.sleep(grace_secs)
+
+    # the input ring (if any) has served its purpose; unlink the shm
+    # segment so repeated runs don't accumulate /dev/shm usage
+    if hub.get("ring_name"):
+      from tensorflowonspark_tpu.control import shmring
+      shmring.release(executor_id)
 
     # late-error propagation with peek-and-put-back (parity :644-650)
     eq = hub.get_queue("error")

@@ -83,8 +83,14 @@ def test_inference_roundtrip_sum_squares(engine):
   assert sum(results) == sum(x * x for x in data)
 
 
-def test_train_feed_and_shutdown(engine):
-  """ENGINE-mode training feed: every row reaches some worker exactly once."""
+@pytest.mark.parametrize("transport", ["queue", "shm"])
+def test_train_feed_and_shutdown(engine, transport):
+  """ENGINE-mode training feed: every row reaches some worker exactly once
+  — on both the queue and shared-memory transports."""
+  if transport == "shm":
+    from tensorflowonspark_tpu.control import shmring
+    if not shmring.available():
+      pytest.skip("native shmring unavailable")
 
   def main_fn(args, ctx):
     feed = ctx.get_data_feed(train_mode=True)
@@ -96,7 +102,7 @@ def test_train_feed_and_shutdown(engine):
       f.write(str(total))
 
   c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
-                      reservation_timeout=30)
+                      reservation_timeout=30, feed_transport=transport)
   partitions = [[1] * 10, [2] * 10, [3] * 10, [4] * 10]
   c.train(partitions, num_epochs=2, feed_timeout=60)
   c.shutdown(timeout=120)
@@ -236,6 +242,29 @@ def test_early_bringup_failure_surfaces_fast():
     assert time.time() - t0 < 60
   finally:
     bad.stop()
+
+
+def test_shm_feed_transport_roundtrip(engine):
+  """ENGINE mode over the native shared-memory ring: train + inference
+  round-trips must behave identically to the queue transport."""
+  from tensorflowonspark_tpu.control import shmring
+  if not shmring.available():
+    pytest.skip("native shmring unavailable")
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+      batch = feed.next_batch(32)
+      if batch:
+        feed.batch_results([x * 3 for x in batch])
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30, feed_transport="shm")
+  assert all(n is not None for n in c.cluster_info)
+  data = list(range(150))
+  results = c.inference([data[i::6] for i in range(6)], feed_timeout=60)
+  c.shutdown(timeout=120)
+  assert sorted(results) == sorted(x * 3 for x in data)
 
 
 def test_train_stream_with_stop_signal(engine):

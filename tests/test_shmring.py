@@ -1,0 +1,151 @@
+"""Shared-memory ring tests: correctness, wrap-around, cross-process
+transfer, end-of-stream, and a throughput comparison against the manager
+feed queues (the bottleneck this transport replaces)."""
+
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.control import shmring
+
+pytestmark = pytest.mark.skipif(not shmring.available(),
+                                reason="native shmring unavailable")
+
+
+def _name():
+  return "/tos_test_%d_%d" % (os.getpid(), int(time.time() * 1e6) % 10 ** 9)
+
+
+class TestShmRing:
+  def test_roundtrip_and_order(self):
+    with shmring.ShmRing.create(_name(), capacity=1 << 20) as ring:
+      for i in range(100):
+        ring.put_batch({"i": i, "data": list(range(i % 7))})
+      for i in range(100):
+        got = ring.get_batch(timeout=5)
+        assert got["i"] == i
+
+  def test_wraparound_many_records(self):
+    # capacity small enough that the ring wraps many times
+    with shmring.ShmRing.create(_name(), capacity=1 << 14) as ring:
+      payload = np.arange(256, dtype=np.float32)
+      for i in range(200):
+        ring.put_batch((i, payload), timeout=5)
+        j, arr = ring.get_batch(timeout=5)
+        assert j == i
+        np.testing.assert_array_equal(arr, payload)
+
+  def test_close_then_drain(self):
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      ring.put_batch([1, 2])
+      ring.close_write()
+      assert ring.get_batch(timeout=2) == [1, 2]
+      with pytest.raises(shmring.RingClosed):
+        ring.get_batch(timeout=2)
+
+  def test_read_timeout(self):
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      t0 = time.monotonic()
+      with pytest.raises(shmring.RingTimeout):
+        ring.get_batch(timeout=0.3)
+      assert 0.2 < time.monotonic() - t0 < 2.0
+
+  def test_oversized_batch_raises(self):
+    with shmring.ShmRing.create(_name(), capacity=1 << 12) as ring:
+      with pytest.raises(ValueError, match="exceeds ring capacity"):
+        ring.put_batch(np.zeros(10000, np.float64))
+
+  def test_large_record_grows_reader_buffer(self):
+    with shmring.ShmRing.create(_name(), capacity=1 << 24) as ring:
+      big = np.random.RandomState(0).rand(500000)  # ~4MB > 1MB scratch
+      ring.put_batch(big, timeout=5)
+      got = ring.get_batch(timeout=5)
+      np.testing.assert_array_equal(got, big)
+
+
+def _producer(name, n_batches, rows_per_batch):
+  ring = shmring.ShmRing.open(name)
+  payload = np.arange(rows_per_batch, dtype=np.float32)
+  for i in range(n_batches):
+    ring.put_batch((i, payload), timeout=30)
+  ring.close_write()
+
+
+def _queue_producer(addr, n_batches, rows_per_batch):
+  from tensorflowonspark_tpu.control import feedhub
+  hub = feedhub.connect(tuple(addr), b"k")
+  q = hub.get_queue("input")
+  payload = np.arange(rows_per_batch, dtype=np.float32)
+  for i in range(n_batches):
+    q.put((i, payload), block=True, timeout=30)
+
+
+class TestCrossProcess:
+  def test_producer_consumer(self):
+    name = _name()
+    with shmring.ShmRing.create(name, capacity=1 << 22) as ring:
+      p = mp.get_context("spawn").Process(target=_producer,
+                                          args=(name, 50, 1000))
+      p.start()
+      seen = 0
+      while True:
+        try:
+          i, arr = ring.get_batch(timeout=30)
+        except shmring.RingClosed:
+          break
+        assert i == seen and len(arr) == 1000
+        seen += 1
+      p.join(timeout=10)
+      assert seen == 50
+
+  def test_throughput_beats_manager_queue(self):
+    """The native ring must beat the manager-proxy queue it replaces on
+    identical cross-process batch traffic (clock starts at first batch so
+    process spawn cost is excluded)."""
+    from tensorflowonspark_tpu.control import feedhub
+
+    n_batches, rows = 300, 2048
+
+    name = _name()
+    with shmring.ShmRing.create(name, capacity=1 << 26) as ring:
+      p = mp.get_context("spawn").Process(target=_producer,
+                                          args=(name, n_batches, rows))
+      p.start()
+      ring.get_batch(timeout=60)          # first batch: start the clock
+      t0 = time.monotonic()
+      got = 1
+      while True:
+        try:
+          ring.get_batch(timeout=60)
+          got += 1
+        except shmring.RingClosed:
+          break
+      p.join()
+      ring_time = time.monotonic() - t0
+      assert got == n_batches
+
+    hub = feedhub.start(b"k", ["input"], mode="local", qmax=64)
+    try:
+      q = hub.get_queue("input")
+      p = mp.get_context("spawn").Process(
+          target=_queue_producer, args=(hub.addr, n_batches, rows))
+      p.start()
+      while len(q.get_many(1, timeout=60)) == 0:
+        pass                               # first batch: start the clock
+      t0 = time.monotonic()
+      received = 1
+      while received < n_batches:
+        got = q.get_many(8, timeout=60)
+        q.task_done(len(got))
+        received += len(got)
+      p.join()
+      hub_time = time.monotonic() - t0
+    finally:
+      hub.shutdown()
+
+    print("shmring: %.3fs, manager queue: %.3fs (%.1fx)"
+          % (ring_time, hub_time, hub_time / ring_time))
+    assert ring_time < hub_time
