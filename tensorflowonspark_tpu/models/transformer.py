@@ -13,11 +13,13 @@ Logical axis names map to mesh axes through
   batch -> data+fsdp, sequence -> sequence axis.
 """
 
+import functools
 from dataclasses import dataclass
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import flax.linen as nn
 
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
@@ -156,6 +158,42 @@ class Transformer(nn.Module):
     # tied output projection (attend to the embedding table)
     logits = emb.attend(x.astype(cfg.dtype))
     return logits.astype(jnp.float32)
+
+
+@functools.lru_cache(maxsize=8)
+def _generate_fn(cfg: TransformerConfig, plen: int, num_steps: int):
+  """Cached jitted decode loop; params/buf are runtime args so repeated
+  generate calls reuse one compilation and params are never baked in as
+  compile-time constants."""
+  model = Transformer(cfg)
+
+  def decode(params, buf):
+    def step(i, buf):
+      logits = model.apply({"params": params}, buf)     # [b, total, V]
+      pos = plen + i - 1
+      last = lax.dynamic_index_in_dim(logits, pos, axis=1, keepdims=False)
+      nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)  # [b]
+      return lax.dynamic_update_slice(buf, nxt[:, None], (0, plen + i))
+
+    return lax.fori_loop(0, num_steps, step, buf)
+
+  return jax.jit(decode)
+
+
+def greedy_generate(params, cfg: TransformerConfig, prompt, num_steps: int,
+                    mesh=None):
+  """Greedy autoregressive decoding (jit-compiled fixed-length loop).
+
+  prompt: int32 [batch, prompt_len]. Returns [batch, prompt_len+num_steps].
+  Recomputes the full forward per step (functional and simple); a KV-cache
+  decode path is a future optimization. The compiled loop is cached per
+  (config, prompt_len, num_steps).
+  """
+  del mesh  # generation runs wherever params live; sharding via params
+  b, plen = prompt.shape
+  buf = jnp.zeros((b, plen + num_steps), jnp.int32)
+  buf = lax.dynamic_update_slice(buf, prompt.astype(jnp.int32), (0, 0))
+  return _generate_fn(cfg, plen, num_steps)(params, buf)
 
 
 def causal_lm_loss(logits, tokens):

@@ -1,14 +1,15 @@
-"""Fused causal attention as a Pallas TPU kernel.
+"""Fused causal attention as Pallas TPU kernels — forward AND backward.
 
-Flash-attention-style: the kernel streams over K/V blocks with an online
+Flash-attention-style: the forward streams over K/V blocks with an online
 softmax carried in VMEM scratch, so the [S, S] score matrix never hits HBM
 — scores are produced on the MXU, normalized on the VPU, and accumulated in
-float32 while inputs stay bfloat16.
+float32 while inputs stay bfloat16. The forward also emits the per-row
+logsumexp, which the backward kernels use to rebuild probabilities
+blockwise: dQ comes from a (batch·heads, q-block) grid and dK/dV from a
+(batch·heads, k-block) grid, so the backward is fused and HBM-light too
+(no dense [S, S] materialization anywhere in training).
 
-Grid: one program per (batch*heads, q-block). K/V blocks are looped inside
-the kernel with ``lax.fori_loop`` (static shapes, compiler-friendly).
-
-``interpret=True`` runs the same kernel on CPU for tests; on TPU the
+``interpret=True`` runs the same kernels on CPU for tests; on TPU the
 MXU/VPU path is used. Layout: [batch, seq, heads, head_dim] to match
 ``parallel.ring_attention``.
 """
@@ -23,8 +24,18 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
-                 seq_len: int, causal: bool, scale: float):
+def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal):
+  """Scaled scores for one (q-block, k-block) pair with causal masking."""
+  s = q @ k.astype(jnp.float32).T
+  if causal:
+    q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    k_pos = ki * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+  return s
+
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_q: int,
+                     blk_k: int, seq_len: int, causal: bool, scale: float):
   qi = pl.program_id(1)
   q = q_ref[0].astype(jnp.float32) * scale          # [blk_q, D]
   n_kblocks = seq_len // blk_k
@@ -33,13 +44,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
     m, l, acc = carry
     k = lax.dynamic_slice_in_dim(k_ref[0], ki * blk_k, blk_k, 0)
     v = lax.dynamic_slice_in_dim(v_ref[0], ki * blk_k, blk_k, 0)
-    s = q @ k.astype(jnp.float32).T                 # [blk_q, blk_k] on MXU
-    if causal:
-      q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32,
-                                                (blk_q, blk_k), 0)
-      k_pos = ki * blk_k + lax.broadcasted_iota(jnp.int32,
-                                                (blk_q, blk_k), 1)
-      s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal)
     m_blk = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m, m_blk)
     m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
@@ -53,75 +58,129 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
   m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
   l0 = jnp.zeros((blk_q,), jnp.float32)
   acc0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
-
-  # causal: blocks strictly right of this q-block's diagonal contribute
-  # nothing — skip them (upper bound is static per q-block only via full
-  # loop; use masked full loop for grid-static shape, cheap for small S)
   m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
-  l = jnp.where(l == 0.0, 1.0, l)
-  o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+  l_safe = jnp.where(l == 0.0, 1.0, l)
+  o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+  # logsumexp of each row's scores (NEG_INF rows stay NEG_INF)
+  lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+  lse_ref[0] = lse
 
 
-def _dense_reference(q, k, v, causal):
-  """Dense attention used for the backward pass (differentiable); the
-  single source of truth for the math lives in parallel.ring_attention."""
-  from tensorflowonspark_tpu.parallel.ring_attention import full_attention
-  return full_attention(q, k, v, causal=causal)
+def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, *, blk_q: int, blk_k: int, seq_len: int,
+                        causal: bool, scale: float):
+  """dQ for one q-block: dQ = scale · Σ_k [P ⊙ (dO·Vᵀ − Δ)] · K."""
+  qi = pl.program_id(1)
+  q = q_ref[0].astype(jnp.float32) * scale
+  do = do_ref[0].astype(jnp.float32)                # [blk_q, D]
+  lse = lse_ref[0]                                  # [blk_q]
+  delta = delta_ref[0]                              # [blk_q]
+  n_kblocks = seq_len // blk_k
+
+  def body(ki, dq):
+    k = lax.dynamic_slice_in_dim(k_ref[0], ki * blk_k, blk_k, 0)
+    v = lax.dynamic_slice_in_dim(v_ref[0], ki * blk_k, blk_k, 0)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal)
+    p = jnp.exp(s - lse[:, None])
+    p = jnp.where(s <= NEG_INF, 0.0, p)
+    dp = do @ v.astype(jnp.float32).T               # [blk_q, blk_k]
+    ds = p * (dp - delta[:, None])
+    return dq + ds @ k.astype(jnp.float32)
+
+  dq0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
+  dq = lax.fori_loop(0, n_kblocks, body, dq0)
+  dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, *, blk_q: int, blk_k: int,
+                         seq_len: int, causal: bool, scale: float):
+  """dK/dV for one k-block: dV = Σ_q Pᵀ·dO; dK = scale · Σ_q dSᵀ·Q."""
+  ki = pl.program_id(1)
+  k = k_ref[0].astype(jnp.float32)                  # [blk_k, D]
+  v = v_ref[0].astype(jnp.float32)
+  n_qblocks = seq_len // blk_q
+
+  def body(qi, carry):
+    dk, dv = carry
+    q = lax.dynamic_slice_in_dim(q_ref[0], qi * blk_q, blk_q, 0) \
+        .astype(jnp.float32) * scale
+    do = lax.dynamic_slice_in_dim(do_ref[0], qi * blk_q, blk_q, 0) \
+        .astype(jnp.float32)
+    lse = lax.dynamic_slice_in_dim(lse_ref[0], qi * blk_q, blk_q, 0)
+    delta = lax.dynamic_slice_in_dim(delta_ref[0], qi * blk_q, blk_q, 0)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal)
+    p = jnp.exp(s - lse[:, None])
+    p = jnp.where(s <= NEG_INF, 0.0, p)
+    dv_new = dv + p.T @ do
+    dp = do @ v.T
+    ds = p * (dp - delta[:, None])
+    dk_new = dk + ds.T @ q
+    return dk_new, dv_new
+
+  dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
+  dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
+  dk, dv = lax.fori_loop(0, n_qblocks, body, (dk0, dv0))
+  dk_ref[0] = dk.astype(dk_ref.dtype)   # q was pre-scaled; dk absorbs it
+  dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def flash_attention(q, k, v, causal: bool = True, blk_q: int = 128,
                     blk_k: int = 128, interpret: bool = False):
-  """Fused attention. q/k/v: [batch, seq, heads, head_dim].
-
-  Forward runs the Pallas kernel; the backward pass currently recomputes
-  through the dense reference (a fused backward kernel is future work —
-  training still benefits from the fused forward under remat).
-  ``blk_q``/``blk_k`` are clamped to the sequence length; seq must be
-  divisible by the resulting blocks.
-  """
+  """Fused attention with fused backward. q/k/v: [batch, seq, heads,
+  head_dim]; seq must divide by the (clamped) block sizes."""
   # keyword args are normalized here: custom_vjp wants positionals
   return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret):
-  return _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
+  out, _ = _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
+  return out
 
 
 def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
-  out = _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
-  return out, (q, k, v)
+  out, lse = _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
+  return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, blk_q, blk_k, interpret, residuals, g):
-  q, k, v = residuals
-  _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal),
-                   q, k, v)
-  return vjp(g)
+  q, k, v, out, lse = residuals
+  return _flash_backward_impl(q, k, v, out, lse, g, causal, blk_q, blk_k,
+                              interpret)
 
 
 _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _blocks(s, blk_q, blk_k):
+  blk_q = min(blk_q, s)
+  blk_k = min(blk_k, s)
+  assert s % blk_q == 0 and s % blk_k == 0, \
+      "seq %d not divisible by blocks (%d, %d)" % (s, blk_q, blk_k)
+  return blk_q, blk_k
+
+
+def _fold(x, b, s, h, d):
+  return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, s, h, d):
+  return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
                                              "interpret"))
 def _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret):
   b, s, h, d = q.shape
-  blk_q = min(blk_q, s)
-  blk_k = min(blk_k, s)
-  assert s % blk_q == 0 and s % blk_k == 0, \
-      "seq %d not divisible by blocks (%d, %d)" % (s, blk_q, blk_k)
+  blk_q, blk_k = _blocks(s, blk_q, blk_k)
   scale = 1.0 / (d ** 0.5)
+  qf, kf, vf = (_fold(x, b, s, h, d) for x in (q, k, v))
 
-  # [B,S,H,D] -> [B*H, S, D]
-  def _fold(x):
-    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-  qf, kf, vf = _fold(q), _fold(k), _fold(v)
-
-  kernel = functools.partial(_attn_kernel, blk_q=blk_q, blk_k=blk_k,
+  kernel = functools.partial(_attn_fwd_kernel, blk_q=blk_q, blk_k=blk_k,
                              seq_len=s, causal=causal, scale=scale)
-  out = pl.pallas_call(
+  out, lse = pl.pallas_call(
       kernel,
       grid=(b * h, s // blk_q),
       in_specs=[
@@ -129,9 +188,73 @@ def _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret):
           pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
           pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
       ],
-      out_specs=pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-      out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+      out_specs=[
+          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+          jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+      ],
       interpret=interpret,
   )(qf, kf, vf)
 
-  return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+  return _unfold(out, b, s, h, d), lse
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
+                                             "interpret"))
+def _flash_backward_impl(q, k, v, out, lse, g, causal, blk_q, blk_k,
+                         interpret):
+  b, s, h, d = q.shape
+  blk_q, blk_k = _blocks(s, blk_q, blk_k)
+  scale = 1.0 / (d ** 0.5)
+  qf, kf, vf, of, gf = (_fold(x, b, s, h, d) for x in (q, k, v, out, g))
+  # Δ_i = Σ_d dO_id · O_id (softmax-normalization correction term)
+  delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+
+  common = dict(blk_q=blk_q, blk_k=blk_k, seq_len=s, causal=causal,
+                scale=scale)
+  full = lambda i, j: (i, 0, 0)       # noqa: E731
+  full2 = lambda i, j: (i, 0)         # noqa: E731
+
+  dq = pl.pallas_call(
+      functools.partial(_attn_bwd_dq_kernel, **common),
+      grid=(b * h, s // blk_q),
+      in_specs=[
+          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, s, d), full),
+          pl.BlockSpec((1, s, d), full),
+          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
+          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
+      ],
+      out_specs=pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
+      out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+      interpret=interpret,
+  )(qf, kf, vf, gf, lse, delta)
+
+  dk, dv = pl.pallas_call(
+      functools.partial(_attn_bwd_dkv_kernel, **common),
+      grid=(b * h, s // blk_k),
+      in_specs=[
+          pl.BlockSpec((1, s, d), full),
+          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, s, d), full),
+          pl.BlockSpec((1, s), full2),
+          pl.BlockSpec((1, s), full2),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+          jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+      ],
+      interpret=interpret,
+  )(qf, kf, vf, gf, lse, delta)
+
+  return (_unfold(dq, b, s, h, d), _unfold(dk, b, s, h, d),
+          _unfold(dv, b, s, h, d))

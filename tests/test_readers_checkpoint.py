@@ -99,22 +99,28 @@ class TestCheckpointManager:
 
 
 class TestFlashAttentionGrad:
-  def test_gradient_matches_dense(self):
+  @pytest.mark.parametrize("causal,blk_q,blk_k", [
+      (True, 16, 16), (False, 16, 16), (True, 32, 16), (False, 16, 32),
+  ])
+  def test_gradient_matches_dense(self, causal, blk_q, blk_k):
     import jax
     import jax.numpy as jnp
     from tensorflowonspark_tpu.ops import flash_attention
     from tensorflowonspark_tpu.parallel.ring_attention import full_attention
 
     rng = np.random.RandomState(0)
-    q, k, v = (jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32)
+    q, k, v = (jnp.asarray(rng.randn(2, 64, 2, 8), jnp.float32)
                for _ in range(3))
+    # non-uniform cotangents exercise the Δ correction term
+    w = jnp.asarray(rng.randn(2, 64, 2, 8), jnp.float32)
 
     def loss_flash(q, k, v):
-      return jnp.sum(flash_attention(q, k, v, blk_q=16, blk_k=16,
-                                     interpret=True) ** 2)
+      return jnp.sum(w * flash_attention(q, k, v, causal=causal,
+                                         blk_q=blk_q, blk_k=blk_k,
+                                         interpret=True))
 
     def loss_dense(q, k, v):
-      return jnp.sum(full_attention(q, k, v) ** 2)
+      return jnp.sum(w * full_attention(q, k, v, causal=causal))
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
