@@ -8,9 +8,13 @@ dispatch) and partial expert outputs are combined with a single ``psum``
 over the expert axis. Top-1 routing; gating runs replicated (it is a tiny
 matmul), expert FFNs run sharded.
 
-The dense dispatch keeps every token on every expert shard (masked), which
-is exact and simple; an all-to-all token exchange is the future
-communication-optimal variant.
+Two dispatch strategies:
+
+- :func:`moe_ffn` — dense masked dispatch: every token visits every expert
+  shard (masked), combined with one psum. Exact and simple.
+- :func:`moe_ffn_a2a` — GShard-style all-to-all token exchange with
+  capacity bounds: each device runs only its experts on only their
+  assigned tokens (the communication-optimal variant).
 """
 
 import functools
@@ -37,31 +41,42 @@ def init_moe_params(rng, num_experts: int, d_model: int, d_ff: int,
   }
 
 
-def _route(params, x):
-  """Top-1 routing: [T, E] combine weights (gate prob on the argmax)."""
-  logits = x.astype(jnp.float32) @ params["w_gate"].astype(jnp.float32)
+def _gate(x, w_gate):
+  """Shared top-1 gating: (onehot [T, E], gate [T]) — the single source of
+  the routing math for every dispatch strategy."""
+  logits = x.astype(jnp.float32) @ w_gate.astype(jnp.float32)
   probs = jax.nn.softmax(logits, axis=-1)
   top = jnp.argmax(probs, axis=-1)
   onehot = jax.nn.one_hot(top, probs.shape[-1], dtype=probs.dtype)
-  return onehot * jnp.max(probs, axis=-1, keepdims=True)
+  return onehot, jnp.max(probs, axis=-1)
+
+
+def _route(params, x):
+  """Top-1 routing: (dispatch [T, E] binary one-hot, combine [T, E] gated).
+
+  Dispatch selects which expert processes each token (binary — experts see
+  the raw token); combine weights the expert output by the gate
+  probability (the standard single-gating semantics)."""
+  onehot, gate = _gate(x, params["w_gate"])
+  return onehot, onehot * gate[:, None]
 
 
 def moe_ffn_reference(params, x):
   """Single-device reference: x [T, D] -> [T, D]."""
-  combine = _route(params, x)                          # [T, E]
+  dispatch, combine = _route(params, x)                # [T, E] each
   xf = x.astype(jnp.float32)
-  h = jax.nn.relu(jnp.einsum("te,td,edf->etf", combine, xf,
+  h = jax.nn.relu(jnp.einsum("te,td,edf->etf", dispatch, xf,
                              params["w_up"].astype(jnp.float32)))
   out = jnp.einsum("etf,efd->etd", h,
                    params["w_down"].astype(jnp.float32))
   return jnp.einsum("etd,te->td", out, combine).astype(x.dtype)
 
 
-def _moe_local(x, combine, w_up, w_down):
+def _moe_local(x, dispatch, combine, w_up, w_down):
   """shard_map body: local expert slice. x [T,D] replicated over expert;
-  combine [T,E_local]; w_up [E_local,D,F]; w_down [E_local,F,D]."""
+  dispatch/combine [T,E_local]; w_up [E_local,D,F]; w_down [E_local,F,D]."""
   xf = x.astype(jnp.float32)
-  h = jax.nn.relu(jnp.einsum("te,td,edf->etf", combine, xf,
+  h = jax.nn.relu(jnp.einsum("te,td,edf->etf", dispatch, xf,
                              w_up.astype(jnp.float32)))
   out = jnp.einsum("etf,efd->etd", h, w_down.astype(jnp.float32))
   partial = jnp.einsum("etd,te->td", out, combine)
@@ -73,14 +88,78 @@ def moe_ffn(params, x, mesh):
   data axes as usual); expert weights sharded over the expert axis."""
   from jax import shard_map
 
-  combine = _route(params, x)                          # [T, E] replicated
+  dispatch, combine = _route(params, x)                # [T, E] replicated
   batch_axes = mesh_lib.data_axes(mesh) or None
   fn = shard_map(
       _moe_local, mesh=mesh,
       in_specs=(P(batch_axes), P(batch_axes, mesh_lib.AXIS_EXPERT),
+                P(batch_axes, mesh_lib.AXIS_EXPERT),
                 P(mesh_lib.AXIS_EXPERT), P(mesh_lib.AXIS_EXPERT)),
       out_specs=P(batch_axes), check_vma=False)
-  return fn(x, combine, params["w_up"], params["w_down"])
+  return fn(x, dispatch, combine, params["w_up"], params["w_down"])
+
+
+def _moe_a2a_local(x, w_gate, w_up, w_down, capacity: int):
+  """shard_map body for all-to-all dispatch (GShard-style).
+
+  x: [T_local, D] (tokens sharded over data×expert axes);
+  w_gate replicated [D, E]; w_up/w_down sharded [E_local, ...].
+  Tokens route to global experts, dispatch tensors are exchanged over the
+  ``expert`` axis with two all-to-alls, and each device runs only its own
+  experts on only their assigned tokens (capacity-bounded; overflow tokens
+  are dropped, the standard top-1 capacity semantics).
+  """
+  xf = x.astype(jnp.float32)
+  onehot, gate = _gate(x, w_gate)                  # [T, E], [T]
+  num_experts = w_gate.shape[-1]
+  # position of each token within its expert's queue
+  pos = jnp.cumsum(onehot, axis=0) * onehot - onehot            # [T, E]
+  pos_scalar = jnp.sum(pos, axis=-1).astype(jnp.int32)          # [T]
+  keep = (pos_scalar < capacity).astype(jnp.float32)
+  dispatch = (onehot * keep[:, None])[:, :, None] * \
+      jax.nn.one_hot(pos_scalar, capacity, dtype=jnp.float32)[:, None, :]
+  combine = dispatch * gate[:, None, None]          # [T, E, C]
+
+  expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)   # [E, C, D]
+  # exchange: every device sends each peer its slice of the expert dim
+  expert_in = lax.all_to_all(expert_in, mesh_lib.AXIS_EXPERT,
+                             split_axis=0, concat_axis=1, tiled=True)
+  h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in,
+                             w_up.astype(jnp.float32)))
+  out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(jnp.float32))
+  out = lax.all_to_all(out, mesh_lib.AXIS_EXPERT,
+                       split_axis=1, concat_axis=0, tiled=True)
+  y = jnp.einsum("ecd,tec->td", out, combine)
+  return y.astype(x.dtype)
+
+
+def moe_ffn_a2a(params, x, mesh, capacity_factor: float = 2.0):
+  """Expert-parallel MoE with all-to-all token dispatch.
+
+  Communication-optimal variant of :func:`moe_ffn`: tokens are sharded
+  over the data AND expert axes, each device dispatches its tokens to the
+  owning experts with two ``all_to_all`` collectives (ICI neighbor
+  traffic), and only capacity-bounded expert work runs per device —
+  instead of every device touching every token. Top-1 routing with
+  capacity ``ceil(T_local / E) * capacity_factor`` per expert per shard;
+  overflow tokens pass through with zero output (standard semantics).
+  """
+  from jax import shard_map
+
+  num_experts = params["w_gate"].shape[-1]
+  batch_axes = mesh_lib.data_axes(mesh)
+  token_axes = tuple(batch_axes) + (mesh_lib.AXIS_EXPERT,)
+  shards = mesh_lib.axis_size(mesh, *token_axes)
+  t_local = x.shape[0] // shards
+  capacity = max(1, int(-(-t_local // num_experts) * capacity_factor))
+
+  fn = functools.partial(_moe_a2a_local, capacity=capacity)
+  return shard_map(
+      fn, mesh=mesh,
+      in_specs=(P(token_axes), P(), P(mesh_lib.AXIS_EXPERT),
+                P(mesh_lib.AXIS_EXPERT)),
+      out_specs=P(token_axes), check_vma=False)(
+          x, params["w_gate"], params["w_up"], params["w_down"])
 
 
 def shard_moe_params(params, mesh):

@@ -157,6 +157,34 @@ class TestExpertParallel:
         EP.init_moe_params(jax.random.PRNGKey(0), 8, 16, 32), mesh)
     assert len(params["w_up"].sharding.device_set) == 8
 
+  def test_a2a_matches_reference_with_ample_capacity(self, devices):
+    from tensorflowonspark_tpu.parallel import expert_parallel as EP
+
+    mesh = M.build_mesh(M.MeshSpec(data=2, expert=4), devices=devices)
+    params = EP.init_moe_params(jax.random.PRNGKey(0), num_experts=8,
+                                d_model=16, d_ff=32)
+    x = jnp.asarray(np.random.RandomState(0).randn(64, 16), jnp.float32)
+    ref = EP.moe_ffn_reference(params, x)
+    sharded = EP.shard_moe_params(params, mesh)
+    # capacity_factor high enough that no token is dropped
+    out = jax.jit(lambda p, x: EP.moe_ffn_a2a(p, x, mesh,
+                                              capacity_factor=8.0))(
+        sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+  def test_a2a_capacity_drops_gracefully(self, devices):
+    from tensorflowonspark_tpu.parallel import expert_parallel as EP
+    mesh = M.build_mesh(M.MeshSpec(expert=4), devices=devices[:4])
+    params = EP.init_moe_params(jax.random.PRNGKey(0), 4, 8, 16)
+    x = jnp.asarray(np.random.RandomState(1).randn(32, 8), jnp.float32)
+    sharded = EP.shard_moe_params(params, mesh)
+    # tiny capacity: result must be finite (dropped tokens -> zeros)
+    out = jax.jit(lambda p, x: EP.moe_ffn_a2a(p, x, mesh,
+                                              capacity_factor=0.5))(
+        sharded, x)
+    assert np.isfinite(np.asarray(out)).all()
+
   def test_differentiable(self, devices):
     from tensorflowonspark_tpu.parallel import expert_parallel as EP
     mesh = M.build_mesh(M.MeshSpec(expert=4), devices=devices[:4])
