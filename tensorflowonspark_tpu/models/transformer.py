@@ -66,7 +66,7 @@ class Attention(nn.Module):
   mesh: Optional[Any] = None
 
   @nn.compact
-  def __call__(self, x, positions):
+  def __call__(self, x, positions, decode: bool = False):
     cfg = self.cfg
     dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
         feats, axis=-1, dtype=cfg.dtype, use_bias=False, name=name,
@@ -76,6 +76,10 @@ class Attention(nn.Module):
     q = dense(qkv_shape, ("embed", "heads", "kv"), "q")(x)
     k = dense(qkv_shape, ("embed", "heads", "kv"), "k")(x)
     v = dense(qkv_shape, ("embed", "heads", "kv"), "v")(x)
+
+    if decode:
+      return self._decode_attend(q, k, v)
+
     q = _rotary(q, positions)
     k = _rotary(k, positions)
 
@@ -94,12 +98,53 @@ class Attention(nn.Module):
       else:
         out = ra.full_attention(q, k, v, causal=True)
 
-    out = nn.DenseGeneral(
+    return self._out_proj(out)
+
+  def _out_proj(self, out):
+    cfg = self.cfg
+    return nn.DenseGeneral(
         cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, use_bias=False,
         name="out",
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.lecun_normal(), ("heads", "kv", "embed")))(out)
-    return out
+
+  def _decode_attend(self, q, k, v):
+    """Incremental attention against a KV cache (serving path).
+
+    Writes the new keys/values at the cache cursor, attends the query
+    block against everything cached so far, and advances the cursor.
+    Cache shape is [batch, max_seq_len, heads, head_dim] per layer.
+    """
+    cfg = self.cfg
+    b, seg, h, d = q.shape
+    cached_k = self.variable(
+        "cache", "cached_k", jnp.zeros, (b, cfg.max_seq_len, h, d), cfg.dtype)
+    cached_v = self.variable(
+        "cache", "cached_v", jnp.zeros, (b, cfg.max_seq_len, h, d), cfg.dtype)
+    cursor = self.variable("cache", "index",
+                           lambda: jnp.zeros((), jnp.int32))
+    idx = cursor.value
+
+    positions = idx + jnp.broadcast_to(jnp.arange(seg), (b, seg))
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+    cached_k.value = jax.lax.dynamic_update_slice(
+        cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+    cached_v.value = jax.lax.dynamic_update_slice(
+        cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+    cursor.value = idx + seg
+
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        cached_k.value.astype(jnp.float32)) * scale
+    q_pos = idx + jnp.arange(seg)[:, None]          # [seg, 1]
+    k_pos = jnp.arange(cfg.max_seq_len)[None, :]    # [1, max]
+    mask = (k_pos <= q_pos)[None, None]             # causal + unwritten
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                     cached_v.value.astype(jnp.float32)).astype(q.dtype)
+    return self._out_proj(out)
 
 
 class MLPBlock(nn.Module):
@@ -123,12 +168,15 @@ class Block(nn.Module):
   mesh: Optional[Any] = None
 
   @nn.compact
-  def __call__(self, x, positions):
+  def __call__(self, x, positions, decode: bool = False):
     cfg = self.cfg
     y = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln1")(x)
-    x = x + Attention(cfg, self.mesh, name="attn")(y, positions)
+    x = x + Attention(cfg, self.mesh, name="attn")(y, positions,
+                                                   decode=decode)
     y = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln2")(x)
     x = x + MLPBlock(cfg, name="mlp")(y)
+    if decode:
+      return x
     return nn.with_logical_constraint(x, ("batch", "sequence", "embed"))
 
 
@@ -138,7 +186,7 @@ class Transformer(nn.Module):
   mesh: Optional[Any] = None
 
   @nn.compact
-  def __call__(self, tokens):
+  def __call__(self, tokens, decode: bool = False):
     cfg = self.cfg
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
     emb = nn.Embed(
@@ -146,13 +194,15 @@ class Transformer(nn.Module):
         embedding_init=nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")))
     x = emb(tokens)
-    x = nn.with_logical_constraint(x, ("batch", "sequence", "embed"))
+    if not decode:
+      x = nn.with_logical_constraint(x, ("batch", "sequence", "embed"))
 
     block = Block
-    if cfg.remat:
-      block = nn.remat(Block, static_argnums=())
+    if cfg.remat and not decode:
+      block = nn.remat(Block)
     for i in range(cfg.num_layers):
-      x = block(cfg, self.mesh, name="layer_%d" % i)(x, positions)
+      layer = block(cfg, self.mesh, name="layer_%d" % i)
+      x = layer(x, positions, True) if decode else layer(x, positions)
 
     x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f")(x)
     # tied output projection (attend to the embedding table)
@@ -185,15 +235,70 @@ def greedy_generate(params, cfg: TransformerConfig, prompt, num_steps: int,
   """Greedy autoregressive decoding (jit-compiled fixed-length loop).
 
   prompt: int32 [batch, prompt_len]. Returns [batch, prompt_len+num_steps].
-  Recomputes the full forward per step (functional and simple); a KV-cache
-  decode path is a future optimization. The compiled loop is cached per
-  (config, prompt_len, num_steps).
+  Recomputes the full forward per step — simple and cache-free; use
+  :func:`greedy_generate_kv` for the O(1)-per-token serving path. The
+  compiled loop is cached per (config, prompt_len, num_steps).
   """
   del mesh  # generation runs wherever params live; sharding via params
   b, plen = prompt.shape
   buf = jnp.zeros((b, plen + num_steps), jnp.int32)
   buf = lax.dynamic_update_slice(buf, prompt.astype(jnp.int32), (0, 0))
   return _generate_fn(cfg, plen, num_steps)(params, buf)
+
+
+@functools.lru_cache(maxsize=8)
+def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
+                    num_steps: int):
+  """Cached jitted KV-cache decode: prefill once, then one token per step
+  against the per-layer key/value cache — O(1) attention work per new
+  token instead of a full-sequence recompute."""
+  model = Transformer(cfg)
+
+  def decode(params, prompt):
+    # init runs the decode path on a dummy token (advancing the cursor and
+    # writing a key); zero the tree so decoding starts from a clean cache
+    cache = jax.tree.map(
+        jnp.zeros_like,
+        model.init(jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32),
+                   decode=True)["cache"])
+    variables = {"params": params, "cache": cache}
+    logits, mutated = model.apply(variables, prompt, decode=True,
+                                  mutable=["cache"])
+    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+      cache, tok = carry
+      logits, mutated = model.apply({"params": params, "cache": cache},
+                                    tok[:, None], decode=True,
+                                    mutable=["cache"])
+      new = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+      return (mutated["cache"], new), new
+
+    # prefill produced g_1; each scan iteration computes one further token
+    _, toks = lax.scan(step, (mutated["cache"], nxt), None,
+                       length=num_steps - 1)
+    generated = jnp.concatenate([nxt[:, None], toks.T], axis=1) \
+        if num_steps > 1 else nxt[:, None]
+    return jnp.concatenate([prompt, generated], axis=1)
+
+  return jax.jit(decode)
+
+
+def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
+                       num_steps: int):
+  """Greedy decoding with a per-layer KV cache (the serving path).
+
+  Semantically identical to :func:`greedy_generate`, but each new token
+  attends against cached keys/values rather than recomputing the full
+  prefix — requires prompt_len + num_steps <= cfg.max_seq_len.
+  """
+  b, plen = prompt.shape
+  if plen + num_steps > cfg.max_seq_len:
+    raise ValueError(
+        "generation of %d tokens from a %d-token prompt exceeds the "
+        "cfg.max_seq_len=%d cache" % (num_steps, plen, cfg.max_seq_len))
+  return _kv_generate_fn(cfg, b, plen, num_steps)(
+      params, prompt.astype(jnp.int32))
 
 
 def causal_lm_loss(logits, tokens):

@@ -109,6 +109,61 @@ class TestTransformer:
     np.testing.assert_array_equal(generated,
                                   [4, 5, 6, 7, 0, 1, 2, 3])
 
+  def test_kv_cache_generate_matches_recompute(self):
+    """The KV-cache decode path must agree with full-recompute decoding:
+    logits numerically close on the prefill, token streams identical on a
+    trained (decisive-logits) model."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=16, num_layers=2, num_heads=2,
+                                d_model=64, d_ff=128, max_seq_len=32,
+                                remat=False, dtype=jnp.float32)
+    state = tfm.create_state(jax.random.PRNGKey(3), cfg,
+                             learning_rate=3e-3, seq_len=24)
+
+    # prefill logits: decode path vs normal forward
+    model = tfm.Transformer(cfg)
+    prompt = jnp.asarray([[5, 9, 2, 11], [1, 1, 7, 0]], jnp.int32)
+    ref_logits = model.apply({"params": state.params}, prompt)
+    cache = jax.tree.map(
+        jnp.zeros_like,
+        model.init(jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+                   decode=True)["cache"])
+    kv_logits, _ = model.apply({"params": state.params, "cache": cache},
+                               prompt, decode=True, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(kv_logits),
+                               np.asarray(ref_logits), atol=1e-4,
+                               rtol=1e-4)
+
+    # train until the model is decisive, then token streams must be equal
+    cycle = np.tile(np.arange(8), 10)
+    tokens = jnp.asarray(np.stack([cycle[i:i + 24] for i in range(8)]),
+                         jnp.int32)
+
+    @jax.jit
+    def step(state, tokens):
+      def loss_fn(p):
+        return tfm.causal_lm_loss(
+            state.apply_fn({"params": p}, tokens), tokens)
+      loss, grads = jax.value_and_grad(loss_fn)(state.params)
+      return state.apply_gradients(grads=grads), loss
+
+    for _ in range(150):
+      state, _ = step(state, tokens)
+    prompt = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    full = tfm.greedy_generate(state.params, cfg, prompt, num_steps=10)
+    kv = tfm.greedy_generate_kv(state.params, cfg, prompt, num_steps=10)
+    np.testing.assert_array_equal(np.asarray(kv), np.asarray(full))
+
+  def test_kv_cache_respects_max_len(self):
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=8, num_layers=1, num_heads=2,
+                                d_model=16, d_ff=32, max_seq_len=8,
+                                remat=False)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=4)
+    with pytest.raises(AssertionError, match="max_seq_len"):
+      tfm.greedy_generate_kv(state.params, cfg,
+                             jnp.zeros((1, 4), jnp.int32), num_steps=8)
+
   def test_single_device_learns(self):
     from tensorflowonspark_tpu.models import transformer as tfm
     cfg = tfm.TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
