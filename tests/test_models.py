@@ -160,7 +160,7 @@ class TestTransformer:
                                 d_model=16, d_ff=32, max_seq_len=8,
                                 remat=False)
     state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=4)
-    with pytest.raises(AssertionError, match="max_seq_len"):
+    with pytest.raises(ValueError, match="max_seq_len"):
       tfm.greedy_generate_kv(state.params, cfg,
                              jnp.zeros((1, 4), jnp.int32), num_steps=8)
 
