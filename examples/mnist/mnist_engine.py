@@ -17,6 +17,11 @@ import sys
 sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), os.pardir, os.pardir)))
 
+# some sandboxes register a remote-accelerator JAX plugin that hijacks even
+# CPU-only runs (see tests/conftest.py); drop its trigger so the examples
+# run anywhere. Harmless where the variable does not exist.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 
 def main_fn(args, ctx):
   import jax

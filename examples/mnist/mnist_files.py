@@ -16,6 +16,11 @@ import sys
 sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), os.pardir, os.pardir)))
 
+# some sandboxes register a remote-accelerator JAX plugin that hijacks even
+# CPU-only runs (see tests/conftest.py); drop its trigger so the examples
+# run anywhere. Harmless where the variable does not exist.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 
 def main_fn(args, ctx):
   import jax
@@ -29,7 +34,7 @@ def main_fn(args, ctx):
                              else mnist.MLP())
   bs = args.batch_size
   for step in range(args.steps):
-    lo = (step * bs) % max(1, len(images) - bs)
+    lo = (step * bs) % max(1, len(images) - bs + 1)
     state, loss = mnist.train_step(state, images[lo:lo + bs],
                                    labels[lo:lo + bs])
     if step % 50 == 0:

@@ -15,6 +15,11 @@ import sys
 sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), os.pardir, os.pardir)))
 
+# some sandboxes register a remote-accelerator JAX plugin that hijacks even
+# CPU-only runs (see tests/conftest.py); drop its trigger so the examples
+# run anywhere. Harmless where the variable does not exist.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 
 def main_fn(args, ctx):
   import jax
@@ -28,7 +33,7 @@ def main_fn(args, ctx):
                            image_shape=(args.size, args.size, 3))
   bs = args.batch_size
   for step in range(args.steps):
-    lo = (step * bs) % max(1, args.num_samples - bs)
+    lo = (step * bs) % max(1, args.num_samples - bs + 1)
     state, loss = seg.train_step(state, jnp.asarray(images[lo:lo + bs]),
                                  jnp.asarray(masks[lo:lo + bs]))
     if step % 5 == 0:
