@@ -61,6 +61,21 @@ def _rotary(x, positions):
       [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
+def _flash_eligible(cfg: TransformerConfig, seq_len: int) -> bool:
+  """Whether the Pallas flash kernel should handle this attention.
+
+  Requires a TPU backend (Pallas doesn't lower elsewhere outside
+  interpret mode — so an explicit attention_impl="flash" still falls back
+  to dense off-TPU) and a block-divisible sequence length; "dense" always
+  opts out.
+  """
+  if cfg.attention_impl == "dense":
+    return False
+  if jax.default_backend() != "tpu":
+    return False
+  return seq_len % min(128, max(1, seq_len)) == 0
+
+
 class Attention(nn.Module):
   cfg: TransformerConfig
   mesh: Optional[Any] = None
@@ -84,15 +99,12 @@ class Attention(nn.Module):
     k = _rotary(k, positions)
 
     if cfg.use_ring_attention and self.mesh is not None:
-      out = ra.ring_attention(q, k, v, self.mesh, causal=True)
+      seq_shards = self.mesh.shape.get(mesh_lib.AXIS_SEQUENCE, 1)
+      local_seq = q.shape[1] // max(1, seq_shards)
+      out = ra.ring_attention(q, k, v, self.mesh, causal=True,
+                              use_flash=_flash_eligible(cfg, local_seq))
     else:
-      impl = cfg.attention_impl
-      if impl == "auto":
-        seq = q.shape[1]
-        divisible = seq % min(128, seq) == 0
-        impl = ("flash" if jax.default_backend() == "tpu" and divisible
-                else "dense")
-      if impl == "flash":
+      if _flash_eligible(cfg, q.shape[1]):
         from tensorflowonspark_tpu.ops import flash_attention
         out = flash_attention(q, k, v, causal=True)
       else:

@@ -1,50 +1,70 @@
-"""Fused causal attention as Pallas TPU kernels — forward AND backward.
+"""Fused attention as Pallas TPU kernels — forward, backward, and
+ring-composable block partials.
 
 Flash-attention-style: the forward streams over K/V blocks with an online
-softmax carried in VMEM scratch, so the [S, S] score matrix never hits HBM
-— scores are produced on the MXU, normalized on the VPU, and accumulated in
-float32 while inputs stay bfloat16. The forward also emits the per-row
-logsumexp, which the backward kernels use to rebuild probabilities
-blockwise: dQ comes from a (batch·heads, q-block) grid and dK/dV from a
-(batch·heads, k-block) grid, so the backward is fused and HBM-light too
-(no dense [S, S] materialization anywhere in training).
+softmax carried in VMEM scratch, so the [Sq, Sk] score matrix never hits
+HBM — scores are produced on the MXU, normalized on the VPU, accumulated
+in float32 while inputs stay bfloat16. The forward also emits the per-row
+logsumexp; the backward is two fused kernels (dQ over q-blocks, dK/dV over
+k-blocks) using the standard Δ correction, so training never materializes
+dense scores either.
 
-``interpret=True`` runs the same kernels on CPU for tests; on TPU the
-MXU/VPU path is used. Layout: [batch, seq, heads, head_dim] to match
-``parallel.ring_attention``.
+:func:`flash_attention` is full (self-)attention. :func:`flash_attention_block`
+computes a PARTIAL attention of local queries against one remote KV block
+(absolute position bases passed as traced scalars) and returns
+(normalized-partial output, logsumexp) — the building block
+``parallel.ring_attention`` merges across ring steps; its custom VJP
+accepts cotangents for both outputs (the lse cotangent folds into Δ).
+
+``interpret=True`` runs the same kernels on CPU for tests. Layout:
+[batch, seq, heads, head_dim].
 """
 
 import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 
 
-def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal):
-  """Scaled scores for one (q-block, k-block) pair with causal masking."""
+def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base=0, k_base=0):
+  """Scaled scores for one (q-block, k-block) pair with causal masking.
+
+  ``q_base``/``k_base`` are absolute position offsets (traced scalars are
+  fine) so the same kernel works for ring-attention blocks where the KV
+  block comes from another sequence shard.
+  """
   s = q @ k.astype(jnp.float32).T
   if causal:
-    q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-    k_pos = ki * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    q_pos = q_base + qi * blk_q + lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0)
+    k_pos = k_base + ki * blk_k + lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 1)
     s = jnp.where(k_pos <= q_pos, s, NEG_INF)
   return s
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_q: int,
-                     blk_k: int, seq_len: int, causal: bool, scale: float):
+# --- kernels ---------------------------------------------------------------
+
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, qb_ref, kb_ref, o_ref, lse_ref, *,
+                     blk_q: int, blk_k: int, kv_len: int, causal: bool,
+                     scale: float):
   qi = pl.program_id(1)
+  q_base = qb_ref[0]
+  k_base = kb_ref[0]
   q = q_ref[0].astype(jnp.float32) * scale          # [blk_q, D]
-  n_kblocks = seq_len // blk_k
+  n_kblocks = kv_len // blk_k
 
   def body(ki, carry):
     m, l, acc = carry
     k = lax.dynamic_slice_in_dim(k_ref[0], ki * blk_k, blk_k, 0)
     v = lax.dynamic_slice_in_dim(v_ref[0], ki * blk_k, blk_k, 0)
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
     m_blk = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m, m_blk)
     m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
@@ -62,28 +82,30 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_q: int,
 
   l_safe = jnp.where(l == 0.0, 1.0, l)
   o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-  # logsumexp of each row's scores (NEG_INF rows stay NEG_INF)
-  lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
-  lse_ref[0] = lse
+  lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
 
 
 def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dq_ref, *, blk_q: int, blk_k: int, seq_len: int,
-                        causal: bool, scale: float):
+                        qb_ref, kb_ref, dq_ref, *, blk_q: int, blk_k: int,
+                        kv_len: int, causal: bool, scale: float):
   """dQ for one q-block: dQ = scale · Σ_k [P ⊙ (dO·Vᵀ − Δ)] · K."""
   qi = pl.program_id(1)
+  q_base = qb_ref[0]
+  k_base = kb_ref[0]
   q = q_ref[0].astype(jnp.float32) * scale
   do = do_ref[0].astype(jnp.float32)                # [blk_q, D]
   lse = lse_ref[0]                                  # [blk_q]
   delta = delta_ref[0]                              # [blk_q]
-  n_kblocks = seq_len // blk_k
+  n_kblocks = kv_len // blk_k
 
   def body(ki, dq):
     k = lax.dynamic_slice_in_dim(k_ref[0], ki * blk_k, blk_k, 0)
     v = lax.dynamic_slice_in_dim(v_ref[0], ki * blk_k, blk_k, 0)
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal)
-    p = jnp.exp(s - lse[:, None])
-    p = jnp.where(s <= NEG_INF, 0.0, p)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
+    p = jnp.exp(s - lse_safe[:, None])
+    p = jnp.where(jnp.logical_or(s <= NEG_INF, (lse <= NEG_INF)[:, None]),
+                  0.0, p)
     dp = do @ v.astype(jnp.float32).T               # [blk_q, blk_k]
     ds = p * (dp - delta[:, None])
     return dq + ds @ k.astype(jnp.float32)
@@ -94,13 +116,16 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dk_ref, dv_ref, *, blk_q: int, blk_k: int,
-                         seq_len: int, causal: bool, scale: float):
+                         qb_ref, kb_ref, dk_ref, dv_ref, *, blk_q: int,
+                         blk_k: int, q_len: int, causal: bool,
+                         scale: float):
   """dK/dV for one k-block: dV = Σ_q Pᵀ·dO; dK = scale · Σ_q dSᵀ·Q."""
   ki = pl.program_id(1)
+  q_base = qb_ref[0]
+  k_base = kb_ref[0]
   k = k_ref[0].astype(jnp.float32)                  # [blk_k, D]
   v = v_ref[0].astype(jnp.float32)
-  n_qblocks = seq_len // blk_q
+  n_qblocks = q_len // blk_q
 
   def body(qi, carry):
     dk, dv = carry
@@ -110,9 +135,11 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         .astype(jnp.float32)
     lse = lax.dynamic_slice_in_dim(lse_ref[0], qi * blk_q, blk_q, 0)
     delta = lax.dynamic_slice_in_dim(delta_ref[0], qi * blk_q, blk_q, 0)
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal)
-    p = jnp.exp(s - lse[:, None])
-    p = jnp.where(s <= NEG_INF, 0.0, p)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
+    p = jnp.exp(s - lse_safe[:, None])
+    p = jnp.where(jnp.logical_or(s <= NEG_INF, (lse <= NEG_INF)[:, None]),
+                  0.0, p)
     dv_new = dv + p.T @ do
     dp = do @ v.T
     ds = p * (dp - delta[:, None])
@@ -126,135 +153,230 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+# --- shared impl -----------------------------------------------------------
+
+
+def _blocks(s_q, s_kv, blk_q, blk_k):
+  blk_q = min(blk_q, s_q)
+  blk_k = min(blk_k, s_kv)
+  assert s_q % blk_q == 0 and s_kv % blk_k == 0, \
+      "seq (%d, %d) not divisible by blocks (%d, %d)" % (s_q, s_kv,
+                                                         blk_q, blk_k)
+  return blk_q, blk_k
+
+
+def _fold(x):
+  b, s, h, d = x.shape
+  return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h):
+  bh, s, d = x.shape
+  return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _base_arrays(q_base, kv_base, bh):
+  qb = jnp.broadcast_to(jnp.asarray(q_base, jnp.int32), (bh,))
+  kb = jnp.broadcast_to(jnp.asarray(kv_base, jnp.int32), (bh,))
+  return qb, kb
+
+
+_BASE_SPEC = pl.BlockSpec((1,), lambda i, j: (i,))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
+                                             "interpret"))
+def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret):
+  b, s_q, h, d = q.shape
+  s_kv = k.shape[1]
+  blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
+  scale = 1.0 / (d ** 0.5)
+  qf, kf, vf = _fold(q), _fold(k), _fold(v)
+  qb, kb = _base_arrays(q_base, kv_base, b * h)
+
+  kernel = functools.partial(_attn_fwd_kernel, blk_q=blk_q, blk_k=blk_k,
+                             kv_len=s_kv, causal=causal, scale=scale)
+  out, lse = pl.pallas_call(
+      kernel,
+      grid=(b * h, s_q // blk_q),
+      in_specs=[
+          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0)),
+          pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0)),
+          _BASE_SPEC, _BASE_SPEC,
+      ],
+      out_specs=[
+          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
+          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+          jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+      ],
+      interpret=interpret,
+  )(qf, kf, vf, qb, kb)
+
+  return _unfold(out, b, h), lse.reshape(b, h, s_q)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
+                                             "interpret"))
+def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
+              blk_k, interpret):
+  b, s_q, h, d = q.shape
+  s_kv = k.shape[1]
+  blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
+  scale = 1.0 / (d ** 0.5)
+  qf, kf, vf, of, gf = (_fold(x) for x in (q, k, v, out, g))
+  lse_f = lse.reshape(b * h, s_q)
+  qb, kb = _base_arrays(q_base, kv_base, b * h)
+
+  # Δ_i = Σ_d dO·O  (+ the lse cotangent folds in with opposite sign:
+  # dS = P ⊙ (dP − Δ + g_lse))
+  delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+  if g_lse is not None:
+    delta = delta - g_lse.reshape(b * h, s_q)
+
+  full3 = lambda i, j: (i, 0, 0)      # noqa: E731
+  full2 = lambda i, j: (i, 0)         # noqa: E731
+  row3 = lambda i, j: (i, j, 0)       # noqa: E731
+  row2 = lambda i, j: (i, j)          # noqa: E731
+
+  dq = pl.pallas_call(
+      functools.partial(_attn_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
+                        kv_len=s_kv, causal=causal, scale=scale),
+      grid=(b * h, s_q // blk_q),
+      in_specs=[
+          pl.BlockSpec((1, blk_q, d), row3),
+          pl.BlockSpec((1, s_kv, d), full3),
+          pl.BlockSpec((1, s_kv, d), full3),
+          pl.BlockSpec((1, blk_q, d), row3),
+          pl.BlockSpec((1, blk_q), row2),
+          pl.BlockSpec((1, blk_q), row2),
+          _BASE_SPEC, _BASE_SPEC,
+      ],
+      out_specs=pl.BlockSpec((1, blk_q, d), row3),
+      out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+      interpret=interpret,
+  )(qf, kf, vf, gf, lse_f, delta, qb, kb)
+
+  dk, dv = pl.pallas_call(
+      functools.partial(_attn_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
+                        q_len=s_q, causal=causal, scale=scale),
+      grid=(b * h, s_kv // blk_k),
+      in_specs=[
+          pl.BlockSpec((1, s_q, d), full3),
+          pl.BlockSpec((1, blk_k, d), row3),
+          pl.BlockSpec((1, blk_k, d), row3),
+          pl.BlockSpec((1, s_q, d), full3),
+          pl.BlockSpec((1, s_q), full2),
+          pl.BlockSpec((1, s_q), full2),
+          _BASE_SPEC, _BASE_SPEC,
+      ],
+      out_specs=[
+          pl.BlockSpec((1, blk_k, d), row3),
+          pl.BlockSpec((1, blk_k, d), row3),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
+          jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
+      ],
+      interpret=interpret,
+  )(qf, kf, vf, gf, lse_f, delta, qb, kb)
+
+  return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)
+
+
+# --- public: full attention -------------------------------------------------
+
+
 def flash_attention(q, k, v, causal: bool = True, blk_q: int = 128,
                     blk_k: int = 128, interpret: bool = False):
-  """Fused attention with fused backward. q/k/v: [batch, seq, heads,
-  head_dim]; seq must divide by the (clamped) block sizes."""
-  # keyword args are normalized here: custom_vjp wants positionals
+  """Fused (self-)attention with fused backward. q/k/v: [batch, seq,
+  heads, head_dim]; seq must divide by the (clamped) block sizes."""
   return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret):
-  out, _ = _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
+  out, _ = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret)
   return out
 
 
 def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
-  out, lse = _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret)
+  out, lse = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret)
   return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, blk_q, blk_k, interpret, residuals, g):
   q, k, v, out, lse = residuals
-  return _flash_backward_impl(q, k, v, out, lse, g, causal, blk_q, blk_k,
-                              interpret)
+  return _bwd_impl(q, k, v, out, lse, g, None, 0, 0, causal, blk_q, blk_k,
+                   interpret)
 
 
 _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _blocks(s, blk_q, blk_k):
-  blk_q = min(blk_q, s)
-  blk_k = min(blk_k, s)
-  assert s % blk_q == 0 and s % blk_k == 0, \
-      "seq %d not divisible by blocks (%d, %d)" % (s, blk_q, blk_k)
-  return blk_q, blk_k
+# --- public: ring-composable block partial ----------------------------------
 
 
-def _fold(x, b, s, h, d):
-  return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
+                          blk_q: int = 128, blk_k: int = 128,
+                          interpret: bool = False):
+  """Partial attention of local queries against ONE KV block.
+
+  q: [B, Sq, H, D] at absolute positions ``q_base + arange(Sq)``;
+  k/v: [B, Sk, H, D] at ``kv_base + arange(Sk)`` (bases may be traced —
+  inside shard_map they depend on ``lax.axis_index``). Returns
+  (normalized partial output, logsumexp) — merge partials across blocks
+  with :func:`merge_partials`. Differentiable in q/k/v (including through
+  the lse output).
+  """
+  return _flash_block_vjp(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
+                          interpret)
 
 
-def _unfold(x, b, s, h, d):
-  return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_block_vjp(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
+                     interpret):
+  return _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
+                   interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
-                                             "interpret"))
-def _flash_forward_impl(q, k, v, causal, blk_q, blk_k, interpret):
-  b, s, h, d = q.shape
-  blk_q, blk_k = _blocks(s, blk_q, blk_k)
-  scale = 1.0 / (d ** 0.5)
-  qf, kf, vf = (_fold(x, b, s, h, d) for x in (q, k, v))
-
-  kernel = functools.partial(_attn_fwd_kernel, blk_q=blk_q, blk_k=blk_k,
-                             seq_len=s, causal=causal, scale=scale)
-  out, lse = pl.pallas_call(
-      kernel,
-      grid=(b * h, s // blk_q),
-      in_specs=[
-          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-          pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-      ],
-      out_specs=[
-          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
-      ],
-      out_shape=[
-          jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-          jax.ShapeDtypeStruct((b * h, s), jnp.float32),
-      ],
-      interpret=interpret,
-  )(qf, kf, vf)
-
-  return _unfold(out, b, s, h, d), lse
+def _flash_block_fwd(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
+                     interpret):
+  out, lse = _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
+                       interpret)
+  return (out, lse), (q, k, v, out, lse, q_base, kv_base)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
-                                             "interpret"))
-def _flash_backward_impl(q, k, v, out, lse, g, causal, blk_q, blk_k,
-                         interpret):
-  b, s, h, d = q.shape
-  blk_q, blk_k = _blocks(s, blk_q, blk_k)
-  scale = 1.0 / (d ** 0.5)
-  qf, kf, vf, of, gf = (_fold(x, b, s, h, d) for x in (q, k, v, out, g))
-  # Δ_i = Σ_d dO_id · O_id (softmax-normalization correction term)
-  delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+def _flash_block_bwd(causal, blk_q, blk_k, interpret, residuals, cotangents):
+  q, k, v, out, lse, q_base, kv_base = residuals
+  g, g_lse = cotangents
+  dq, dk, dv = _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base,
+                         causal, blk_q, blk_k, interpret)
+  zero_base = np.zeros((), jax.dtypes.float0)
+  return dq, dk, dv, zero_base, zero_base
 
-  common = dict(blk_q=blk_q, blk_k=blk_k, seq_len=s, causal=causal,
-                scale=scale)
-  full = lambda i, j: (i, 0, 0)       # noqa: E731
-  full2 = lambda i, j: (i, 0)         # noqa: E731
 
-  dq = pl.pallas_call(
-      functools.partial(_attn_bwd_dq_kernel, **common),
-      grid=(b * h, s // blk_q),
-      in_specs=[
-          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, s, d), full),
-          pl.BlockSpec((1, s, d), full),
-          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
-          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
-      ],
-      out_specs=pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-      out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-      interpret=interpret,
-  )(qf, kf, vf, gf, lse, delta)
+_flash_block_vjp.defvjp(_flash_block_fwd, _flash_block_bwd)
 
-  dk, dv = pl.pallas_call(
-      functools.partial(_attn_bwd_dkv_kernel, **common),
-      grid=(b * h, s // blk_k),
-      in_specs=[
-          pl.BlockSpec((1, s, d), full),
-          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, s, d), full),
-          pl.BlockSpec((1, s), full2),
-          pl.BlockSpec((1, s), full2),
-      ],
-      out_specs=[
-          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, blk_k, d), lambda i, j: (i, j, 0)),
-      ],
-      out_shape=[
-          jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-          jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
-      ],
-      interpret=interpret,
-  )(qf, kf, vf, gf, lse, delta)
 
-  return (_unfold(dq, b, s, h, d), _unfold(dk, b, s, h, d),
-          _unfold(dv, b, s, h, d))
+def merge_partials(o_a, lse_a, o_b, lse_b):
+  """Combine two normalized attention partials (the ring-merge step).
+
+  Given partial outputs over disjoint KV sets with their logsumexps,
+  produces the exact partial over the union. Fully-masked partials
+  (lse = NEG_INF) contribute nothing.
+  """
+  lse_new = jnp.logaddexp(lse_a, lse_b)               # [B, H, S]
+  lse_safe = jnp.where(lse_new <= NEG_INF, 0.0, lse_new)
+  w_a = jnp.where((lse_a <= NEG_INF)[..., None], 0.0,
+                  jnp.exp(lse_a - lse_safe)[..., None])
+  w_b = jnp.where((lse_b <= NEG_INF)[..., None], 0.0,
+                  jnp.exp(lse_b - lse_safe)[..., None])
+  # weights are [B,H,S,1]; outputs are [B,S,H,D]
+  w_a = jnp.swapaxes(w_a, 1, 2)
+  w_b = jnp.swapaxes(w_b, 1, 2)
+  o = o_a.astype(jnp.float32) * w_a + o_b.astype(jnp.float32) * w_b
+  return o.astype(o_a.dtype), lse_new

@@ -89,9 +89,49 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
   return out.astype(q.dtype)
 
 
+def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
+                      blk_k: int, interpret: bool):
+  """shard_map body: ring attention with Pallas flash-attention blocks.
+
+  Each ring step computes the partial attention of the local queries
+  against the currently-held KV block with the fused kernel
+  (ops.flash_attention_block) and merges the normalized partials via
+  their logsumexps — the fused-kernel memory profile composed with
+  sequence parallelism.
+  """
+  from tensorflowonspark_tpu.ops.flash_attention import (
+      NEG_INF as _NEG_INF, flash_attention_block, merge_partials)
+
+  n = lax.axis_size(axis_name)
+  my = lax.axis_index(axis_name)
+  b, s_local, h, d = q.shape
+
+  # accumulate the running output in float32 across ring steps (a bf16
+  # carry would round n times); cast to the input dtype once at the end
+  o0 = jnp.zeros(q.shape, jnp.float32)
+  lse0 = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+
+  def body(step, carry):
+    k_blk, v_blk, o, lse = carry
+    src = (my - step) % n
+    o_j, lse_j = flash_attention_block(
+        q, k_blk, v_blk, my * s_local, src * s_local, causal=causal,
+        blk_q=blk_q, blk_k=blk_k, interpret=interpret)
+    o, lse = merge_partials(o, lse, o_j.astype(jnp.float32), lse_j)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_blk = lax.ppermute(k_blk, axis_name, perm)
+    v_blk = lax.ppermute(v_blk, axis_name, perm)
+    return k_blk, v_blk, o, lse
+
+  _, _, o, _ = lax.fori_loop(0, n, body, (k, v, o0, lse0))
+  return o.astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh, causal: bool = True,
                    axis_name: str = mesh_lib.AXIS_SEQUENCE,
-                   batch_axes=None):
+                   batch_axes=None, use_flash: bool = False,
+                   blk_q: int = 128, blk_k: int = 128,
+                   interpret: bool = False):
   """Exact full attention over a sequence sharded across ``axis_name``.
 
   Args:
@@ -99,6 +139,9 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
     mesh: the device mesh.
     causal: apply a global causal mask.
     batch_axes: mesh axes dim 0 is sharded over (defaults to data+fsdp).
+    use_flash: compute each ring step's block with the fused Pallas kernel
+      (ops.flash_attention_block) instead of dense block math — the
+      memory-optimal path on TPU (``interpret=True`` for CPU tests).
 
   Returns attention output with the same sharding as ``q``.
   """
@@ -108,8 +151,13 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
       mesh_lib.data_axes(mesh)
   spec = P(batch_axes or None, axis_name, mesh_lib.AXIS_TENSOR
            if mesh_lib.AXIS_TENSOR in mesh.axis_names else None, None)
-  fn = functools.partial(_ring_attn_local, axis_name=axis_name,
-                         causal=causal)
+  if use_flash:
+    fn = functools.partial(_ring_flash_local, axis_name=axis_name,
+                           causal=causal, blk_q=blk_q, blk_k=blk_k,
+                           interpret=interpret)
+  else:
+    fn = functools.partial(_ring_attn_local, axis_name=axis_name,
+                           causal=causal)
   return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)(q, k, v)
 
