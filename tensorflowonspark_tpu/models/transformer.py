@@ -258,15 +258,26 @@ def greedy_generate(params, cfg: TransformerConfig, prompt, num_steps: int,
   return _generate_fn(cfg, plen, num_steps)(params, buf)
 
 
+def _select_token(logits, rng, temperature: float, top_k: int):
+  """Greedy (temperature == 0) or top-k temperature sampling."""
+  if temperature == 0.0:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+  scaled = logits.astype(jnp.float32) / temperature
+  if top_k > 0 and top_k < logits.shape[-1]:
+    kth = lax.top_k(scaled, top_k)[0][..., -1:]   # dedicated TPU top-k op
+    scaled = jnp.where(scaled < kth, -1e30, scaled)
+  return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+
 @functools.lru_cache(maxsize=8)
 def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
-                    num_steps: int):
+                    num_steps: int, temperature: float, top_k: int):
   """Cached jitted KV-cache decode: prefill once, then one token per step
   against the per-layer key/value cache — O(1) attention work per new
   token instead of a full-sequence recompute."""
   model = Transformer(cfg)
 
-  def decode(params, prompt):
+  def decode(params, prompt, rng):
     # init runs the decode path on a dummy token (advancing the cursor and
     # writing a key); zero the tree so decoding starts from a clean cache
     cache = jax.tree.map(
@@ -276,18 +287,20 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
     variables = {"params": params, "cache": cache}
     logits, mutated = model.apply(variables, prompt, decode=True,
                                   mutable=["cache"])
-    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    rng, sub = jax.random.split(rng)
+    nxt = _select_token(logits[:, -1], sub, temperature, top_k)
 
     def step(carry, _):
-      cache, tok = carry
+      cache, tok, rng = carry
       logits, mutated = model.apply({"params": params, "cache": cache},
                                     tok[:, None], decode=True,
                                     mutable=["cache"])
-      new = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-      return (mutated["cache"], new), new
+      rng, sub = jax.random.split(rng)
+      new = _select_token(logits[:, -1], sub, temperature, top_k)
+      return (mutated["cache"], new, rng), new
 
     # prefill produced g_1; each scan iteration computes one further token
-    _, toks = lax.scan(step, (mutated["cache"], nxt), None,
+    _, toks = lax.scan(step, (mutated["cache"], nxt, rng), None,
                        length=num_steps - 1)
     generated = jnp.concatenate([nxt[:, None], toks.T], axis=1) \
         if num_steps > 1 else nxt[:, None]
@@ -297,20 +310,28 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
 
 
 def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
-                       num_steps: int):
-  """Greedy decoding with a per-layer KV cache (the serving path).
+                       num_steps: int, temperature: float = 0.0,
+                       top_k: int = 0, rng=None):
+  """Decoding with a per-layer KV cache (the serving path).
 
-  Semantically identical to :func:`greedy_generate`, but each new token
-  attends against cached keys/values rather than recomputing the full
-  prefix — requires prompt_len + num_steps <= cfg.max_seq_len.
+  Greedy by default; ``temperature > 0`` samples (optionally top-k
+  filtered) using ``rng``. Semantically identical to
+  :func:`greedy_generate` when greedy, but each new token attends against
+  cached keys/values rather than recomputing the full prefix — requires
+  prompt_len + num_steps <= cfg.max_seq_len.
   """
   b, plen = prompt.shape
   if plen + num_steps > cfg.max_seq_len:
     raise ValueError(
         "generation of %d tokens from a %d-token prompt exceeds the "
         "cfg.max_seq_len=%d cache" % (num_steps, plen, cfg.max_seq_len))
-  return _kv_generate_fn(cfg, b, plen, num_steps)(
-      params, prompt.astype(jnp.int32))
+  if rng is None:
+    if temperature > 0:
+      # a silent fixed key would make every "sampled" call identical
+      raise ValueError("temperature > 0 requires an explicit rng key")
+    rng = jax.random.PRNGKey(0)
+  return _kv_generate_fn(cfg, b, plen, num_steps, float(temperature),
+                         int(top_k))(params, prompt.astype(jnp.int32), rng)
 
 
 def causal_lm_loss(logits, tokens):

@@ -5,7 +5,9 @@ Beyond-parity capability (SURVEY.md §2.3: "Expert parallelism: No"): each
 device of the ``expert`` axis holds a disjoint slice of the expert stack;
 tokens are dispatched with one-hot combine weights (Shazeer-style einsum
 dispatch) and partial expert outputs are combined with a single ``psum``
-over the expert axis. Top-1 routing; gating runs replicated (it is a tiny
+over the expert axis. Top-1 or top-k routing (renormalized combine
+weights) with a Switch/GShard :func:`load_balancing_loss`; gating runs
+replicated (it is a tiny
 matmul), expert FFNs run sharded.
 
 Two dispatch strategies:
@@ -41,29 +43,62 @@ def init_moe_params(rng, num_experts: int, d_model: int, d_ff: int,
   }
 
 
-def _gate(x, w_gate):
-  """Shared top-1 gating: (onehot [T, E], gate [T]) — the single source of
-  the routing math for every dispatch strategy."""
+def _router_probs(x, w_gate):
+  """Router forward: softmax probabilities [T, E] — the single source of
+  the gating math for every dispatch strategy and the aux loss."""
   logits = x.astype(jnp.float32) @ w_gate.astype(jnp.float32)
-  probs = jax.nn.softmax(logits, axis=-1)
+  return jax.nn.softmax(logits, axis=-1)
+
+
+def _topk_dispatch(probs, top_k: int):
+  """Binary multi-hot dispatch [T, E] selecting each token's top-k experts."""
+  _, idx = lax.top_k(probs, top_k)
+  return jax.nn.one_hot(idx, probs.shape[-1],
+                        dtype=probs.dtype).sum(axis=1)
+
+
+def _gate(x, w_gate):
+  """Top-1 gating: (onehot [T, E], gate [T])."""
+  probs = _router_probs(x, w_gate)
   top = jnp.argmax(probs, axis=-1)
   onehot = jax.nn.one_hot(top, probs.shape[-1], dtype=probs.dtype)
   return onehot, jnp.max(probs, axis=-1)
 
 
-def _route(params, x):
-  """Top-1 routing: (dispatch [T, E] binary one-hot, combine [T, E] gated).
+def _route(params, x, top_k: int = 1):
+  """Top-k routing: (dispatch [T, E] binary multi-hot, combine [T, E]).
 
-  Dispatch selects which expert processes each token (binary — experts see
-  the raw token); combine weights the expert output by the gate
-  probability (the standard single-gating semantics)."""
-  onehot, gate = _gate(x, params["w_gate"])
-  return onehot, onehot * gate[:, None]
+  Dispatch selects which experts process each token (binary — experts see
+  the raw token); combine weights each selected expert's output by its
+  gate probability renormalized over the selected set (standard top-2
+  semantics when ``top_k == 2``)."""
+  if top_k == 1:
+    onehot, gate = _gate(x, params["w_gate"])
+    return onehot, onehot * gate[:, None]
+  probs = _router_probs(x, params["w_gate"])
+  dispatch = _topk_dispatch(probs, top_k)               # [T, E]
+  selected = probs * dispatch
+  combine = selected / jnp.sum(selected, axis=-1, keepdims=True)
+  return dispatch, combine
 
 
-def moe_ffn_reference(params, x):
+def load_balancing_loss(params, x, top_k: int = 1):
+  """Auxiliary load-balancing loss (Switch/GShard style).
+
+  ``E · Σ_e fraction_of_tokens_routed_to_e · mean_router_prob_e`` — equals
+  1.0 under perfectly uniform routing; add a small multiple to the task
+  loss to keep experts utilized.
+  """
+  probs = _router_probs(x, params["w_gate"])
+  dispatch = _topk_dispatch(probs, top_k)
+  fraction = jnp.mean(dispatch, axis=0) / top_k         # [E]
+  mean_prob = jnp.mean(probs, axis=0)                   # [E]
+  return probs.shape[-1] * jnp.sum(fraction * mean_prob)
+
+
+def moe_ffn_reference(params, x, top_k: int = 1):
   """Single-device reference: x [T, D] -> [T, D]."""
-  dispatch, combine = _route(params, x)                # [T, E] each
+  dispatch, combine = _route(params, x, top_k)         # [T, E] each
   xf = x.astype(jnp.float32)
   h = jax.nn.relu(jnp.einsum("te,td,edf->etf", dispatch, xf,
                              params["w_up"].astype(jnp.float32)))
@@ -83,12 +118,12 @@ def _moe_local(x, dispatch, combine, w_up, w_down):
   return lax.psum(partial, mesh_lib.AXIS_EXPERT).astype(x.dtype)
 
 
-def moe_ffn(params, x, mesh):
+def moe_ffn(params, x, mesh, top_k: int = 1):
   """Expert-sharded MoE FFN. x: [tokens, d_model] (shard tokens over the
   data axes as usual); expert weights sharded over the expert axis."""
   from jax import shard_map
 
-  dispatch, combine = _route(params, x)                # [T, E] replicated
+  dispatch, combine = _route(params, x, top_k)         # [T, E] replicated
   batch_axes = mesh_lib.data_axes(mesh) or None
   fn = shard_map(
       _moe_local, mesh=mesh,

@@ -154,6 +154,27 @@ class TestTransformer:
     kv = tfm.greedy_generate_kv(state.params, cfg, prompt, num_steps=10)
     np.testing.assert_array_equal(np.asarray(kv), np.asarray(full))
 
+  def test_sampling_generation(self):
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=32,
+                                remat=False, dtype=jnp.float32)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=8)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    a = tfm.greedy_generate_kv(state.params, cfg, prompt, 10,
+                               temperature=1.0, top_k=5,
+                               rng=jax.random.PRNGKey(1))
+    b = tfm.greedy_generate_kv(state.params, cfg, prompt, 10,
+                               temperature=1.0, top_k=5,
+                               rng=jax.random.PRNGKey(2))
+    assert a.shape == (1, 13)
+    # different rng -> (almost surely) different samples; same rng -> same
+    c = tfm.greedy_generate_kv(state.params, cfg, prompt, 10,
+                               temperature=1.0, top_k=5,
+                               rng=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
   def test_kv_cache_respects_max_len(self):
     from tensorflowonspark_tpu.models import transformer as tfm
     cfg = tfm.TransformerConfig(vocab_size=8, num_layers=1, num_heads=2,

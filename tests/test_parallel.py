@@ -223,6 +223,31 @@ class TestExpertParallel:
         sharded, x)
     assert np.isfinite(np.asarray(out)).all()
 
+  def test_top2_routing_matches_reference(self, devices):
+    from tensorflowonspark_tpu.parallel import expert_parallel as EP
+    mesh = M.build_mesh(M.MeshSpec(data=2, expert=4), devices=devices)
+    params = EP.init_moe_params(jax.random.PRNGKey(2), 8, 16, 32)
+    x = jnp.asarray(np.random.RandomState(2).randn(24, 16), jnp.float32)
+    ref = EP.moe_ffn_reference(params, x, top_k=2)
+    out = jax.jit(lambda p, x: EP.moe_ffn(p, x, mesh, top_k=2))(
+        EP.shard_moe_params(params, mesh), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # top-2 combine weights sum to 1 per token -> output differs from top-1
+    top1 = EP.moe_ffn_reference(params, x, top_k=1)
+    assert float(jnp.max(jnp.abs(top1 - ref))) > 1e-4
+
+  def test_load_balancing_loss(self):
+    from tensorflowonspark_tpu.parallel import expert_parallel as EP
+    params = EP.init_moe_params(jax.random.PRNGKey(0), 4, 8, 16)
+    x = jnp.asarray(np.random.RandomState(3).randn(256, 8), jnp.float32)
+    aux = float(EP.load_balancing_loss(params, x))
+    assert aux >= 1.0 - 1e-3          # 1.0 is the uniform-routing floor
+    assert np.isfinite(aux)
+    # differentiable w.r.t. the gate
+    g = jax.grad(lambda p: EP.load_balancing_loss(p, x))(params)
+    assert float(jnp.abs(g["w_gate"]).sum()) > 0
+
   def test_differentiable(self, devices):
     from tensorflowonspark_tpu.parallel import expert_parallel as EP
     mesh = M.build_mesh(M.MeshSpec(expert=4), devices=devices[:4])
