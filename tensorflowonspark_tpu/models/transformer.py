@@ -40,6 +40,16 @@ class TransformerConfig:
   # "auto": Pallas flash attention on TPU, dense elsewhere; or force
   # "flash" / "dense"
   attention_impl: str = "auto"
+  # Mixture-of-experts: when moe_experts > 0, every `moe_every`-th layer
+  # (moe_every >= 1) replaces its dense MLP with an expert-routed FFN
+  # (parallel.expert_parallel; experts shard over the `expert` mesh axis)
+  moe_experts: int = 0
+  moe_top_k: int = 1
+  moe_every: int = 2
+
+  def __post_init__(self):
+    if self.moe_experts > 0 and self.moe_every < 1:
+      raise ValueError("moe_every must be >= 1 when moe_experts > 0")
 
   @property
   def head_dim(self) -> int:
@@ -175,9 +185,56 @@ class MLPBlock(nn.Module):
                         nn.initializers.lecun_normal(), ("mlp", "embed")))(h)
 
 
+class MoEBlock(nn.Module):
+  """Expert-routed FFN (see parallel.expert_parallel): dense masked
+  dispatch over the ``expert`` mesh axis, top-k routing, with the
+  load-balancing auxiliary loss sown under ``intermediates/moe_aux``.
+
+  Constraint: tokens must not be sequence-sharded (MoE layers flatten
+  [B, S, D] to tokens, which composes with data/expert sharding only).
+  """
+  cfg: TransformerConfig
+  mesh: Optional[Any] = None
+
+  @nn.compact
+  def __call__(self, x):
+    from tensorflowonspark_tpu.parallel import expert_parallel as ep
+
+    cfg = self.cfg
+    d = x.shape[-1]
+    params = {
+        "w_gate": self.param(
+            "w_gate", nn.initializers.lecun_normal(),
+            (d, cfg.moe_experts), jnp.float32),
+        "w_up": self.param(
+            "w_up", nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")),
+            (cfg.moe_experts, d, cfg.d_ff), jnp.float32),
+        "w_down": self.param(
+            "w_down", nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")),
+            (cfg.moe_experts, cfg.d_ff, d), jnp.float32),
+    }
+    flat = x.reshape(-1, d)
+    # one router forward feeds both the dispatch and the aux loss
+    dispatch, combine, probs = ep.route(params, flat, cfg.moe_top_k)
+    routing = (dispatch, combine)
+    if self.mesh is not None and \
+        self.mesh.shape.get(mesh_lib.AXIS_EXPERT, 1) > 1:
+      y = ep.moe_ffn(params, flat, self.mesh, top_k=cfg.moe_top_k,
+                     routing=routing)
+    else:
+      y = ep.moe_ffn_reference(params, flat, top_k=cfg.moe_top_k,
+                               routing=routing)
+    self.sow("intermediates", "moe_aux",
+             ep.aux_loss_from(probs, dispatch, cfg.moe_top_k))
+    return y.reshape(x.shape).astype(x.dtype)
+
+
 class Block(nn.Module):
   cfg: TransformerConfig
   mesh: Optional[Any] = None
+  use_moe: bool = False
 
   @nn.compact
   def __call__(self, x, positions, decode: bool = False):
@@ -186,7 +243,10 @@ class Block(nn.Module):
     x = x + Attention(cfg, self.mesh, name="attn")(y, positions,
                                                    decode=decode)
     y = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln2")(x)
-    x = x + MLPBlock(cfg, name="mlp")(y)
+    if self.use_moe:
+      x = x + MoEBlock(cfg, self.mesh, name="moe")(y)
+    else:
+      x = x + MLPBlock(cfg, name="mlp")(y)
     if decode:
       return x
     return nn.with_logical_constraint(x, ("batch", "sequence", "embed"))
@@ -213,7 +273,9 @@ class Transformer(nn.Module):
     if cfg.remat and not decode:
       block = nn.remat(Block)
     for i in range(cfg.num_layers):
-      layer = block(cfg, self.mesh, name="layer_%d" % i)
+      use_moe = (cfg.moe_experts > 0
+                 and i % cfg.moe_every == cfg.moe_every - 1)
+      layer = block(cfg, self.mesh, use_moe, name="layer_%d" % i)
       x = layer(x, positions, True) if decode else layer(x, positions)
 
     x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f")(x)
@@ -325,8 +387,10 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
     raise ValueError(
         "generation of %d tokens from a %d-token prompt exceeds the "
         "cfg.max_seq_len=%d cache" % (num_steps, plen, cfg.max_seq_len))
+  if temperature < 0:
+    raise ValueError("temperature must be >= 0, got %r" % temperature)
   if rng is None:
-    if temperature > 0:
+    if temperature != 0:
       # a silent fixed key would make every "sampled" call identical
       raise ValueError("temperature > 0 requires an explicit rng key")
     rng = jax.random.PRNGKey(0)

@@ -65,21 +65,27 @@ def _gate(x, w_gate):
   return onehot, jnp.max(probs, axis=-1)
 
 
-def _route(params, x, top_k: int = 1):
-  """Top-k routing: (dispatch [T, E] binary multi-hot, combine [T, E]).
+def route(params, x, top_k: int = 1):
+  """Top-k routing: (dispatch [T,E] multi-hot, combine [T,E], probs [T,E]).
 
   Dispatch selects which experts process each token (binary — experts see
   the raw token); combine weights each selected expert's output by its
-  gate probability renormalized over the selected set (standard top-2
-  semantics when ``top_k == 2``)."""
-  if top_k == 1:
-    onehot, gate = _gate(x, params["w_gate"])
-    return onehot, onehot * gate[:, None]
+  gate probability (renormalized over the selected set for top_k > 1).
+  Returns the router probabilities too so callers can derive the
+  load-balancing loss without a second router forward.
+  """
   probs = _router_probs(x, params["w_gate"])
   dispatch = _topk_dispatch(probs, top_k)               # [T, E]
   selected = probs * dispatch
-  combine = selected / jnp.sum(selected, axis=-1, keepdims=True)
-  return dispatch, combine
+  if top_k == 1:
+    combine = selected
+  else:
+    combine = selected / jnp.sum(selected, axis=-1, keepdims=True)
+  return dispatch, combine, probs
+
+
+def _route(params, x, top_k: int = 1):
+  return route(params, x, top_k)[:2]
 
 
 def load_balancing_loss(params, x, top_k: int = 1):
@@ -91,14 +97,21 @@ def load_balancing_loss(params, x, top_k: int = 1):
   """
   probs = _router_probs(x, params["w_gate"])
   dispatch = _topk_dispatch(probs, top_k)
+  return aux_loss_from(probs, dispatch, top_k)
+
+
+def aux_loss_from(probs, dispatch, top_k: int = 1):
+  """Load-balancing loss from an existing routing (no router recompute)."""
   fraction = jnp.mean(dispatch, axis=0) / top_k         # [E]
   mean_prob = jnp.mean(probs, axis=0)                   # [E]
   return probs.shape[-1] * jnp.sum(fraction * mean_prob)
 
 
-def moe_ffn_reference(params, x, top_k: int = 1):
-  """Single-device reference: x [T, D] -> [T, D]."""
-  dispatch, combine = _route(params, x, top_k)         # [T, E] each
+def moe_ffn_reference(params, x, top_k: int = 1, routing=None):
+  """Single-device reference: x [T, D] -> [T, D]. ``routing`` optionally
+  supplies a precomputed (dispatch, combine) pair from :func:`route`."""
+  dispatch, combine = routing if routing is not None \
+      else _route(params, x, top_k)                    # [T, E] each
   xf = x.astype(jnp.float32)
   h = jax.nn.relu(jnp.einsum("te,td,edf->etf", dispatch, xf,
                              params["w_up"].astype(jnp.float32)))
@@ -118,12 +131,13 @@ def _moe_local(x, dispatch, combine, w_up, w_down):
   return lax.psum(partial, mesh_lib.AXIS_EXPERT).astype(x.dtype)
 
 
-def moe_ffn(params, x, mesh, top_k: int = 1):
+def moe_ffn(params, x, mesh, top_k: int = 1, routing=None):
   """Expert-sharded MoE FFN. x: [tokens, d_model] (shard tokens over the
   data axes as usual); expert weights sharded over the expert axis."""
   from jax import shard_map
 
-  dispatch, combine = _route(params, x, top_k)         # [T, E] replicated
+  dispatch, combine = routing if routing is not None \
+      else _route(params, x, top_k)                    # [T, E] replicated
   batch_axes = mesh_lib.data_axes(mesh) or None
   fn = shard_map(
       _moe_local, mesh=mesh,
