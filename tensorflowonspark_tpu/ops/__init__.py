@@ -8,4 +8,7 @@ few ops XLA cannot fuse optimally are written in Pallas:
   ring attention's sequence parallelism.
 """
 
-from tensorflowonspark_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from tensorflowonspark_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_block, merge_partials,
+)
+from tensorflowonspark_tpu.ops.layer_norm import layer_norm  # noqa: F401

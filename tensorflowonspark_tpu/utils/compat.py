@@ -46,6 +46,13 @@ def import_model(export_dir: str, template=None):
   return ckptr.restore(path)
 
 
+def is_tpu_available() -> bool:
+  """Accelerator-availability shim for user code (parity:
+  reference compat.is_gpu_available, compat.py:27-31)."""
+  from tensorflowonspark_tpu.utils import tpu_info
+  return tpu_info.is_tpu_available()
+
+
 def disable_auto_shard(options) -> None:
   """No-op on the JAX path (parity stub: reference compat.py:20-24).
 

@@ -7,8 +7,60 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from tensorflowonspark_tpu.ops import flash_attention
+from tensorflowonspark_tpu.ops import flash_attention, layer_norm
 from tensorflowonspark_tpu.parallel import ring_attention as ra
+
+
+class TestLayerNorm:
+  def _ref(self, x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) *
+            w.astype(jnp.float32)).astype(x.dtype)
+
+  def test_forward_matches_reference(self):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 64, 128), jnp.float32)
+    w = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    out = layer_norm(x, w, blk_rows=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(self._ref(x, w)),
+                               atol=1e-5, rtol=1e-5)
+
+  def test_gradients_match_reference(self):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 32, 64), jnp.float32)
+    w = jnp.asarray(rng.rand(64) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(2, 32, 64), jnp.float32)
+
+    gk = jax.grad(lambda x, w: jnp.sum(
+        t * layer_norm(x, w, blk_rows=16, interpret=True)),
+        argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum(t * self._ref(x, w)),
+                  argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gk[0]), np.asarray(gr[0]),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gk[1]), np.asarray(gr[1]),
+                               atol=1e-4, rtol=1e-4)
+
+  def test_indivisible_rows_handled(self):
+    # 300 rows with blk_rows=128: block auto-shrinks to a divisor
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(3, 100, 64), jnp.float32)
+    w = jnp.ones((64,), jnp.float32)
+    out = layer_norm(x, w, blk_rows=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(self._ref(x, w)),
+                               atol=1e-5, rtol=1e-5)
+
+  def test_bfloat16(self):
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 128), jnp.bfloat16)
+    w = jnp.ones((128,), jnp.bfloat16)
+    out = layer_norm(x, w, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(self._ref(x, w), np.float32),
+                               atol=3e-2, rtol=3e-2)
 
 
 class TestFlashAttention:
