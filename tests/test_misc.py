@@ -1,0 +1,139 @@
+"""Cross-cutting coverage: the inference CLI as a real subprocess, bundle
+export/import round-trips, rendezvous protocol verbs, and small API
+surfaces not covered elsewhere."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+class TestInferenceCLISubprocess:
+  def test_python_dash_m_invocation(self, tmp_path):
+    """The documented `python -m tensorflowonspark_tpu.inference_cli`
+    entry point, as a real subprocess."""
+    from tensorflowonspark_tpu import pipeline
+    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.data.schema import parse_schema
+
+    def predict_fn(params, batch):
+      return {"y": np.asarray(batch["x"], "float32") * params["m"]}
+
+    export_dir = str(tmp_path / "model")
+    pipeline.export_bundle({"m": np.float32(10.0)}, predict_fn, export_dir)
+    dfutil.save_as_tfrecords([[(1.5,), (2.5,)]],
+                             parse_schema("struct<v:float>"),
+                             str(tmp_path / "data"))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out_path = str(tmp_path / "preds.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tensorflowonspark_tpu.inference_cli",
+         "--export_dir", export_dir,
+         "--input", str(tmp_path / "data"),
+         "--schema_hint", "struct<v:float>",
+         "--input_mapping", json.dumps({"v": "x"}),
+         "--output_mapping", json.dumps({"y": "pred"}),
+         "--output", out_path],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    preds = [json.loads(l)["pred"] for l in open(out_path)]
+    assert preds == [15.0, 25.0]
+
+
+class TestCompatRoundtrip:
+  def test_export_import_model(self, tmp_path):
+    import jax.numpy as jnp
+    from tensorflowonspark_tpu.utils import compat
+
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.asarray(1.5)}
+    target = compat.export_model(state, str(tmp_path / "exp"),
+                                 is_chief=True)
+    assert target == str(tmp_path / "exp")
+    restored = compat.import_model(target)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.arange(6.0).reshape(2, 3))
+
+  def test_non_chief_writes_elsewhere(self, tmp_path):
+    import jax.numpy as jnp
+    from tensorflowonspark_tpu.utils import compat
+
+    target = compat.export_model({"w": jnp.zeros(2)},
+                                 str(tmp_path / "exp2"), is_chief=False)
+    try:
+      assert target != str(tmp_path / "exp2")
+      assert not os.path.exists(str(tmp_path / "exp2"))
+    finally:
+      import shutil
+      shutil.rmtree(target, ignore_errors=True)
+
+
+class TestRendezvousVerbs:
+  def test_qinfo_and_list(self):
+    from tensorflowonspark_tpu.control.rendezvous import Client, Server
+
+    s = Server(3)
+    addr = s.start()
+    try:
+      c = Client(addr)
+      c.register({"executor_id": 0, "host": "h0"})
+      c.register({"executor_id": 2, "host": "h2"})
+      count = c._request({"type": "QINFO"})
+      assert count["registered"] == 2 and count["required"] == 3
+      listed = c.get_reservations()
+      assert [m["executor_id"] for m in listed] == [0, 2]
+      unknown = c._request({"type": "NOPE"})
+      assert unknown["type"] == "ERROR"
+      c.close()
+    finally:
+      s.stop()
+
+
+class TestSmallSurfaces:
+  def test_yield_batch_scalar_rows(self):
+    from tensorflowonspark_tpu.pipeline import yield_batch
+    batches = list(yield_batch([1, 2, 3, 4, 5], batch_size=2))
+    assert batches == [[[1, 2]], [[3, 4]], [[5]]]
+
+  def test_yield_batch_multi_tensor(self):
+    from tensorflowonspark_tpu.pipeline import yield_batch
+    rows = [(1, "a"), (2, "b"), (3, "c")]
+    batches = list(yield_batch(rows, batch_size=2, num_tensors=2))
+    assert batches == [[[1, 2], ["a", "b"]], [[3], ["c"]]]
+
+  def test_namespace_rejects_garbage(self):
+    from tensorflowonspark_tpu.pipeline import Namespace
+    with pytest.raises(TypeError):
+      Namespace(42)
+
+  def test_batched_custom_collate(self):
+    from tensorflowonspark_tpu.data import readers
+    got = list(readers.batched([1, 2, 3, 4], 2,
+                               collate=lambda rows: sum(rows)))
+    assert got == [3, 7]
+
+  def test_datafeed_arrays_without_mapping(self):
+    from tensorflowonspark_tpu.control import feedhub
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    hub = feedhub.start(b"k", ["input", "output", "error"], mode="local")
+    try:
+      hub.get_queue("input").put_many([1.0, 2.0, None])
+      feed = DataFeed(hub)
+      arr = feed.next_batch_arrays(5, dtype="float32")
+      np.testing.assert_allclose(arr, [1.0, 2.0])
+    finally:
+      hub.shutdown()
+
+  def test_engine_factory(self):
+    from tensorflowonspark_tpu.engine import get_engine
+    e = get_engine("local", num_executors=1)
+    assert e.num_executors == 1
+    e.stop()
+    with pytest.raises(ValueError):
+      get_engine("nope")
