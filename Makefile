@@ -15,8 +15,11 @@ native:
 bench:
 	$(PY) bench.py
 
+# dryrun_multichip self-sanitizes via utils/platform_env.py; the env prefix is
+# redundant belt-and-suspenders for sandboxes with a remote-TPU sitecustomize.
 dryrun:
-	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  $(PY) __graft_entry__.py 8
 
 clean:

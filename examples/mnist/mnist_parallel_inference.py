@@ -16,9 +16,10 @@ sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), os.pardir, os.pardir)))
 
 # some sandboxes register a remote-accelerator JAX plugin that hijacks even
-# CPU-only runs (see tests/conftest.py); drop its trigger so the examples
-# run anywhere. Harmless where the variable does not exist.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# CPU-only runs; strip it (no-op elsewhere) so the examples run anywhere —
+# real TPU hosts keep their real platform.
+from tensorflowonspark_tpu.utils.platform_env import drop_remote_plugin
+drop_remote_plugin()
 
 
 def infer_fn(args, ctx):

@@ -1,0 +1,110 @@
+"""Process-level JAX platform sanitization for CPU-only multi-device work.
+
+Some sandboxes install a sitecustomize hook that registers a remote-TPU PJRT
+plugin at interpreter start whenever ``PALLAS_AXON_POOL_IPS`` is set; the
+plugin's ``register()`` force-sets ``jax_platforms="axon,cpu"`` (overriding the
+``JAX_PLATFORMS`` env var), so any backend initialization dials a remote chip
+for a claim. Multi-process CPU drives must defeat that, or N concurrent
+processes all claim the single remote chip and wedge it.
+
+One shared implementation for tests (``tests/conftest.py``), the driver entry
+(``__graft_entry__.py``), the ``Makefile`` dryrun, and the example scripts —
+keeps them from drifting. Must be called before jax's backend initializes;
+raises if that already happened with the wrong platform, because silently
+proceeding would dial the remote TPU.
+"""
+
+import os
+import re
+
+
+def _backend_initialized() -> bool:
+  """True when jax's backend is already up (best effort — private API)."""
+  try:
+    from jax._src import xla_bridge
+    return bool(xla_bridge.backends_are_initialized())
+  except Exception:  # noqa: BLE001 - private API; degrade to "unknown"
+    return False
+
+
+def _strip_axon(platforms: str) -> str:
+  return ",".join(p for p in platforms.split(",") if p and p != "axon")
+
+
+def drop_remote_plugin() -> None:
+  """Strip the sandbox's remote-TPU plugin without otherwise forcing a
+  platform.
+
+  For example/driver scripts that should run on whatever real hardware the
+  host has (CPU locally, real TPUs on a pod) but must never dial the
+  sandbox's single remote chip. No-op outside the sandbox. Children inherit
+  the cleaned environment, so spawned executors are safe too.
+
+  Raises:
+    RuntimeError: if jax already initialized its backend on the remote
+      plugin — too late to redirect; sanitize earlier.
+  """
+  os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+  env_platforms = os.environ.get("JAX_PLATFORMS")
+  if env_platforms is not None and "axon" in env_platforms.split(","):
+    stripped = _strip_axon(env_platforms)
+    if stripped:
+      os.environ["JAX_PLATFORMS"] = stripped
+    else:
+      del os.environ["JAX_PLATFORMS"]
+  try:
+    import jax
+  except ImportError:
+    return  # nothing registered yet; the env cleanup above is sufficient
+  configured = jax.config.jax_platforms or ""
+  if "axon" in configured.split(","):
+    if _backend_initialized():
+      raise RuntimeError(
+          "drop_remote_plugin called after jax initialized the %r backend — "
+          "sanitize before any jax computation" % configured)
+    jax.config.update("jax_platforms", _strip_axon(configured) or None)
+
+
+def force_cpu_platform(n_devices: int = 8) -> None:
+  """Force this process onto a virtual CPU platform of >= ``n_devices``.
+
+  A caller-supplied ``--xla_force_host_platform_device_count`` larger than
+  ``n_devices`` is preserved (so e.g. ``XLA_FLAGS=...=16 pytest`` still sees
+  16 devices); a smaller one is grown to ``n_devices``. Safe to call multiple
+  times. Child processes inherit the environment, so calling this before
+  spawning executors keeps the whole tree CPU-only.
+
+  Raises:
+    RuntimeError: if jax's backend was already initialized on a non-CPU
+      platform or with too few devices (too late to redirect — the caller
+      must sanitize earlier).
+  """
+  os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+  os.environ["JAX_PLATFORMS"] = "cpu"
+  flags = os.environ.get("XLA_FLAGS", "")
+  existing = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+  count = max(n_devices, int(existing.group(1)) if existing else 0)
+  opt = "--xla_force_host_platform_device_count=%d" % count
+  if existing:
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", opt, flags)
+  else:
+    flags = (flags + " " + opt).strip()
+  os.environ["XLA_FLAGS"] = flags
+
+  try:
+    import jax
+  except ImportError:
+    return  # nothing registered yet; the env vars above are sufficient
+  # Undo the plugin's force-set config (sitecustomize already ran register()
+  # in this process; the env var alone no longer wins).
+  jax.config.update("jax_platforms", "cpu")
+  if _backend_initialized():
+    if jax.default_backend() != "cpu":
+      raise RuntimeError(
+          "force_cpu_platform called after jax initialized backend %r — "
+          "sanitize before any jax computation" % jax.default_backend())
+    if jax.device_count() < n_devices:
+      raise RuntimeError(
+          "force_cpu_platform: jax already initialized with %d CPU devices, "
+          "cannot grow to %d — sanitize before any jax computation"
+          % (jax.device_count(), n_devices))

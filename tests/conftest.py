@@ -2,30 +2,22 @@
 
 Mirrors the reference's test strategy (reference tox.ini: a 2-worker Spark
 standalone cluster on one host): multi-device behavior is tested on one host
-by splitting the CPU into 8 virtual XLA devices. Must run before jax import.
+by splitting the CPU into 8 virtual XLA devices. Must run before jax's
+backend initializes — the shared helper raises if it's too late.
+
+CRITICAL for this container: a sitecustomize hook registers a remote-TPU
+PJRT plugin whenever PALLAS_AXON_POOL_IPS is set; see
+tensorflowonspark_tpu/utils/platform_env.py for the full story.
 """
 
 import os
+import sys
 
-# CRITICAL for this container: a sitecustomize hook registers a remote-TPU
-# PJRT plugin whenever PALLAS_AXON_POOL_IPS is set, and xla_bridge initializes
-# it even under JAX_PLATFORMS=cpu — every test process would then dial the
-# single remote TPU for a claim (hanging, and wedging the claim service under
-# concurrency). Tests are CPU-only: drop the trigger before any jax import;
-# child processes inherit this environment.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-# The plugin's register() (already executed by sitecustomize in THIS
-# process) force-sets jax.config jax_platforms="axon,cpu", overriding the
-# env var — undo that so in-process jax stays CPU-only too.
-try:
-  import jax
-  jax.config.update("jax_platforms", "cpu")
-except Exception:  # noqa: BLE001 - no jax yet means nothing to undo
-  pass
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-  os.environ["XLA_FLAGS"] = (
-      flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir)))
+
+from tensorflowonspark_tpu.utils.platform_env import force_cpu_platform
+
+force_cpu_platform(8)
 # keep subprocesses (LocalEngine executors) on CPU too
 os.environ.setdefault("TOS_TPU_TEST_MODE", "1")

@@ -1,0 +1,128 @@
+"""Tests for utils.platform_env — the shared CPU-platform sanitizer.
+
+These run in subprocesses because the helpers mutate process-global jax
+config/env state that the test process itself already fixed up (conftest).
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _run(code, extra_env=None):
+  env = dict(os.environ)
+  env.pop("PALLAS_AXON_POOL_IPS", None)
+  env.pop("JAX_PLATFORMS", None)
+  env.pop("XLA_FLAGS", None)
+  env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+  env.update(extra_env or {})
+  return subprocess.run(
+      [sys.executable, "-c", code], env=env, timeout=120,
+      capture_output=True, text=True)
+
+
+def test_force_cpu_platform_device_count():
+  res = _run(
+      "from tensorflowonspark_tpu.utils.platform_env import force_cpu_platform\n"
+      "force_cpu_platform(6)\n"
+      "import jax\n"
+      "print(jax.default_backend(), jax.device_count())\n")
+  assert res.returncode == 0, res.stderr
+  assert res.stdout.split() == ["cpu", "6"]
+
+
+def test_force_cpu_platform_preserves_larger_count():
+  res = _run(
+      "import os\n"
+      "from tensorflowonspark_tpu.utils.platform_env import force_cpu_platform\n"
+      "force_cpu_platform(4)\n"
+      "print(os.environ['XLA_FLAGS'])\n",
+      extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=16"})
+  assert res.returncode == 0, res.stderr
+  assert "--xla_force_host_platform_device_count=16" in res.stdout
+
+
+def test_force_cpu_platform_grows_smaller_count():
+  res = _run(
+      "import os\n"
+      "from tensorflowonspark_tpu.utils.platform_env import force_cpu_platform\n"
+      "force_cpu_platform(8)\n"
+      "print(os.environ['XLA_FLAGS'])\n",
+      extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2 "
+                              "--xla_cpu_enable_fast_math=false"})
+  assert res.returncode == 0, res.stderr
+  assert "--xla_force_host_platform_device_count=8" in res.stdout
+  assert "--xla_cpu_enable_fast_math=false" in res.stdout
+
+
+def test_force_cpu_platform_too_late_raises():
+  res = _run(
+      "import jax\n"
+      "jax.devices()\n"
+      "from tensorflowonspark_tpu.utils.platform_env import force_cpu_platform\n"
+      "try:\n"
+      "  force_cpu_platform(64)\n"
+      "except RuntimeError as e:\n"
+      "  print('RAISED', e)\n",
+      extra_env={"JAX_PLATFORMS": "cpu"})
+  assert res.returncode == 0, res.stderr
+  assert "RAISED" in res.stdout
+
+
+def test_drop_remote_plugin_strips_axon_from_env_list():
+  res = _run(
+      "import os\n"
+      "from tensorflowonspark_tpu.utils.platform_env import drop_remote_plugin\n"
+      "drop_remote_plugin()\n"
+      "print(repr(os.environ.get('JAX_PLATFORMS')))\n"
+      "print(repr(os.environ.get('PALLAS_AXON_POOL_IPS')))\n",
+      extra_env={"JAX_PLATFORMS": "axon,cpu",
+                 "PALLAS_AXON_POOL_IPS": "203.0.113.1"})
+  assert res.returncode == 0, res.stderr
+  lines = res.stdout.splitlines()
+  assert lines[0] == "'cpu'"
+  assert lines[1] == "None"
+
+
+def test_drop_remote_plugin_removes_bare_axon_env():
+  res = _run(
+      "import os\n"
+      "from tensorflowonspark_tpu.utils.platform_env import drop_remote_plugin\n"
+      "drop_remote_plugin()\n"
+      "print(repr(os.environ.get('JAX_PLATFORMS')))\n",
+      extra_env={"JAX_PLATFORMS": "axon"})
+  assert res.returncode == 0, res.stderr
+  assert res.stdout.strip() == "None"
+
+
+def test_drop_remote_plugin_keeps_real_platform():
+  res = _run(
+      "import os\n"
+      "from tensorflowonspark_tpu.utils.platform_env import drop_remote_plugin\n"
+      "drop_remote_plugin()\n"
+      "import jax\n"
+      "print(os.environ['JAX_PLATFORMS'], jax.default_backend())\n",
+      extra_env={"JAX_PLATFORMS": "cpu"})
+  assert res.returncode == 0, res.stderr
+  assert res.stdout.split() == ["cpu", "cpu"]
+
+
+@pytest.mark.skipif(importlib.util.find_spec("axon") is None,
+                    reason="sandbox plugin not present")
+def test_force_cpu_under_sandbox_plugin():
+  """End-to-end: with the sitecustomize trigger set, the helper still lands
+  the process on a virtual CPU platform (the MULTICHIP driver scenario)."""
+  res = _run(
+      "from tensorflowonspark_tpu.utils.platform_env import force_cpu_platform\n"
+      "force_cpu_platform(8)\n"
+      "import jax\n"
+      "print(jax.default_backend(), jax.device_count())\n",
+      extra_env={"PALLAS_AXON_POOL_IPS": "127.0.0.1",
+                 "JAX_PLATFORMS": "axon"})
+  assert res.returncode == 0, res.stderr
+  assert res.stdout.split() == ["cpu", "8"]
