@@ -120,15 +120,26 @@ class TPUCluster(object):
     return self.server.addr
 
   def inference(self, data_partitions: Sequence, feed_timeout: float = 600,
-                qname: str = "input") -> List:
-    """Feed data for inference and return collected results (parity:
-    TFCluster.inference, reference TFCluster.py:96-115)."""
+                qname: str = "input", collect: bool = True):
+    """Feed data for inference (parity: TFCluster.inference, reference
+    TFCluster.py:96-115).
+
+    With ``collect=True`` (default) results are gathered into a driver-side
+    list — fine for small jobs. With ``collect=False`` the return value is
+    the engine's lazy handle (Spark: the uncollected result RDD, exactly
+    like the reference; LocalEngine: a streaming generator holding at most
+    one window of partitions), so cluster-scale inference output never
+    materializes on the driver.
+    """
     logger.info("feeding inference data")
     assert self.input_mode == InputMode.ENGINE, \
         "inference() requires InputMode.ENGINE/SPARK"
     fn = node_mod.make_inference_fn(self.cluster_info, self.cluster_meta,
                                     feed_timeout=feed_timeout, qname=qname)
-    return self.engine.map_partitions(data_partitions, fn)
+    if collect:
+      return self.engine.map_partitions(data_partitions, fn)
+    return self.engine.map_partitions_lazy(data_partitions, fn,
+                                           timeout=feed_timeout)
 
   # -- lifecycle -------------------------------------------------------------
 

@@ -102,6 +102,18 @@ class Engine(abc.ABC):
     Parity: dataRDD.mapPartitions(...).collect()."""
 
   @abc.abstractmethod
+  def map_partitions_lazy(self, partitions: Sequence[Iterable],
+                          fn: Callable[[Iterable], Iterable],
+                          timeout: Optional[float] = None):
+    """Non-collecting ``map_partitions``: return an engine-native lazy
+    handle — Spark: the mapped RDD (parity: reference TFCluster.inference
+    returning an uncollected RDD, TFCluster.py:96-115); Local: a generator
+    streaming per-partition results — so cluster-scale inference output
+    never materializes on the driver. ``timeout`` bounds per-partition
+    completion where the engine executes eagerly-on-consume (Local); on
+    Spark the deadline belongs to the caller's eventual RDD action."""
+
+  @abc.abstractmethod
   def barrier_run(self, fn: Callable[[Iterable, "BarrierContext"], object],
                   num_tasks: Optional[int] = None,
                   timeout: Optional[float] = None) -> List:

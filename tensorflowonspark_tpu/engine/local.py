@@ -147,6 +147,41 @@ class LocalEngine(Engine):
       out.extend(r if isinstance(r, (list, tuple)) else [r])
     return out
 
+  def map_partitions_lazy(self, partitions, fn, timeout: Optional[float] = None,
+                          window: Optional[int] = None):
+    """Generator of per-partition results, at most ``window`` partitions
+    in flight (default: one per executor). The driver holds one window of
+    results instead of the whole output — the LocalEngine analog of
+    returning an uncollected RDD. ``timeout`` bounds each partition's
+    completion like the eager path's deadline."""
+    window = window or self._num_executors
+
+    def _gen():
+      pending: deque = deque()
+      parts = iter(partitions)
+
+      def _submit():
+        try:
+          part = next(parts)
+        except StopIteration:
+          return False
+        pending.append(self.foreach_partition([part], fn))
+        return True
+
+      for _ in range(window):
+        if not _submit():
+          break
+      while pending:
+        results = pending.popleft().wait(timeout=timeout)
+        _submit()
+        for r in results:
+          if r is None:
+            continue
+          for row in (r if isinstance(r, (list, tuple)) else [r]):
+            yield row
+
+    return _gen()
+
   def barrier_run(self, fn, num_tasks: Optional[int] = None,
                   timeout: Optional[float] = None) -> List:
     """Gang-schedule with placement info and a reusable barrier.
@@ -246,6 +281,11 @@ class LocalEngine(Engine):
         job._task_finished(task_id, result=cloudpickle.loads(payload))
       else:
         job._task_finished(task_id, error=payload)
+      if job.done():
+        # evict finished jobs so the engine doesn't pin every job's results
+        # forever (the lazy map path depends on this for bounded memory)
+        with self._lock:
+          self._jobs.pop(job_id, None)
 
   def __del__(self):
     try:

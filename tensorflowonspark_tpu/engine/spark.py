@@ -3,25 +3,48 @@
 Optional — importable only where pyspark is installed. Maps each Engine
 operation onto the exact Spark idiom the reference used:
 
-- ``run_on_executors``  → ``sc.parallelize(range(n), n).foreachPartition``
+- ``run_on_executors``  → ``sc.parallelize(range(n), n).mapPartitions``
   (reference TFCluster.py:301,321), launched from a daemon thread so it is
   async like the reference's ``_start`` thread (TFCluster.py:318-336);
-- ``foreach_partition`` → ``rdd.foreachPartition``;
+- ``foreach_partition`` → per-partition side-effect tasks;
 - ``map_partitions``    → ``rdd.mapPartitions(...).collect()``;
 - ``barrier_run``       → ``rdd.barrier().mapPartitions`` with
   BarrierTaskContext (reference TFParallel.py:43-74).
 
-``from_rdd`` lets callers hand existing RDDs/DataFrames to cluster.train /
+``_as_rdd`` lets callers hand existing RDDs/DataFrames to cluster.train /
 cluster.inference without materializing them on the driver.
+
+Every partition function is wrapped so a failing task ships ITS OWN
+traceback back through the collect (LocalEngine parity) instead of Spark
+aborting the whole job with one driver-side exception for all tasks.
 """
 
 import logging
 import threading
-from typing import Callable, Iterable, List, Optional, Sequence
+from typing import Callable, List, Optional
 
 from tensorflowonspark_tpu.engine.base import BarrierContext, Engine, EngineJob
 
 logger = logging.getLogger(__name__)
+
+_OK, _ERR = "ok", "err"
+
+
+def _capture(fn: Callable):
+  """Wrap a partition fn so each task returns ``(status, payload)``.
+
+  Failures are materialized per task (payload = that task's traceback),
+  which preserves the per-task error attribution LocalEngine gives —
+  otherwise one bad partition aborts the Spark job and every task reports
+  the same driver-side exception.
+  """
+  def _wrap(it):
+    try:
+      yield (_OK, fn(it))
+    except Exception:  # noqa: BLE001 - shipped back as the task's error
+      import traceback
+      yield (_ERR, traceback.format_exc())
+  return _wrap
 
 
 class SparkEngine(Engine):
@@ -48,21 +71,27 @@ class SparkEngine(Engine):
       return "file://"
 
   def _async_job(self, runner: Callable[[], List], num_tasks: int) -> EngineJob:
+    """Run ``runner`` (returning one (status, payload) pair per task) on a
+    daemon thread, routing per-task results/errors into an EngineJob."""
     job = EngineJob(num_tasks)
     job.job_id = -1
 
     def _run():
       try:
-        results = runner()
-        for i in range(num_tasks):
-          r = results[i] if results and i < len(results) else None
-          job._task_finished(i, result=r)
-      except Exception:  # noqa: BLE001 - deliver driver-side traceback
+        pairs = runner()
+      except Exception:  # noqa: BLE001 - whole-job (driver-side) failure
         import traceback
         tb = traceback.format_exc()
         for i in range(num_tasks):
-          if job.errors[i] is None and job.results[i] is None:
-            job._task_finished(i, error=tb)
+          job._task_finished(i, error=tb)
+        return
+      for i in range(num_tasks):
+        status, payload = pairs[i] if i < len(pairs) else \
+            (_ERR, "task %d produced no result" % i)
+        if status == _OK:
+          job._task_finished(i, result=payload)
+        else:
+          job._task_finished(i, error=payload)
 
     threading.Thread(target=_run, daemon=True,
                      name="spark-engine-job").start()
@@ -77,37 +106,41 @@ class SparkEngine(Engine):
       raise ValueError("task_payloads has %d entries for %d tasks"
                        % (len(payloads), n))
     rdd = self.sc.parallelize(payloads, n)
-
-    def _wrap(it):
-      yield fn(it)  # preserve per-task return values (LocalEngine parity)
-
-    def runner():
-      return rdd.mapPartitions(_wrap).collect()
-
-    return self._async_job(runner, n)
+    return self._async_job(rdd.mapPartitions(_capture(fn)).collect, n)
 
   def foreach_partition(self, partitions, fn) -> EngineJob:
     rdd = self._as_rdd(partitions)
     n = rdd.getNumPartitions()
 
-    def runner():
-      rdd.foreachPartition(fn)
-      return [None] * n
+    def _consume(it):
+      fn(it)
+      return None
 
-    return self._async_job(runner, n)
+    return self._async_job(rdd.mapPartitions(_capture(_consume)).collect, n)
 
   def map_partitions(self, partitions, fn, timeout=None) -> List:
     rdd = self._as_rdd(partitions)
-    if timeout is None:
-      return rdd.mapPartitions(fn).collect()
-    # honor the bound like LocalEngine: run the collect on a worker thread
-    # and fail if it exceeds the timeout
-    job = self._async_job(lambda: [rdd.mapPartitions(fn).collect()], 1)
-    return job.wait(timeout=timeout)[0]
+    n = rdd.getNumPartitions()
+    # materialize inside the task so lazy/generator errors surface per task
+    wrapped = rdd.mapPartitions(_capture(lambda it: list(fn(it))))
+    parts = self._async_job(wrapped.collect, n).wait(timeout=timeout)
+    return [row for part in parts for row in part]
+
+  def map_partitions_lazy(self, partitions, fn, timeout=None):
+    """Return the mapped RDD WITHOUT collecting (parity: reference
+    TFCluster.inference returning a lazy RDD, TFCluster.py:96-115) — the
+    caller saves/consumes it through Spark, never through the driver.
+    ``timeout`` is ignored here: no work runs until the caller's RDD
+    action, which owns its own deadline."""
+    return self._as_rdd(partitions).mapPartitions(fn)
 
   def barrier_run(self, fn, num_tasks: Optional[int] = None,
                   timeout: Optional[float] = None) -> List:
     n = num_tasks if num_tasks is not None else self._num_executors
+    if n > self._num_executors:
+      raise ValueError(
+          "barrier gang of %d exceeds %d executors (barrier stages need a "
+          "free slot per task or Spark deadlocks)" % (n, self._num_executors))
     rdd = self.sc.parallelize(range(n), n)
 
     def _task(it):
@@ -117,7 +150,14 @@ class SparkEngine(Engine):
       ctx = BarrierContext(btc.partitionId(), infos, sync_fn=btc.barrier)
       return [fn(it, ctx)]
 
-    return rdd.barrier().mapPartitions(_task).collect()
+    def _runner():
+      return [(_OK, rdd.barrier().mapPartitions(_task).collect())]
+
+    # honor the engine-contract deadline (LocalEngine parity): the collect
+    # runs on a worker thread and a hung gang raises TimeoutError here
+    # (the abandoned Spark job keeps running server-side; callers shut the
+    # cluster down on error anyway)
+    return self._async_job(_runner, 1).wait(timeout=timeout)[0]
 
   def _as_rdd(self, partitions):
     """Accept an existing RDD, a DataFrame, or driver-side partition lists."""
@@ -125,5 +165,8 @@ class SparkEngine(Engine):
       return partitions.rdd
     if hasattr(partitions, "mapPartitions"):  # RDD
       return partitions
-    return self.sc.parallelize(
-        [row for part in partitions for row in part], max(1, len(partitions)))
+    # one list element per slice keeps the caller's partition boundaries;
+    # the flatten unwraps each slice's single partition-list into its rows
+    parts = list(partitions)
+    rdd = self.sc.parallelize(parts, max(1, len(parts)))
+    return rdd.mapPartitions(lambda it: (row for part in it for row in part))

@@ -252,12 +252,17 @@ class TFModel(TFParams):
     super().__init__()
     self.tf_args = tf_args if tf_args is not None else {}
 
-  def transform(self, engine, partitions: Sequence) -> List:
-    """Run the exported bundle over partitioned rows; returns result rows.
+  def transform(self, engine, partitions: Sequence, collect: bool = True):
+    """Run the exported bundle over partitioned rows.
 
     Rows are tuples ordered by ``sorted(input_mapping)`` columns; outputs
     are tuples ordered by ``sorted(output_mapping)`` tensor names
     (column-mapping parity: pipeline.py:463-492).
+
+    ``collect=False`` returns the engine's lazy handle instead of a
+    driver-side list (Spark: the uncollected result RDD — the reference's
+    ``TFModel._transform`` returned a DataFrame, pipeline.py:487-492;
+    LocalEngine: a streaming generator), for cluster-scale inference.
     """
     args = self.merge_args_params(self.tf_args)
     export_dir = args.get("export_dir") or args.get("model_dir")
@@ -292,5 +297,8 @@ class TFModel(TFParams):
           results.append(row[0] if len(row) == 1 else row)
       return results
 
-    return engine.map_partitions(partitions, _transform_partition,
-                                 timeout=args.get("feed_timeout", 600))
+    if collect:
+      return engine.map_partitions(partitions, _transform_partition,
+                                   timeout=args.get("feed_timeout", 600))
+    return engine.map_partitions_lazy(partitions, _transform_partition,
+                                      timeout=args.get("feed_timeout", 600))

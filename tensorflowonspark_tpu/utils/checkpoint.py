@@ -32,8 +32,9 @@ class CheckpointManager(object):
     import orbax.checkpoint as ocp
     from tensorflowonspark_tpu.utils import paths
 
-    self.directory = os.path.abspath(paths.strip_scheme(directory))
-    os.makedirs(self.directory, exist_ok=True)
+    self.directory = paths.for_io(directory)
+    if not paths.is_remote_uri(self.directory):
+      os.makedirs(self.directory, exist_ok=True)
     self.save_interval_steps = save_interval_steps
     self._mgr = ocp.CheckpointManager(
         self.directory,

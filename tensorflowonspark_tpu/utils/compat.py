@@ -9,7 +9,6 @@ artifact is an Orbax checkpoint / flax state rather than a TF SavedModel.
 """
 
 import logging
-import os
 import tempfile
 
 logger = logging.getLogger(__name__)
@@ -26,10 +25,11 @@ def export_model(state, export_dir: str, is_chief: bool) -> str:
   Returns the directory actually written to.
   """
   import orbax.checkpoint as ocp
+  from tensorflowonspark_tpu.utils import paths
 
   target = export_dir if is_chief else tempfile.mkdtemp(prefix="nonchief_export_")
   ckptr = ocp.StandardCheckpointer()
-  ckptr.save(os.path.abspath(os.path.join(target, "model")), state, force=True)
+  ckptr.save(paths.for_io(paths.join(target, "model")), state, force=True)
   ckptr.wait_until_finished()
   logger.info("exported model to %s (chief=%s)", target, is_chief)
   return target
@@ -38,9 +38,10 @@ def export_model(state, export_dir: str, is_chief: bool) -> str:
 def import_model(export_dir: str, template=None):
   """Load a model state previously written by :func:`export_model`."""
   import orbax.checkpoint as ocp
+  from tensorflowonspark_tpu.utils import paths
 
   ckptr = ocp.StandardCheckpointer()
-  path = os.path.abspath(os.path.join(export_dir, "model"))
+  path = paths.for_io(paths.join(export_dir, "model"))
   if template is not None:
     return ckptr.restore(path, template)
   return ckptr.restore(path)

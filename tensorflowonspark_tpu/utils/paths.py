@@ -45,3 +45,29 @@ def strip_scheme(path: str) -> str:
   if path.startswith("file://"):
     return path[len("file://"):]
   return path
+
+
+def is_remote_uri(path: str) -> bool:
+  """True for non-local scheme URIs (gs://, hdfs://, s3://, ...)."""
+  return any(path.startswith(s) for s in _PASSTHROUGH if s != "file://")
+
+
+def for_io(path: str) -> str:
+  """Normalize a storage target for IO libraries (orbax/tensorstore).
+
+  Remote scheme URIs pass through untouched — orbax handles ``gs://`` etc.
+  natively, and ``os.path.abspath`` would mangle them into bogus local
+  paths. Local paths (with or without ``file://``) become absolute.
+  """
+  if is_remote_uri(path):
+    return path
+  import os
+  return os.path.abspath(strip_scheme(path))
+
+
+def join(path: str, *parts: str) -> str:
+  """Scheme-aware join: ``/`` for remote URIs, ``os.path.join`` locally."""
+  if is_remote_uri(path):
+    return "/".join([path.rstrip("/")] + list(parts))
+  import os
+  return os.path.join(path, *parts)

@@ -1,0 +1,150 @@
+"""Minimal in-process pyspark stand-in for SparkEngine contract tests.
+
+pyspark is not installed in this image, but an untested Spark adapter is a
+claim rather than a capability (the reference's whole identity is driving
+Spark — TFCluster.py:215-385). This stub implements exactly the RDD surface
+SparkEngine touches, with Spark-faithful semantics where they matter to the
+engine contract:
+
+- ``parallelize(data, n)`` slices like Spark (one contiguous slice per
+  partition; n elements into n slices → one element each);
+- ``mapPartitions`` is lazy; ``collect`` runs partitions concurrently in
+  threads and preserves partition order;
+- ``rdd.barrier().mapPartitions`` gang-runs all partitions with a real
+  threading.Barrier behind ``BarrierTaskContext.barrier()`` and placement
+  info via ``getTaskInfos()``.
+
+Install with ``sys.modules["pyspark"] = tests.pyspark_stub`` (see
+test_engine.py's fixture) so SparkEngine's ``from pyspark import ...``
+resolves here.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_COLLECT_TIMEOUT = 60
+
+
+def _slices(data, n):
+  """Spark's ParallelCollectionRDD slicing: contiguous, size-balanced."""
+  data = list(data)
+  n = max(1, n)
+  return [data[len(data) * i // n: len(data) * (i + 1) // n]
+          for i in range(n)]
+
+
+class _TaskInfo:
+  def __init__(self, address):
+    self.address = address
+
+
+class BarrierTaskContext:
+  """Thread-local barrier context, like pyspark's per-task singleton."""
+
+  _local = threading.local()
+
+  def __init__(self, partition_id, infos, barrier):
+    self._partition_id = partition_id
+    self._infos = infos
+    self._barrier = barrier
+
+  @classmethod
+  def get(cls):
+    return cls._local.ctx
+
+  def partitionId(self):
+    return self._partition_id
+
+  def getTaskInfos(self):
+    return list(self._infos)
+
+  def barrier(self):
+    self._barrier.wait(timeout=_COLLECT_TIMEOUT)
+
+
+class StubRDD:
+  """An RDD as a list of per-partition thunks (lazy until collect)."""
+
+  def __init__(self, sc, part_fns):
+    self.sc = sc
+    self._part_fns = part_fns
+
+  def getNumPartitions(self):
+    return len(self._part_fns)
+
+  def mapPartitions(self, fn):
+    return StubRDD(self.sc, [
+        (lambda pf=pf: fn(iter(list(pf())))) for pf in self._part_fns])
+
+  def _run_partitions(self, thunks):
+    with ThreadPoolExecutor(max_workers=max(1, len(thunks))) as ex:
+      futures = [ex.submit(lambda t=t: list(t())) for t in thunks]
+      return [f.result(timeout=_COLLECT_TIMEOUT) for f in futures]
+
+  def collect(self):
+    return [row for part in self._run_partitions(self._part_fns)
+            for row in part]
+
+  def foreachPartition(self, fn):
+    self._run_partitions([
+        (lambda pf=pf: (fn(iter(list(pf()))), ())[1])
+        for pf in self._part_fns])
+
+  def barrier(self):
+    return _StubBarrierRDD(self)
+
+
+class _StubBarrierRDD:
+  def __init__(self, rdd):
+    self._rdd = rdd
+
+  def mapPartitions(self, fn):
+    rdd = self._rdd
+    n = rdd.getNumPartitions()
+    gate = threading.Barrier(n)
+    infos = [_TaskInfo("stub-host:%d" % (40000 + i)) for i in range(n)]
+
+    def _bind(pid, pf):
+      def _run():
+        BarrierTaskContext._local.ctx = BarrierTaskContext(pid, infos, gate)
+        try:
+          return fn(iter(list(pf())))
+        finally:
+          BarrierTaskContext._local.ctx = None
+      return _run
+
+    return StubRDD(rdd.sc, [
+        _bind(i, pf) for i, pf in enumerate(rdd._part_fns)])
+
+
+class _Conf:
+  def __init__(self, values=None):
+    self._values = values or {}
+
+  def get(self, key, default=None):
+    return self._values.get(key, default)
+
+
+class SparkContext:
+  _active = None
+
+  def __init__(self, num_executors=2, conf_values=None):
+    self.defaultParallelism = num_executors
+    self._conf = _Conf(conf_values)
+    SparkContext._active = self
+
+  @classmethod
+  def getOrCreate(cls):
+    return cls._active or cls()
+
+  def getConf(self):
+    return self._conf
+
+  def parallelize(self, data, numSlices=None):
+    n = numSlices if numSlices is not None else self.defaultParallelism
+    return StubRDD(self, [
+        (lambda s=s: iter(s)) for s in _slices(data, n)])
+
+  def stop(self):
+    if SparkContext._active is self:
+      SparkContext._active = None

@@ -83,6 +83,44 @@ def test_inference_roundtrip_sum_squares(engine):
   assert sum(results) == sum(x * x for x in data)
 
 
+def test_inference_lazy_streams_without_driver_collect(engine):
+  """collect=False streams ≥10k inference rows through the driver without
+  ever materializing the full result list (parity: reference
+  TFCluster.inference returning a lazy RDD, TFCluster.py:96-115)."""
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+      batch = feed.next_batch(256)
+      if batch:
+        feed.batch_results([x + 1 for x in batch])
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  n_rows, n_parts = 12000, 24
+  pulled = []
+
+  def parts():
+    for p in range(n_parts):
+      pulled.append(p)
+      yield list(range(p * 500, (p + 1) * 500))
+
+  lazy = c.inference(parts(), feed_timeout=60, collect=False)
+  assert not isinstance(lazy, list)
+  total, count = 0, 0
+  first_row_pull_count = None
+  for row in lazy:
+    if first_row_pull_count is None:
+      first_row_pull_count = len(pulled)
+    total += row
+    count += 1
+  c.shutdown(timeout=120)
+  assert count == n_rows
+  assert total == sum(range(n_rows)) + n_rows
+  assert first_row_pull_count <= engine.num_executors + 2, \
+      "lazy inference pre-pulled the whole dataset onto the driver"
+
+
 @pytest.mark.parametrize("transport", ["queue", "shm"])
 def test_train_feed_and_shutdown(engine, transport):
   """ENGINE-mode training feed: every row reaches some worker exactly once

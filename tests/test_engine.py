@@ -1,22 +1,28 @@
-"""LocalEngine tests: Spark-parity scheduling semantics with real processes.
+"""Engine contract tests: LocalEngine (real processes) + SparkEngine (stub).
 
-Covers the engine contract the cluster layer depends on: one task per
-executor for run_on_executors, busy executors excluded from shared
-scheduling, error propagation with tracebacks, barrier gang semantics
-(parity: reference tests/test_TFParallel.py:16-51).
+The shared contract class runs against both engines — scheduling results,
+per-task error attribution, barrier gang semantics (parity: reference
+tests/test_TFParallel.py:16-51). LocalEngine-specific tests cover the
+process-isolation behaviors a thread-backed stub cannot exhibit.
+
+SparkEngine runs against tests/pyspark_stub.py (pyspark is not installed in
+CI); the stub keeps Spark's slicing/partition-order/barrier semantics.
 """
 
 import os
+import sys
 import time
 
 import pytest
 
 from tensorflowonspark_tpu.engine import LocalEngine
 
+sys.path.insert(0, os.path.dirname(__file__))
+
 
 def _slot_and_pid(it):
   consumed = list(it)
-  return (consumed, os.environ["TOS_EXECUTOR_SLOT"], os.getpid())
+  return (consumed, os.environ.get("TOS_EXECUTOR_SLOT", "-"), os.getpid())
 
 
 def _square_sum(it):
@@ -26,6 +32,13 @@ def _square_sum(it):
 def _boom(it):
   list(it)
   raise ValueError("deliberate failure for testing")
+
+
+def _boom_on_two(it):
+  rows = list(it)
+  if 2 in rows:
+    raise ValueError("deliberate failure on partition with 2")
+  return rows
 
 
 def _sleep_then_slot(it):
@@ -41,26 +54,59 @@ def _barrier_fn(it, ctx):
   return (task_id, len(infos))
 
 
-class TestLocalEngine:
-  @pytest.fixture(scope="class")
-  def engine(self):
-    e = LocalEngine(num_executors=2)
-    yield e
-    e.stop()
+@pytest.fixture(scope="module")
+def local_engine():
+  e = LocalEngine(num_executors=2)
+  yield e
+  e.stop()
 
-  def test_run_on_executors_distinct_processes(self, engine):
-    results = engine.run_on_executors(_slot_and_pid).wait(timeout=30)
-    slots = sorted(r[1] for r in results)
-    pids = {r[2] for r in results}
-    assert slots == ["0", "1"]
-    assert len(pids) == 2            # real separate processes
-    assert os.getpid() not in pids
-    assert [r[0] for r in sorted(results)] == [[0], [1]]
+
+@pytest.fixture(scope="module")
+def spark_engine():
+  import pyspark_stub
+  sys.modules["pyspark"] = pyspark_stub
+  from tensorflowonspark_tpu.engine.spark import SparkEngine
+  e = SparkEngine(sc=pyspark_stub.SparkContext(num_executors=2))
+  yield e
+  sys.modules.pop("pyspark", None)
+
+
+@pytest.fixture(params=["local", "spark"])
+def engine(request):
+  return request.getfixturevalue(request.param + "_engine")
+
+
+class TestEngineContract:
+  """Runs against BOTH engines."""
+
+  def test_run_on_executors_routes_payloads(self, engine):
+    results = engine.run_on_executors(_slot_and_pid, num_tasks=2).wait(
+        timeout=30)
+    assert sorted(r[0] for r in results) == [[0], [1]]
+
+  def test_run_on_executors_custom_payloads(self, engine):
+    results = engine.run_on_executors(
+        _slot_and_pid, num_tasks=2, task_payloads=["a", "b"]).wait(timeout=30)
+    assert sorted(r[0] for r in results) == [["a"], ["b"]]
 
   def test_map_partitions_collects(self, engine):
     parts = [[1, 2], [3], [4, 5, 6]]
     got = engine.map_partitions(parts, _square_sum, timeout=30)
     assert sorted(got) == [5, 9, 77]
+
+  def test_map_partitions_preserves_boundaries(self, engine):
+    # one result per partition proves boundaries were not re-sliced
+    parts = [[1, 2], [3], [4, 5, 6]]
+    got = engine.map_partitions(parts, lambda it: [len(list(it))], timeout=30)
+    assert sorted(got) == [1, 2, 3]
+
+  def test_generator_results_materialized(self, engine):
+    def gen_fn(it):
+      for x in it:
+        yield x + 100
+
+    got = engine.map_partitions([[1, 2]], gen_fn, timeout=30)
+    assert got == [101, 102]
 
   def test_error_propagates_with_traceback(self, engine):
     job = engine.foreach_partition([[1], [2]], _boom)
@@ -68,27 +114,13 @@ class TestLocalEngine:
       job.wait(timeout=30)
     assert "ValueError" in job.first_error()
 
-  def test_busy_executor_excluded_from_shared_tasks(self, engine):
-    # pin a slow task onto each executor, then queue shared work; shared
-    # tasks must wait for a free executor, not interleave
-    slow = engine.run_on_executors(_sleep_then_slot, num_tasks=1)
-    t0 = time.time()
-    got = engine.map_partitions([[1]], _square_sum, timeout=30)
-    assert got == [1]
-    slow.wait(timeout=30)
-    assert time.time() - t0 < 5
-
-  def test_executor_workdirs_isolated(self, engine):
-    def write_marker(it):
-      i = list(it)[0]
-      with open("marker.txt", "w") as f:
-        f.write(str(i))
-      return os.getcwd()
-
-    dirs = engine.run_on_executors(write_marker).wait(timeout=30)
-    assert len(set(dirs)) == 2
-    for d in dirs:
-      assert os.path.exists(os.path.join(d, "marker.txt"))
+  def test_error_attributed_to_failing_task_only(self, engine):
+    job = engine.foreach_partition([[1], [2]], _boom_on_two)
+    with pytest.raises(RuntimeError, match="partition with 2"):
+      job.wait(timeout=30)
+    errors = [e for e in job.errors if e is not None]
+    assert len(errors) == 1, "only the failing task should carry an error"
+    assert "partition with 2" in errors[0]
 
   def test_barrier_run(self, engine):
     got = engine.barrier_run(_barrier_fn, num_tasks=2, timeout=60)
@@ -98,14 +130,109 @@ class TestLocalEngine:
     with pytest.raises(ValueError, match="barrier gang"):
       engine.barrier_run(_barrier_fn, num_tasks=5)
 
-  def test_run_on_executors_too_many_tasks_raises(self, engine):
+  def test_default_fs(self, engine):
+    assert engine.default_fs() == "file://"
+
+
+class TestLazyMapPartitions:
+  def test_local_lazy_streams_bounded(self, local_engine):
+    """The driver must never hold the full result set: with a window of 2
+    executors, at most window+1 partitions may have been pulled from the
+    source by the time the first row is consumed."""
+    pulled = []
+
+    def parts():
+      for p in range(20):
+        pulled.append(p)
+        yield [p * 600 + i for i in range(600)]   # 12,000 rows total
+
+    lazy = local_engine.map_partitions_lazy(parts(),
+                                            lambda it: [x * 2 for x in it])
+    first = next(lazy)
+    assert first == 0
+    assert len(pulled) <= local_engine.num_executors + 2, \
+        "lazy path pre-pulled the whole dataset"
+    rest = list(lazy)
+    assert len(rest) == 12000 - 1
+    assert rest[-1] == (20 * 600 - 1) * 2
+
+  def test_local_lazy_propagates_errors(self, local_engine):
+    lazy = local_engine.map_partitions_lazy([[1], [2]], _boom_on_two)
+    with pytest.raises(RuntimeError, match="partition with 2"):
+      list(lazy)
+
+  def test_spark_lazy_returns_uncollected_rdd(self, spark_engine):
+    lazy = spark_engine.map_partitions_lazy([[1, 2], [3]], _square_sum)
+    assert not isinstance(lazy, list)
+    assert hasattr(lazy, "mapPartitions"), "expected an RDD-like handle"
+    assert sorted(lazy.collect()) == [5, 9]
+
+
+class TestLocalEngine:
+  """Process-isolation behaviors only real subprocess executors exhibit."""
+
+  def test_run_on_executors_distinct_processes(self, local_engine):
+    results = local_engine.run_on_executors(_slot_and_pid).wait(timeout=30)
+    slots = sorted(r[1] for r in results)
+    pids = {r[2] for r in results}
+    assert slots == ["0", "1"]
+    assert len(pids) == 2            # real separate processes
+    assert os.getpid() not in pids
+
+  def test_busy_executor_excluded_from_shared_tasks(self, local_engine):
+    # pin a slow task onto each executor, then queue shared work; shared
+    # tasks must wait for a free executor, not interleave
+    slow = local_engine.run_on_executors(_sleep_then_slot, num_tasks=1)
+    t0 = time.time()
+    got = local_engine.map_partitions([[1]], _square_sum, timeout=30)
+    assert got == [1]
+    slow.wait(timeout=30)
+    assert time.time() - t0 < 5
+
+  def test_executor_workdirs_isolated(self, local_engine):
+    def write_marker(it):
+      i = list(it)[0]
+      with open("marker.txt", "w") as f:
+        f.write(str(i))
+      return os.getcwd()
+
+    dirs = local_engine.run_on_executors(write_marker).wait(timeout=30)
+    assert len(set(dirs)) == 2
+    for d in dirs:
+      assert os.path.exists(os.path.join(d, "marker.txt"))
+
+  def test_run_on_executors_too_many_tasks_raises(self, local_engine):
     with pytest.raises(ValueError, match="executors"):
-      engine.run_on_executors(_slot_and_pid, num_tasks=3)
+      local_engine.run_on_executors(_slot_and_pid, num_tasks=3)
 
-  def test_generator_results_materialized(self, engine):
-    def gen_fn(it):
-      for x in it:
-        yield x + 100
+  def test_finished_jobs_evicted(self, local_engine):
+    """The engine must not pin every job's results forever — the lazy map
+    path's bounded-memory contract depends on eviction."""
+    local_engine.map_partitions([[1, 2], [3]], _square_sum, timeout=30)
+    deadline = time.time() + 5
+    while local_engine._jobs and time.time() < deadline:
+      time.sleep(0.05)
+    assert not local_engine._jobs
 
-    got = engine.map_partitions([[1, 2]], gen_fn, timeout=30)
-    assert got == [101, 102]
+
+class TestSparkEngineSpecific:
+  def test_num_executors_from_conf(self):
+    import pyspark_stub
+    from tensorflowonspark_tpu.engine.spark import SparkEngine
+    sc = pyspark_stub.SparkContext(
+        num_executors=8, conf_values={"spark.executor.instances": "3"})
+    assert SparkEngine(sc=sc).num_executors == 3
+
+  def test_accepts_existing_rdd(self, spark_engine):
+    rdd = spark_engine.sc.parallelize([1, 2, 3, 4], 2)
+    got = spark_engine.map_partitions(rdd, _square_sum, timeout=30)
+    assert sorted(got) == [5, 25]
+
+  def test_barrier_timeout_enforced(self, spark_engine):
+    def _slow_barrier_fn(it, ctx):
+      list(it)
+      time.sleep(5.0)
+      return None
+
+    with pytest.raises(TimeoutError):
+      spark_engine.barrier_run(_slow_barrier_fn, num_tasks=2, timeout=0.5)

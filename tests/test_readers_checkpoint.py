@@ -97,6 +97,63 @@ class TestCheckpointManager:
     assert step == 0
     np.testing.assert_allclose(np.asarray(state["w"]), [1, 1])
 
+  def test_gcs_uri_reaches_orbax_untouched(self, monkeypatch):
+    """gs:// targets must not be abspath-mangled into local paths (orbax
+    handles cloud schemes natively; parity: reference TFNode.py:32-67)."""
+    import orbax.checkpoint as ocp
+    from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
+
+    seen = {}
+
+    class Recorder:
+      def __init__(self, directory, options=None):
+        seen["directory"] = directory
+
+    monkeypatch.setattr(ocp, "CheckpointManager", Recorder)
+    mgr = CheckpointManager("gs://bucket/experiments/run1")
+    assert mgr.directory == "gs://bucket/experiments/run1"
+    assert seen["directory"] == "gs://bucket/experiments/run1"
+
+
+class TestExportPathConstruction:
+  def test_gcs_export_uri_untouched(self, monkeypatch):
+    import orbax.checkpoint as ocp
+    from tensorflowonspark_tpu.utils import compat
+
+    seen = {}
+
+    class Recorder:
+      def save(self, path, state, force=False):
+        seen["path"] = path
+
+      def wait_until_finished(self):
+        pass
+
+    monkeypatch.setattr(ocp, "StandardCheckpointer", Recorder)
+    out = compat.export_model({"w": np.zeros(2)},
+                              "gs://bucket/exports/model_v1", is_chief=True)
+    assert out == "gs://bucket/exports/model_v1"
+    assert seen["path"] == "gs://bucket/exports/model_v1/model"
+
+  def test_local_export_still_absolute(self, monkeypatch, tmp_path):
+    import orbax.checkpoint as ocp
+    from tensorflowonspark_tpu.utils import compat
+
+    seen = {}
+
+    class Recorder:
+      def save(self, path, state, force=False):
+        seen["path"] = path
+
+      def wait_until_finished(self):
+        pass
+
+    monkeypatch.setattr(ocp, "StandardCheckpointer", Recorder)
+    compat.export_model({"w": np.zeros(2)}, str(tmp_path / "exp"),
+                        is_chief=True)
+    assert seen["path"] == str(tmp_path / "exp" / "model")
+    assert seen["path"].startswith("/")
+
 
 class TestFlashAttentionGrad:
   @pytest.mark.parametrize("causal,blk_q,blk_k", [

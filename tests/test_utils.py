@@ -63,6 +63,27 @@ class TestPaths:
     assert paths.strip_scheme("file:///tmp/x") == "/tmp/x"
     assert paths.strip_scheme("/tmp/x") == "/tmp/x"
 
+  def test_is_remote_uri(self):
+    assert paths.is_remote_uri("gs://bucket/x")
+    assert paths.is_remote_uri("s3://bucket/x")
+    assert not paths.is_remote_uri("file:///tmp/x")
+    assert not paths.is_remote_uri("/tmp/x")
+    assert not paths.is_remote_uri("rel/x")
+
+  def test_for_io_remote_untouched(self):
+    assert paths.for_io("gs://bucket/dir") == "gs://bucket/dir"
+    assert paths.for_io("hdfs://nn:8020/dir") == "hdfs://nn:8020/dir"
+
+  def test_for_io_local_absolute(self, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    assert paths.for_io("rel/dir") == str(tmp_path / "rel" / "dir")
+    assert paths.for_io("file:///tmp/x") == "/tmp/x"
+
+  def test_join_scheme_aware(self):
+    assert paths.join("gs://bucket/dir", "model") == "gs://bucket/dir/model"
+    assert paths.join("gs://bucket/dir/", "a", "b") == "gs://bucket/dir/a/b"
+    assert paths.join("/tmp/dir", "model") == "/tmp/dir/model"
+
 
 class TestTPUInfo:
   """Mocked discovery/allocation matrix (no real TPU needed)."""
