@@ -40,12 +40,21 @@ class TransformerConfig:
   # "auto": Pallas flash attention on TPU, dense elsewhere; or force
   # "flash" / "dense"
   attention_impl: str = "auto"
+  # "auto": fused Pallas LayerNorm (ops.layer_norm) on TPU, flax elsewhere;
+  # "fused" forces the kernel everywhere (interpret mode off-TPU — how CPU
+  # CI exercises the production code path); "flax" opts out
+  layer_norm_impl: str = "auto"
   # Mixture-of-experts: when moe_experts > 0, every `moe_every`-th layer
   # (moe_every >= 1) replaces its dense MLP with an expert-routed FFN
   # (parallel.expert_parallel; experts shard over the `expert` mesh axis)
   moe_experts: int = 0
   moe_top_k: int = 1
   moe_every: int = 2
+  # > 0 enables GShard-style all-to-all dispatch with this capacity factor
+  # when the expert mesh axis is sharded (communication-optimal; overflow
+  # tokens above ceil(T_local·k/E)·factor are dropped); 0 keeps the exact
+  # dense-masked dispatch
+  moe_capacity_factor: float = 0.0
 
   def __post_init__(self):
     if self.moe_experts > 0 and self.moe_every < 1:
@@ -84,6 +93,50 @@ def _flash_eligible(cfg: TransformerConfig, seq_len: int) -> bool:
   if jax.default_backend() != "tpu":
     return False
   return seq_len % min(128, max(1, seq_len)) == 0
+
+
+def _fused_ln_eligible(cfg: TransformerConfig) -> bool:
+  """Whether blocks should use the fused Pallas LayerNorm."""
+  if cfg.layer_norm_impl == "flax":
+    return False
+  if cfg.layer_norm_impl == "fused":
+    return True
+  return jax.default_backend() == "tpu"
+
+
+class FusedLayerNorm(nn.Module):
+  """LayerNorm via the fused Pallas kernel (ops.layer_norm).
+
+  Same parameter ("scale"), stats dtype (f32) and eps as the flax
+  ``nn.LayerNorm(use_bias=False)`` it replaces, so checkpoints are
+  interchangeable across ``layer_norm_impl`` settings. With a mesh the
+  kernel maps per-shard through shard_map (ops.layer_norm_sharded) — an
+  unpartitioned pallas_call over GSPMD-sharded activations would force
+  gathers (ROADMAP: ops coverage).
+  """
+  mesh: Optional[Any] = None
+  eps: float = 1e-6
+  interpret: bool = False
+
+  @nn.compact
+  def __call__(self, x):
+    from tensorflowonspark_tpu import ops
+    w = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                   jnp.float32)
+    # x goes in at its native dtype — the kernel computes f32 statistics
+    # internally, so upcasting here would only double the HBM read traffic
+    # (the downstream matmuls cast to cfg.dtype regardless)
+    if self.mesh is not None:
+      return ops.layer_norm_sharded(x, w, self.mesh, eps=self.eps,
+                                    interpret=self.interpret)
+    return ops.layer_norm(x, w, eps=self.eps, interpret=self.interpret)
+
+
+def _make_layer_norm(cfg: TransformerConfig, mesh, name: str):
+  if _fused_ln_eligible(cfg):
+    return FusedLayerNorm(mesh=mesh, name=name,
+                          interpret=jax.default_backend() != "tpu")
+  return nn.LayerNorm(dtype=jnp.float32, use_bias=False, name=name)
 
 
 class Attention(nn.Module):
@@ -218,14 +271,21 @@ class MoEBlock(nn.Module):
     flat = x.reshape(-1, d)
     # one router forward feeds both the dispatch and the aux loss
     dispatch, combine, probs = ep.route(params, flat, cfg.moe_top_k)
-    routing = (dispatch, combine)
-    if self.mesh is not None and \
-        self.mesh.shape.get(mesh_lib.AXIS_EXPERT, 1) > 1:
+    expert_sharded = self.mesh is not None and \
+        self.mesh.shape.get(mesh_lib.AXIS_EXPERT, 1) > 1
+    if expert_sharded and cfg.moe_capacity_factor > 0:
+      # communication-optimal path: tokens exchanged over the expert axis
+      # with two all-to-alls, each device runs only its experts (the
+      # router re-runs per-shard inside the body — a tiny matmul)
+      y = ep.moe_ffn_a2a(params, flat, self.mesh,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         top_k=cfg.moe_top_k)
+    elif expert_sharded:
       y = ep.moe_ffn(params, flat, self.mesh, top_k=cfg.moe_top_k,
-                     routing=routing)
+                     routing=(dispatch, combine))
     else:
       y = ep.moe_ffn_reference(params, flat, top_k=cfg.moe_top_k,
-                               routing=routing)
+                               routing=(dispatch, combine))
     self.sow("intermediates", "moe_aux",
              ep.aux_loss_from(probs, dispatch, cfg.moe_top_k))
     return y.reshape(x.shape).astype(x.dtype)
@@ -239,10 +299,10 @@ class Block(nn.Module):
   @nn.compact
   def __call__(self, x, positions, decode: bool = False):
     cfg = self.cfg
-    y = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln1")(x)
+    y = _make_layer_norm(cfg, self.mesh, "ln1")(x)
     x = x + Attention(cfg, self.mesh, name="attn")(y, positions,
                                                    decode=decode)
-    y = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln2")(x)
+    y = _make_layer_norm(cfg, self.mesh, "ln2")(x)
     if self.use_moe:
       x = x + MoEBlock(cfg, self.mesh, name="moe")(y)
     else:
@@ -278,7 +338,7 @@ class Transformer(nn.Module):
       layer = block(cfg, self.mesh, use_moe, name="layer_%d" % i)
       x = layer(x, positions, True) if decode else layer(x, positions)
 
-    x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f")(x)
+    x = _make_layer_norm(cfg, self.mesh, "ln_f")(x)
     # tied output projection (attend to the embedding table)
     logits = emb.attend(x.astype(cfg.dtype))
     return logits.astype(jnp.float32)

@@ -11,4 +11,6 @@ few ops XLA cannot fuse optimally are written in Pallas:
 from tensorflowonspark_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention, flash_attention_block, merge_partials,
 )
-from tensorflowonspark_tpu.ops.layer_norm import layer_norm  # noqa: F401
+from tensorflowonspark_tpu.ops.layer_norm import (  # noqa: F401
+    layer_norm, layer_norm_sharded,
+)

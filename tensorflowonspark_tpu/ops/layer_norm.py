@@ -55,6 +55,34 @@ def layer_norm(x, weight, eps: float = 1e-6, blk_rows: int = 128,
   return _ln_vjp(x, weight, eps, blk_rows, interpret)
 
 
+def layer_norm_sharded(x, weight, mesh, eps: float = 1e-6,
+                       blk_rows: int = 128, interpret: bool = False,
+                       batch_axes=None):
+  """Fused LayerNorm applied per-shard through shard_map.
+
+  For activations living inside a GSPMD-partitioned model: an
+  unpartitioned ``pallas_call`` on sharded activations would force XLA to
+  gather them; mapping the kernel over shards keeps each device's rows
+  local (the norm reduces only over ``hidden``, which must be unsharded).
+
+  x: [batch, seq, hidden] with batch sharded over the data(+fsdp) axes and
+  seq optionally over the sequence axis; weight replicated.
+  """
+  from jax import shard_map
+  from jax.sharding import PartitionSpec as P
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+  if batch_axes is None:
+    batch_axes = mesh_lib.data_axes(mesh)
+  seq_axis = mesh_lib.AXIS_SEQUENCE \
+      if mesh_lib.AXIS_SEQUENCE in mesh.axis_names else None
+  spec = P(batch_axes or None, seq_axis, None)
+  fn = shard_map(
+      lambda xs, w: layer_norm(xs, w, eps, blk_rows, interpret),
+      mesh=mesh, in_specs=(spec, P(None)), out_specs=spec, check_vma=False)
+  return fn(x, weight)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def _ln_vjp(x, weight, eps, blk_rows, interpret):
   return _ln_fwd(x, weight, eps, blk_rows, interpret)[0]

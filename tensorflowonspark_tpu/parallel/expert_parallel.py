@@ -65,6 +65,16 @@ def _gate(x, w_gate):
   return onehot, jnp.max(probs, axis=-1)
 
 
+def _combine_weights(probs, dispatch, top_k: int):
+  """Combine weights [T, E] for a multi-hot dispatch: gate probabilities,
+  renormalized over the selected set for top_k > 1. The single source of
+  this math for every dispatch strategy."""
+  selected = probs * dispatch
+  if top_k == 1:
+    return selected
+  return selected / jnp.sum(selected, axis=-1, keepdims=True)
+
+
 def route(params, x, top_k: int = 1):
   """Top-k routing: (dispatch [T,E] multi-hot, combine [T,E], probs [T,E]).
 
@@ -76,12 +86,7 @@ def route(params, x, top_k: int = 1):
   """
   probs = _router_probs(x, params["w_gate"])
   dispatch = _topk_dispatch(probs, top_k)               # [T, E]
-  selected = probs * dispatch
-  if top_k == 1:
-    combine = selected
-  else:
-    combine = selected / jnp.sum(selected, axis=-1, keepdims=True)
-  return dispatch, combine, probs
+  return dispatch, _combine_weights(probs, dispatch, top_k), probs
 
 
 def _route(params, x, top_k: int = 1):
@@ -148,26 +153,27 @@ def moe_ffn(params, x, mesh, top_k: int = 1, routing=None):
   return fn(x, dispatch, combine, params["w_up"], params["w_down"])
 
 
-def _moe_a2a_local(x, w_gate, w_up, w_down, capacity: int):
+def _moe_a2a_local(x, w_gate, w_up, w_down, capacity: int, top_k: int):
   """shard_map body for all-to-all dispatch (GShard-style).
 
   x: [T_local, D] (tokens sharded over data×expert axes);
   w_gate replicated [D, E]; w_up/w_down sharded [E_local, ...].
-  Tokens route to global experts, dispatch tensors are exchanged over the
-  ``expert`` axis with two all-to-alls, and each device runs only its own
-  experts on only their assigned tokens (capacity-bounded; overflow tokens
-  are dropped, the standard top-1 capacity semantics).
+  Tokens route to their top-k global experts, dispatch tensors are
+  exchanged over the ``expert`` axis with two all-to-alls, and each device
+  runs only its own experts on only their assigned tokens
+  (capacity-bounded; overflow (token, expert) assignments are dropped, the
+  standard GShard capacity semantics).
   """
   xf = x.astype(jnp.float32)
-  onehot, gate = _gate(x, w_gate)                  # [T, E], [T]
-  num_experts = w_gate.shape[-1]
-  # position of each token within its expert's queue
-  pos = jnp.cumsum(onehot, axis=0) * onehot - onehot            # [T, E]
-  pos_scalar = jnp.sum(pos, axis=-1).astype(jnp.int32)          # [T]
-  keep = (pos_scalar < capacity).astype(jnp.float32)
-  dispatch = (onehot * keep[:, None])[:, :, None] * \
-      jax.nn.one_hot(pos_scalar, capacity, dtype=jnp.float32)[:, None, :]
-  combine = dispatch * gate[:, None, None]          # [T, E, C]
+  probs = _router_probs(x, w_gate)                  # [T, E]
+  mh = _topk_dispatch(probs, top_k)                 # [T, E] binary multi-hot
+  combine_w = _combine_weights(probs, mh, top_k)
+  # position of each (token, expert) assignment in that expert's queue
+  pos = (jnp.cumsum(mh, axis=0) - 1.0) * mh                      # [T, E]
+  keep = mh * (pos < capacity)
+  dispatch = keep[:, :, None] * jax.nn.one_hot(
+      pos.astype(jnp.int32), capacity, dtype=jnp.float32)        # [T, E, C]
+  combine = dispatch * combine_w[:, :, None]                     # [T, E, C]
 
   expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)   # [E, C, D]
   # exchange: every device sends each peer its slice of the expert dim
@@ -182,16 +188,19 @@ def _moe_a2a_local(x, w_gate, w_up, w_down, capacity: int):
   return y.astype(x.dtype)
 
 
-def moe_ffn_a2a(params, x, mesh, capacity_factor: float = 2.0):
+def moe_ffn_a2a(params, x, mesh, capacity_factor: float = 2.0,
+                top_k: int = 1):
   """Expert-parallel MoE with all-to-all token dispatch.
 
   Communication-optimal variant of :func:`moe_ffn`: tokens are sharded
   over the data AND expert axes, each device dispatches its tokens to the
   owning experts with two ``all_to_all`` collectives (ICI neighbor
   traffic), and only capacity-bounded expert work runs per device —
-  instead of every device touching every token. Top-1 routing with
-  capacity ``ceil(T_local / E) * capacity_factor`` per expert per shard;
-  overflow tokens pass through with zero output (standard semantics).
+  instead of every device touching every token. Top-k routing with
+  capacity ``ceil(T_local · k / E) * capacity_factor`` per expert per
+  shard; overflow assignments contribute zero output (standard GShard
+  semantics; with top-k > 1 a token's surviving experts keep their
+  renormalized weights).
   """
   from jax import shard_map
 
@@ -200,9 +209,9 @@ def moe_ffn_a2a(params, x, mesh, capacity_factor: float = 2.0):
   token_axes = tuple(batch_axes) + (mesh_lib.AXIS_EXPERT,)
   shards = mesh_lib.axis_size(mesh, *token_axes)
   t_local = x.shape[0] // shards
-  capacity = max(1, int(-(-t_local // num_experts) * capacity_factor))
+  capacity = max(1, int(-(-t_local * top_k // num_experts) * capacity_factor))
 
-  fn = functools.partial(_moe_a2a_local, capacity=capacity)
+  fn = functools.partial(_moe_a2a_local, capacity=capacity, top_k=top_k)
   return shard_map(
       fn, mesh=mesh,
       in_specs=(P(token_axes), P(), P(mesh_lib.AXIS_EXPERT),
