@@ -177,6 +177,18 @@ class TPUCluster(object):
                                                       "control")])
       self.engine.foreach_partition([[n["executor_id"]] for n in workers],
                                     fn).wait()
+    elif any(n.get("tb_url") for n in self.cluster_info):
+      # FILES mode has no feed-shutdown job; still reap the TensorBoard the
+      # chief spawned. One PINNED task per executor slot (shared-queue tasks
+      # could all land on one free executor and miss the chief's), each
+      # best-effort so a dead node can't abort the rest of shutdown.
+      fn = node_mod.make_tb_kill_fn(self.cluster_info, self.cluster_meta)
+      try:
+        self.engine.run_on_executors(
+            fn, num_tasks=self.engine.num_executors).wait(
+                raise_on_error=False)
+      except Exception as e:  # noqa: BLE001 - reap is best-effort
+        logger.warning("tensorboard reap job failed: %s", e)
 
     # stop ps/evaluator nodes by reaching their remote hubs directly
     # (parity: TFCluster.py:186-194)

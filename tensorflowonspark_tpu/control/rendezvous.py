@@ -96,11 +96,21 @@ class Reservations(object):
     executor_id = meta["executor_id"]
     with self._lock:
       prev = self._table.get(executor_id)
-      if prev is not None and prev.get("host") != meta.get("host"):
-        # two different hosts claiming one slot: record for the sanity check
-        self.duplicates.append(meta)
-        logger.warning("duplicate reservation for executor %d: %s vs %s",
-                       executor_id, prev.get("host"), meta.get("host"))
+      if prev is not None:
+        same_host = prev.get("host") == meta.get("host")
+        same_proc = same_host and prev.get("pid") == meta.get("pid")
+        # Legitimate replacements: the same process re-sending (lost reply),
+        # or a retried task that RECLAIMED its predecessor's stale hub (it
+        # proved the old owner is gone — node.py's live-hub check). A fresh
+        # registration colliding with a live entry — same host or not — is a
+        # concurrent duplicate (two tasks claiming one executor slot, the
+        # reference's TFCluster.py:357-372 failure mode) and must surface.
+        if not same_proc and not (same_host and meta.get("reclaimed")):
+          self.duplicates.append(meta)
+          logger.warning(
+              "duplicate reservation for executor %d: %s pid=%s vs %s pid=%s",
+              executor_id, prev.get("host"), prev.get("pid"),
+              meta.get("host"), meta.get("pid"))
       self._table[executor_id] = meta
 
   def done(self) -> bool:
@@ -175,35 +185,64 @@ class Server(MessageSocket):
     logger.info("rendezvous server listening at %s", self.addr)
     return self.addr
 
+  @staticmethod
+  def _drain_frames(buf: bytearray) -> List[dict]:
+    """Pop every complete length-prefixed message from ``buf`` (mutates it).
+
+    Partial frames stay buffered — a client that stalls mid-message costs
+    nothing; its bytes wait here while other connections are served.
+    """
+    msgs = []
+    while len(buf) >= _HEADER.size:
+      (length,) = _HEADER.unpack(bytes(buf[:_HEADER.size]))
+      if length > MAX_MESSAGE_BYTES:
+        raise ConnectionError(
+            "oversized rendezvous message (%d bytes); dropping connection"
+            % length)
+      if len(buf) < _HEADER.size + length:
+        break
+      payload = bytes(buf[_HEADER.size:_HEADER.size + length])
+      del buf[:_HEADER.size + length]
+      msgs.append(msgpack.unpackb(payload, raw=False))
+    return msgs
+
   def _serve(self) -> None:
-    conns = [self._listener]
+    # per-connection receive buffers: reads are one recv() per select hit
+    # (never a blocking read-to-completion), so one slow/stalled peer cannot
+    # serialize the control plane for everyone else
+    conns: Dict[socket.socket, bytearray] = {}
     while not self.done.is_set():
       try:
-        readable, _, _ = select.select(conns, [], [], 0.25)
+        readable, _, _ = select.select([self._listener] + list(conns),
+                                       [], [], 0.25)
       except OSError:
         break
       for s in readable:
         if s is self._listener:
           try:
             client, _ = self._listener.accept()
-            # a client that stalls mid-message must not wedge the single
-            # serve thread: bound each blocking read
+            # bounds sendall toward a peer that never drains replies
             client.settimeout(30.0)
-            conns.append(client)
+            conns[client] = bytearray()
           except OSError:
             pass
-        else:
-          try:
-            msg = self.receive(s)
+          continue
+        try:
+          chunk = s.recv(65536)
+          if not chunk:
+            raise ConnectionError("peer closed")
+          buf = conns[s]
+          buf += chunk
+          for msg in self._drain_frames(buf):
             self._handle(s, msg)
-          except Exception as e:  # noqa: BLE001 - a bad client (garbage
-            # bytes, truncated msgpack, malformed REG) must never kill the
-            # serve loop; drop only that connection
-            if not isinstance(e, (ConnectionError, OSError)):
-              logger.warning("dropping rendezvous connection after bad "
-                             "message: %s", e)
-            conns.remove(s)
-            s.close()
+        except Exception as e:  # noqa: BLE001 - a bad client (garbage
+          # bytes, truncated msgpack, malformed REG) must never kill the
+          # serve loop; drop only that connection
+          if not isinstance(e, (ConnectionError, OSError)):
+            logger.warning("dropping rendezvous connection after bad "
+                           "message: %s", e)
+          del conns[s]
+          s.close()
     for s in conns:
       try:
         s.close()

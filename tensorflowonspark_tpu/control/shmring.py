@@ -132,6 +132,7 @@ class RingQueueAdapter(object):
 
   def __init__(self, ring: "ShmRing"):
     self._ring = ring
+    self._closed = False
     import collections
     self._buffer = collections.deque()
 
@@ -172,13 +173,23 @@ class RingQueueAdapter(object):
 
   def get_many(self, max_items: int, block: bool = True, timeout=None):
     if not self._buffer:
+      if self._closed:
+        return []
       try:
         got = self._ring.get_batch(
             timeout=(timeout if timeout is not None else
                      (None if block else 0.0)))
         self._buffer.extend(got)
-      except (RingTimeout, RingClosed):
+      except RingTimeout:
         return []
+      except RingClosed:
+        # producer closed the ring without an in-band end-of-feed marker
+        # (e.g. it died): synthesize one, exactly once, so
+        # DataFeed.next_batch reaches done_feeding instead of polling an
+        # empty closed ring forever — and later calls return [] so
+        # DataFeed.terminate's consecutive-empty drain loop still ends
+        self._closed = True
+        return [None]
     out = []
     while self._buffer and len(out) < max_items:
       out.append(self._buffer.popleft())

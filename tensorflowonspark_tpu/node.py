@@ -173,17 +173,38 @@ def _build_cluster_spec(cluster_info: List[dict]) -> Dict[str, List[str]]:
   return spec
 
 
+def _find_tensorboard(search_path: Optional[str] = None):
+  """Locate a TensorBoard entry point, or False.
+
+  Searches the python bin dir, PATH, sys.path and PYTHONPATH for the
+  ``tensorboard`` executable, then for the module form ``tensorboard/main.py``
+  (parity: the reference's three-step search, TFSparkNode.py:310-322).
+  """
+  if search_path is None:
+    search_path = os.pathsep.join([
+        os.path.dirname(sys.executable),
+        os.environ.get("PATH", ""),
+        os.pathsep.join(p for p in sys.path if p),
+        os.environ.get("PYTHONPATH", ""),
+    ])
+  return hostinfo.find_in_path(search_path, "tensorboard") or \
+      hostinfo.find_in_path(search_path,
+                            os.path.join("tensorboard", "main.py"))
+
+
 def _spawn_tensorboard(log_dir: str) -> Optional[dict]:
   """Launch a TensorBoard server subprocess (parity: TFSparkNode.py:292-329).
 
   Port selection: env ``TENSORBOARD_PORT`` or an ephemeral bind. Returns
-  {'pid','url'} or None when no tensorboard binary is on PATH/PYTHONPATH.
+  {'pid','url'} or None when no tensorboard entry point is found on the
+  python bin dir / PATH / sys.path / PYTHONPATH.
   """
   tb_port = os.environ.get("TENSORBOARD_PORT")
   port = int(tb_port) if tb_port else hostinfo.get_free_port()
-  tb_bin = hostinfo.find_in_path(os.environ.get("PATH", ""), "tensorboard")
+  tb_bin = _find_tensorboard()
   if not tb_bin:
-    logger.warning("tensorboard binary not found; skipping launch")
+    logger.warning("tensorboard not found on PATH/PYTHONPATH; skipping "
+                   "launch")
     return None
   proc = subprocess.Popen(
       [sys.executable, tb_bin, "--logdir", log_dir, "--port", str(port),
@@ -244,7 +265,8 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
     # so the engine retries elsewhere. Anything else (dead socket, stale
     # 'stopped' hub, or an AuthenticationError from a *previous* cluster's
     # hub with a different key) is reclaimed, releasing the old manager.
-    if os.path.exists(os.path.join(working_dir, HUB_ADDR_FILE)):
+    reclaimed = os.path.exists(os.path.join(working_dir, HUB_ADDR_FILE))
+    if reclaimed:
       try:
         with open(os.path.join(working_dir, HUB_ADDR_FILE)) as f:
           host, port = f.read().strip().split(":")
@@ -317,6 +339,10 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
         "hub_addr": list(hub.addr),
         "pid": os.getpid(),
         "tb_url": tb_info["url"] if tb_info else None,
+        # a reclaimed stale hub proves this is a retry of a dead predecessor,
+        # not a concurrent task — the rendezvous replaces instead of flagging
+        # a duplicate (Reservations.add)
+        "reclaimed": reclaimed,
     }
     client.register(reservation)
     cluster_info = client.await_reservations(
@@ -338,7 +364,8 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
         local_index = cohosted.index(executor_id)
         workers_per_host = max(1, topo.chips_per_host // num_chips)
         tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
-            num_chips, local_index, workers_per_host))
+            num_chips, local_index, workers_per_host,
+            generation=topo.generation))
 
     # 8. synthesize the cluster spec + JAX process coordinates (the TPU
     # analog of exporting TF_CONFIG, parity :373-384)
@@ -557,6 +584,36 @@ def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
   return _inference
 
 
+def _kill_tensorboard(hub) -> None:
+  """SIGTERM this node's TensorBoard if it started one (parity :619-625)."""
+  tb_pid = hub.get("tb_pid")
+  if tb_pid:
+    try:
+      os.kill(int(tb_pid), 15)
+    except OSError:
+      pass
+
+
+def make_tb_kill_fn(cluster_info, cluster_meta):
+  """Engine task killing a node's TensorBoard (FILES-mode shutdown — there
+  is no feed-shutdown job to fold it into, unlike ENGINE mode).
+
+  Best-effort by design: a dead node/hub must not abort the rest of
+  shutdown (server stop, sidecar stops, error propagation)."""
+  authkey = cluster_meta["authkey"]
+
+  def _kill(iterator):
+    for _ in iterator:
+      pass
+    try:
+      executor_id = hostinfo.read_executor_id(os.getcwd())
+      _kill_tensorboard(_get_hub(cluster_info, executor_id, authkey))
+    except Exception as e:  # noqa: BLE001 - reap is best-effort
+      logger.warning("tensorboard reap skipped on this executor: %s", e)
+
+  return _kill
+
+
 def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
                      queues=("input",)):
   """Shutdown task: send end-of-feed, await node exit, surface late errors
@@ -569,13 +626,7 @@ def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
     executor_id = hostinfo.read_executor_id(os.getcwd())
     hub = _get_hub(cluster_info, executor_id, authkey)
 
-    # kill TensorBoard if we started one (parity :619-625)
-    tb_pid = hub.get("tb_pid")
-    if tb_pid:
-      try:
-        os.kill(int(tb_pid), 15)
-      except OSError:
-        pass
+    _kill_tensorboard(hub)
 
     for qname in queues:
       input_channel(hub, qname).put(None, block=True, timeout=60)

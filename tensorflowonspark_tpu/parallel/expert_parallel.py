@@ -57,14 +57,6 @@ def _topk_dispatch(probs, top_k: int):
                         dtype=probs.dtype).sum(axis=1)
 
 
-def _gate(x, w_gate):
-  """Top-1 gating: (onehot [T, E], gate [T])."""
-  probs = _router_probs(x, w_gate)
-  top = jnp.argmax(probs, axis=-1)
-  onehot = jax.nn.one_hot(top, probs.shape[-1], dtype=probs.dtype)
-  return onehot, jnp.max(probs, axis=-1)
-
-
 def _combine_weights(probs, dispatch, top_k: int):
   """Combine weights [T, E] for a multi-hot dispatch: gate probabilities,
   renormalized over the selected set for top_k > 1. The single source of

@@ -76,22 +76,39 @@ def param_sharding_from_boxed(boxed_params, mesh):
 
 def state_shardings(abs_state, param_sharding, mesh):
   """Shardings for a whole TrainState: params exact, optimizer moments
-  mirror the parameter of the same shape, everything else replicated."""
+  mirror THEIR parameter (matched by tree path, so two same-shaped params
+  with different layouts keep their own moment layouts — a shape-keyed
+  lookup would silently reshard one of them every step), everything else
+  replicated."""
   import jax
   from jax.sharding import NamedSharding, PartitionSpec as P
+  from jax.tree_util import tree_flatten_with_path, tree_unflatten
 
-  by_shape = {}
-  for leaf, sh in zip(jax.tree.leaves(abs_state.params),
-                      jax.tree.leaves(param_sharding)):
-    by_shape.setdefault(tuple(leaf.shape), sh)
+  def _names(path):
+    return tuple(str(getattr(k, "key", getattr(k, "name",
+                                               getattr(k, "idx", k))))
+                 for k in path)
 
-  def _leaf(leaf):
-    sh = by_shape.get(tuple(getattr(leaf, "shape", ())))
-    if sh is not None and getattr(leaf, "ndim", 0) > 0:
-      return sh
-    return NamedSharding(mesh, P())
+  param_flat, _ = tree_flatten_with_path(abs_state.params)
+  by_path = {}
+  for (path, leaf), sh in zip(param_flat, jax.tree.leaves(param_sharding)):
+    by_path[_names(path)] = (tuple(leaf.shape), sh)
 
-  full = jax.tree.map(_leaf, abs_state)
+  state_flat, treedef = tree_flatten_with_path(abs_state)
+  out = []
+  for path, leaf in state_flat:
+    names = _names(path)
+    sh = None
+    if getattr(leaf, "ndim", 0) > 0:
+      # optimizer moments live at <state prefix> + <param path>: take the
+      # longest path suffix that names a parameter of the same shape
+      for i in range(len(names)):
+        hit = by_path.get(names[i:])
+        if hit is not None and hit[0] == tuple(getattr(leaf, "shape", ())):
+          sh = hit[1]
+          break
+    out.append(sh if sh is not None else NamedSharding(mesh, P()))
+  full = tree_unflatten(treedef, out)
   return full.replace(params=param_sharding)
 
 

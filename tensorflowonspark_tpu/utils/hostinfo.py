@@ -71,7 +71,8 @@ def single_node_env(num_chips: int = 0, worker_index: int = 0,
     topo = tpu_info.get_topology()
     if topo is not None:
       tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
-          num_chips, worker_index, workers_per_host))
+          num_chips, worker_index, workers_per_host,
+          generation=topo.generation))
 
 
 def write_executor_id(num: int, working_dir: str = ".") -> None:

@@ -138,10 +138,32 @@ def is_tpu_available(environ: Optional[Dict[str, str]] = None) -> bool:
       or os.path.exists("/dev/vfio/0")
 
 
+# physical chip grid of one host, by generation: libtpu requires per-process
+# and process bounds that TILE this grid (x, y products, z always 1 per host)
+_HOST_CHIP_GRID = {
+    "v2": (2, 2), "v3": (2, 2), "v4": (2, 2), "v5p": (2, 2),
+    "v5litepod": (2, 4), "v5e": (2, 4), "v6e": (2, 4),
+}
+
+
+def _fit_grid(count: int, bounds):
+  """Largest-x ``(x, y)`` with ``x*y == count`` that tiles ``bounds``
+  (x | bounds_x and bounds_y % y == 0), or None when no arrangement fits."""
+  bx, by = bounds
+  for x in range(bx, 0, -1):
+    if bx % x or count % x:
+      continue
+    y = count // x
+    if y <= by and by % y == 0:
+      return (x, y)
+  return None
+
+
 def chip_env_for_worker(num_chips: int, worker_index: int,
                         workers_per_host: int,
                         base_port: int = 8476,
-                        host: str = "localhost") -> Dict[str, str]:
+                        host: str = "localhost",
+                        generation: Optional[str] = None) -> Dict[str, str]:
   """Env vars granting ``worker_index`` a disjoint set of chips on this host.
 
   TPU analog of the reference's deterministic by-worker-index GPU placement
@@ -149,6 +171,11 @@ def chip_env_for_worker(num_chips: int, worker_index: int,
   gets chips ``[i*num_chips, (i+1)*num_chips)``. Exports the libtpu
   multi-process coordination variables so each worker process initializes only
   its share.
+
+  The exported bounds tile the host's physical chip grid for ``generation``
+  (2x4 on v5e/v6e, 2x2 on v4/v5p — libtpu rejects bounds that don't tile the
+  topology): e.g. 2 workers x 4 chips on v5e gets
+  ``TPU_CHIPS_PER_PROCESS_BOUNDS=2,2,1`` and ``TPU_PROCESS_BOUNDS=1,2,1``.
   """
   if num_chips < 1 or worker_index < 0 or workers_per_host < 1:
     raise ValueError("invalid chip allocation request: num_chips={} "
@@ -160,13 +187,22 @@ def chip_env_for_worker(num_chips: int, worker_index: int,
     raise ValueError(
         "worker {} requests chips {} but hosts have at most {} chips".format(
             worker_index, chips, MAX_CHIPS_PER_HOST))
+  host_grid = _HOST_CHIP_GRID.get((generation or "").lower(), (2, 4))
+  total_grid = _fit_grid(num_chips * workers_per_host, host_grid)
+  chip_grid = _fit_grid(num_chips, total_grid) if total_grid else None
+  if chip_grid is None:
+    raise ValueError(
+        "cannot tile {} chips x {} workers onto the {} host chip grid "
+        "{}x{}".format(num_chips, workers_per_host, generation or "default",
+                       host_grid[0], host_grid[1]))
+  proc_grid = (total_grid[0] // chip_grid[0], total_grid[1] // chip_grid[1])
   addresses = ",".join(
       "{}:{}".format(host, base_port + i) for i in range(workers_per_host))
   local = worker_index % workers_per_host
   return {
       "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips),
-      "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,{},1".format(num_chips),
-      "TPU_PROCESS_BOUNDS": "1,{},1".format(workers_per_host),
+      "TPU_CHIPS_PER_PROCESS_BOUNDS": "{},{},1".format(*chip_grid),
+      "TPU_PROCESS_BOUNDS": "{},{},1".format(*proc_grid),
       "TPU_PROCESS_ADDRESSES": addresses,
       "TPU_PROCESS_PORT": str(base_port + local),
       "CLOUD_TPU_TASK_ID": str(local),

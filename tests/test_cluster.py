@@ -24,6 +24,75 @@ def engine():
   e.stop()
 
 
+# fake tensorboard entry point: records start/kill so tests can observe
+# the node runtime's spawn and kill-on-shutdown behavior
+_FAKE_TB = """\
+import argparse, os, signal, sys, time
+p = argparse.ArgumentParser()
+p.add_argument("--logdir"); p.add_argument("--port"); p.add_argument("--host")
+a, _ = p.parse_known_args()
+
+
+def _bye(sig, frame):
+  with open(os.path.join(a.logdir, "tb_killed.txt"), "w") as f:
+    f.write("killed")
+  sys.exit(0)
+
+
+signal.signal(signal.SIGTERM, _bye)
+with open(os.path.join(a.logdir, "tb_started.txt"), "w") as f:
+  f.write("%d %s" % (os.getpid(), a.port))
+while True:
+  time.sleep(0.2)
+"""
+
+
+def test_tensorboard_spawned_on_chief_and_killed_on_shutdown(
+    tmp_path, monkeypatch):
+  """tensorboard=True spawns the discovered binary on the chief with the
+  requested port, tensorboard_url() plumbs through cluster_info, and
+  shutdown kills the server (parity: TFSparkNode.py:292-329, 619-625;
+  TFCluster.tensorboard_url, TFCluster.py:207-212)."""
+  import time
+  from tensorflowonspark_tpu.utils.hostinfo import get_free_port
+
+  fake_bin = tmp_path / "bin"
+  fake_bin.mkdir()
+  (fake_bin / "tensorboard").write_text(_FAKE_TB)
+  log_dir = tmp_path / "logs"
+  log_dir.mkdir()
+  port = get_free_port()
+  monkeypatch.setenv("PATH",
+                     str(fake_bin) + os.pathsep + os.environ.get("PATH", ""))
+  monkeypatch.setenv("TENSORBOARD_PORT", str(port))
+
+  engine = LocalEngine(num_executors=2)
+  try:
+    c = tos_cluster.run(engine, lambda args, ctx: None,
+                        input_mode=InputMode.FILES, tensorboard=True,
+                        log_dir=str(log_dir), reservation_timeout=30)
+    url = c.tensorboard_url()
+    assert url is not None and url.endswith(":%d" % port), url
+
+    started = log_dir / "tb_started.txt"
+    deadline = time.time() + 20
+    while not started.exists() and time.time() < deadline:
+      time.sleep(0.2)
+    assert started.exists(), "fake tensorboard never started"
+    tb_pid, tb_port = started.read_text().split()
+    assert tb_port == str(port)
+    os.kill(int(tb_pid), 0)        # alive while the cluster runs
+
+    c.shutdown(timeout=120)
+    killed = log_dir / "tb_killed.txt"
+    deadline = time.time() + 20
+    while not killed.exists() and time.time() < deadline:
+      time.sleep(0.2)
+    assert killed.exists(), "shutdown did not SIGTERM the tensorboard"
+  finally:
+    engine.stop()
+
+
 def test_independent_jax_nodes(engine):
   """Each node runs a small real JAX computation (parity :16-27)."""
 

@@ -11,6 +11,83 @@ import numpy as np
 import pytest
 
 
+class TestTensorboardDiscovery:
+  """Parity: the reference's three-step search and spawn
+  (TFSparkNode.py:292-329)."""
+
+  def test_finds_executable(self, tmp_path):
+    from tensorflowonspark_tpu import node
+    (tmp_path / "tensorboard").write_text("# fake executable")
+    assert node._find_tensorboard(str(tmp_path)) == \
+        str(tmp_path / "tensorboard")
+
+  def test_falls_back_to_module_main(self, tmp_path):
+    from tensorflowonspark_tpu import node
+    pkg = tmp_path / "tensorboard"
+    pkg.mkdir()
+    (pkg / "main.py").write_text("# fake module entry")
+    assert node._find_tensorboard(str(tmp_path)) == str(pkg / "main.py")
+
+  def test_executable_takes_precedence(self, tmp_path):
+    from tensorflowonspark_tpu import node
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    (d2 / "tensorboard").write_text("# exe")
+    pkg = d1 / "tensorboard"
+    pkg.mkdir()
+    (pkg / "main.py").write_text("# module")
+    search = os.pathsep.join([str(d1), str(d2)])
+    assert node._find_tensorboard(search) == str(d2 / "tensorboard")
+
+  def test_default_search_covers_pythonpath(self, tmp_path, monkeypatch):
+    from tensorflowonspark_tpu import node
+    pkg = tmp_path / "tensorboard"
+    pkg.mkdir()
+    (pkg / "main.py").write_text("# via PYTHONPATH")
+    monkeypatch.setenv("PATH", str(tmp_path / "nothing_here"))
+    monkeypatch.setenv("PYTHONPATH", str(tmp_path))
+    found = node._find_tensorboard()
+    # this image ships the tensorboard package on sys.path, which the
+    # (reference-faithful) search order prefers; either hit proves the
+    # default search string includes the module-form fallback
+    assert found and str(found).endswith(os.path.join("tensorboard",
+                                                      "main.py"))
+
+  def test_not_found_returns_false(self, tmp_path):
+    from tensorflowonspark_tpu import node
+    assert not node._find_tensorboard(str(tmp_path))
+
+
+class TestSpawnTensorboard:
+  def test_spawn_args_and_url(self, tmp_path, monkeypatch):
+    from tensorflowonspark_tpu import node
+    fake = tmp_path / "tensorboard"
+    fake.write_text("# fake")
+    monkeypatch.setenv("TENSORBOARD_PORT", "23456")
+    monkeypatch.setattr(node, "_find_tensorboard", lambda: str(fake))
+    calls = {}
+
+    class _Proc:
+      pid = 4242
+
+    monkeypatch.setattr(
+        node.subprocess, "Popen",
+        lambda args, **kw: calls.setdefault("args", args) and _Proc()
+        or _Proc())
+    info = node._spawn_tensorboard(str(tmp_path / "logs"))
+    assert info["pid"] == 4242
+    assert info["url"].startswith("http://") and info["url"].endswith(":23456")
+    args = calls["args"]
+    assert args[0] == sys.executable and args[1] == str(fake)
+    assert "--logdir" in args and str(tmp_path / "logs") in args
+    assert "--port" in args and "23456" in args
+
+  def test_returns_none_when_not_found(self, monkeypatch):
+    from tensorflowonspark_tpu import node
+    monkeypatch.setattr(node, "_find_tensorboard", lambda: False)
+    assert node._spawn_tensorboard("/tmp/logs") is None
+
+
 class TestInferenceCLISubprocess:
   def test_python_dash_m_invocation(self, tmp_path):
     """The documented `python -m tensorflowonspark_tpu.inference_cli`

@@ -334,6 +334,27 @@ class TestShardedTrainStep:
     leaves = jax.tree.leaves(state.params)
     assert any(len(l.sharding.device_set) > 1 for l in leaves)
 
+  def test_state_shardings_distinguish_same_shape_params(self, devices):
+    """Adam moments must mirror THEIR parameter's layout: two params with
+    identical shapes but different shardings each keep their own (a
+    shape-keyed lookup would assign both the first layout and silently
+    reshard between a param and its moments every step)."""
+    import optax
+    from flax.training import train_state
+    from jax.sharding import NamedSharding
+
+    mesh = M.build_mesh(M.MeshSpec(data=2, tensor=2), devices=devices[:4])
+    params = {"a": jnp.zeros((8, 8)), "b": jnp.zeros((8, 8))}
+    abs_state = jax.eval_shape(lambda: train_state.TrainState.create(
+        apply_fn=lambda v, x: x, params=params, tx=optax.adam(1e-3)))
+    sh_a = NamedSharding(mesh, P(M.AXIS_TENSOR, None))
+    sh_b = NamedSharding(mesh, P(None, M.AXIS_TENSOR))
+    full = SH.state_shardings(abs_state, {"a": sh_a, "b": sh_b}, mesh)
+    mu = full.opt_state[0].mu
+    nu = full.opt_state[0].nu
+    assert mu["a"] == sh_a and nu["a"] == sh_a
+    assert mu["b"] == sh_b and nu["b"] == sh_b
+
   def test_fused_layer_norm_matches_flax_in_model(self, devices):
     """The fused Pallas LayerNorm (per-shard via shard_map) trains the
     sharded transformer on the same trajectory as flax LayerNorm."""

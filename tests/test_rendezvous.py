@@ -47,6 +47,23 @@ class TestReservations:
     r.add(_meta(0, host="h1"))  # different host claims same slot
     assert len(r.duplicates) == 1
 
+  def test_same_host_concurrent_tasks_flagged(self):
+    """Two fresh tasks on ONE host claiming the same executor slot (the
+    multiple-executors-per-host case, reference TFCluster.py:357-372) must
+    not silently last-write-win."""
+    r = Reservations(2)
+    r.add(_meta(0, host="h0", pid=100))
+    r.add(_meta(0, host="h0", pid=200))  # concurrent, not a retry
+    assert len(r.duplicates) == 1
+
+  def test_reclaiming_retry_replaces_silently(self):
+    r = Reservations(2)
+    r.add(_meta(0, host="h0", pid=100))
+    # a retry that reclaimed the dead predecessor's hub is legitimate
+    r.add(_meta(0, host="h0", pid=200, reclaimed=True))
+    assert not r.duplicates
+    assert r.get()[0]["pid"] == 200
+
 
 class TestServerClient:
   def test_register_and_await(self):
@@ -126,6 +143,52 @@ class TestServerClient:
 
 
 class TestServerRobustness:
+  def test_stalled_client_does_not_serialize_control_plane(self):
+    """A peer stalled mid-message must not delay other clients: reads are
+    buffered per connection, never blocking read-to-completion."""
+    import socket as socket_mod
+    s = Server(2)
+    addr = s.start()
+    stalled = None
+    try:
+      # claims to send a 1000-byte message but delivers only 2 bytes
+      stalled = socket_mod.create_connection(("127.0.0.1", addr[1]))
+      stalled.sendall(b"\x00\x00\x03\xe8" + b"xx")
+      time.sleep(0.3)                      # let the server read the stub
+      c = Client(("127.0.0.1", addr[1]))
+      t0 = time.time()
+      c.register(_meta(0))
+      c.register(_meta(1))
+      assert s.reservations.done()
+      assert time.time() - t0 < 5, "stalled peer delayed healthy clients"
+      c.close()
+    finally:
+      if stalled is not None:
+        stalled.close()
+      s.stop()
+
+  def test_split_frames_across_recv_boundaries(self):
+    """Messages fragmented at arbitrary byte boundaries must reassemble."""
+    import socket as socket_mod
+    import msgpack as mp
+    import struct
+    s = Server(1)
+    addr = s.start()
+    try:
+      raw = socket_mod.create_connection(("127.0.0.1", addr[1]))
+      payload = mp.packb({"type": "REG", "data": _meta(0)}, use_bin_type=True)
+      frame = struct.pack(">I", len(payload)) + payload
+      for i in range(0, len(frame), 3):    # drip-feed 3 bytes at a time
+        raw.sendall(frame[i:i + 3])
+        time.sleep(0.01)
+      deadline = time.time() + 5
+      while not s.reservations.done() and time.time() < deadline:
+        time.sleep(0.05)
+      assert s.reservations.done()
+      raw.close()
+    finally:
+      s.stop()
+
   def test_malformed_payload_does_not_kill_server(self):
     import socket
     import struct

@@ -46,6 +46,25 @@ class TestShmRing:
       with pytest.raises(shmring.RingClosed):
         ring.get_batch(timeout=2)
 
+  def test_adapter_synthesizes_end_marker_on_close(self):
+    """A producer that closes the ring without the in-band None marker
+    (e.g. it died) must still unblock the consumer: the adapter synthesizes
+    the end-of-feed None instead of returning [] forever."""
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      q = shmring.RingQueueAdapter(ring)
+      q.put_many([1, 2, 3])
+      ring.close_write()
+      assert q.get_many(10, timeout=2) == [1, 2, 3]
+      assert q.get_many(10, timeout=2) == [None]   # synthesized marker, once
+      # then empty — so DataFeed.terminate's consecutive-empty drain ends
+      assert q.get_many(10, timeout=2) == []
+      assert q.get_many(10, timeout=2) == []
+
+  def test_adapter_timeout_still_returns_empty(self):
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      q = shmring.RingQueueAdapter(ring)
+      assert q.get_many(4, timeout=0.2) == []      # timeout, NOT closed
+
   def test_read_timeout(self):
     with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
       t0 = time.monotonic()

@@ -156,3 +156,36 @@ class TestTPUInfo:
   def test_chip_env_invalid(self):
     with pytest.raises(ValueError):
       tpu_info.chip_env_for_worker(0, 0, 1)
+
+  def test_chip_env_bounds_tile_v5e_grid(self):
+    """2 workers x 4 chips on a v5e host (2x4 grid): per-process bounds
+    2,2,1 with process bounds 1,2,1 — not a bogus 1x8 arrangement that
+    libtpu would reject."""
+    env = tpu_info.chip_env_for_worker(4, worker_index=1, workers_per_host=2,
+                                       generation="v5e")
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,2,1"
+
+  def test_chip_env_bounds_tile_v4_grid(self):
+    # 2 workers x 2 chips on a v4 host (2x2 grid)
+    env = tpu_info.chip_env_for_worker(2, worker_index=0, workers_per_host=2,
+                                       generation="v4")
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,2,1"
+
+  def test_chip_env_full_host_single_process(self):
+    env = tpu_info.chip_env_for_worker(8, worker_index=0, workers_per_host=1,
+                                       generation="v5e")
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,4,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+
+  def test_chip_env_one_chip_per_worker_covers_grid(self):
+    env = tpu_info.chip_env_for_worker(1, worker_index=3, workers_per_host=8,
+                                       generation="v6e")
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "2,4,1"
+
+  def test_chip_env_untileable_raises(self):
+    with pytest.raises(ValueError, match="cannot tile"):
+      tpu_info.chip_env_for_worker(3, worker_index=0, workers_per_host=1,
+                                   generation="v5e")
