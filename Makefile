@@ -4,9 +4,14 @@
 
 PY ?= python
 
-.PHONY: test native bench dryrun clean
+.PHONY: test lint native bench dryrun clean
 
-test:
+# stdlib-only lint gate (this image has no ruff/pycodestyle/mypy and no
+# network); scope parity with the reference's tox pycodestyle/pylint envs
+lint:
+	$(PY) tools/lint.py
+
+test: lint
 	$(PY) -m pytest tests/ -q
 
 native:

@@ -26,7 +26,6 @@ drop_remote_plugin()
 
 
 def main_fn(args, ctx):
-  import numpy as np
   import jax
   from jax.sharding import NamedSharding, PartitionSpec as P
   from tensorflowonspark_tpu.models import mnist

@@ -25,7 +25,6 @@ drop_remote_plugin()
 
 def main_fn(args, ctx):
   import jax
-  import jax.numpy as jnp
   from tensorflowonspark_tpu.models import mnist
 
   images, labels = mnist.synthetic_dataset(

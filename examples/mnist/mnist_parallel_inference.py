@@ -24,7 +24,6 @@ drop_remote_plugin()
 
 def infer_fn(args, ctx):
   import jax
-  import numpy as np
   from tensorflowonspark_tpu.models import mnist
 
   # each task scores its own shard (sharded by task id among gang size)

@@ -243,7 +243,7 @@ def run(engine: Engine, main_fn, tf_args=None,
         queues: Sequence[str] = ("input", "output", "error", "control"),
         eval_node: bool = False, release_port: bool = True,
         chips_per_node: int = 0, qmax: int = 1024,
-        feed_transport: str = "queue",
+        feed_transport: str = "auto",
         shm_capacity: int = 64 * 1024 * 1024) -> TPUCluster:
   """Start a cluster and run ``main_fn(tf_args, ctx)`` on every node.
 
@@ -255,6 +255,12 @@ def run(engine: Engine, main_fn, tf_args=None,
   FILES input mode only, like the reference).
   """
   num_executors = num_executors or engine.num_executors
+  if feed_transport == "auto":
+    # shared-memory rings require the feeder task and the node to share a
+    # host, which only engines with colocated executors guarantee; the
+    # node itself still falls back to "queue" if the native ring is absent
+    feed_transport = "shm" if getattr(engine, "colocated_executors", False) \
+        else "queue"
   if driver_ps_nodes and input_mode != InputMode.FILES:
     raise ValueError("driver_ps_nodes requires InputMode.FILES/TENSORFLOW "
                      "(parity with the reference)")
@@ -315,7 +321,8 @@ def run(engine: Engine, main_fn, tf_args=None,
       "chips_per_node": chips_per_node,
       "qmax": qmax,
       # "queue" (manager-proxy, works everywhere) or "shm" (native
-      # shared-memory ring for the input stream; single host or per-host)
+      # shared-memory ring for the input stream; single host or per-host).
+      # The default "auto" resolved above: shm on colocated engines.
       "feed_transport": feed_transport,
       "shm_capacity": max(shm_capacity, 8 * 1024 * 1024),
   }

@@ -8,7 +8,10 @@ row-at-a-time pickled puts at TFSparkNode.py:500-502).
 
 Topology: single producer (the feeder task) / single consumer (the node's
 data loader) per ring, which is exactly what the engine guarantees.
-Batches are serialized with cloudpickle (numpy arrays supported).
+Batches are serialized with the columnar chunk codec
+(control/chunkcodec.py): homogeneous row chunks ship as raw column
+buffers in a msgpack envelope, everything else falls back to cloudpickle
+inside the codec.
 """
 
 import ctypes
@@ -17,7 +20,6 @@ import os
 import subprocess
 from typing import Optional
 
-import cloudpickle
 
 logger = logging.getLogger(__name__)
 
@@ -146,8 +148,8 @@ class RingQueueAdapter(object):
   def put_many(self, items, block: bool = True, timeout=None) -> None:
     items = list(items)
     t = None if (block and timeout is None) else (timeout if block else 0.0)
-    import cloudpickle
-    payload = cloudpickle.dumps(items)
+    from tensorflowonspark_tpu.control import chunkcodec
+    payload = chunkcodec.encode(items)
     if len(payload) > self.MAX_PAYLOAD and len(items) > 1:
       # split oversized chunks so large rows stream through (parity with
       # FeedQueue.put_many spilling through bounded queues)
@@ -240,8 +242,13 @@ class ShmRing(object):
   # -- batch API -------------------------------------------------------------
 
   def put_batch(self, batch, timeout: Optional[float] = None) -> None:
-    """Serialize and enqueue one batch (a list of rows / arrays pytree)."""
-    self.put_payload(cloudpickle.dumps(batch), timeout=timeout)
+    """Serialize and enqueue one batch (a list of rows / arrays pytree).
+
+    Homogeneous row lists go through the columnar chunk codec (raw column
+    buffers, no pickle); everything else falls back to cloudpickle inside
+    the codec."""
+    from tensorflowonspark_tpu.control import chunkcodec
+    self.put_payload(chunkcodec.encode(batch), timeout=timeout)
 
   def put_payload(self, payload: bytes,
                   timeout: Optional[float] = None) -> None:
@@ -264,7 +271,8 @@ class ShmRing(object):
     while True:
       n = self._lib.tos_ring_read(self._h, self._buf, len(self._buf), t)
       if n >= 0:
-        return cloudpickle.loads(self._buf.raw[:n])
+        from tensorflowonspark_tpu.control import chunkcodec
+        return chunkcodec.decode(self._buf.raw[:n])
       if n == -1:
         raise RingTimeout("ring %r read timed out" % self.name)
       if n == -2:

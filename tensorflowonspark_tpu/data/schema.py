@@ -9,7 +9,7 @@ string disambiguation hint, reference dfutil.py:134-168).
 
 import re
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import List
 
 BASE_TYPES = ("binary", "boolean", "double", "float", "int", "bigint",
               "long", "string")

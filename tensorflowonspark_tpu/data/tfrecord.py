@@ -14,7 +14,7 @@ import logging
 import os
 import struct
 import subprocess
-from typing import Iterator, Optional
+from typing import Iterator
 
 logger = logging.getLogger(__name__)
 

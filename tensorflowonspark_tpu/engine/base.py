@@ -122,6 +122,10 @@ class Engine(abc.ABC):
     rdd.barrier().mapPartitions with BarrierTaskContext (TFParallel.py:43-56).
     Raises if num_tasks exceeds available executors."""
 
+  #: True when every executor runs on THIS host (LocalEngine) — enables
+  #: same-host-only transports like the shared-memory feed ring
+  colocated_executors = False
+
   def default_fs(self) -> str:
     """Default filesystem URI for path normalization."""
     return "file://"

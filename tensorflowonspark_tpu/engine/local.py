@@ -23,7 +23,7 @@ import tempfile
 import threading
 import traceback
 from collections import deque
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence
 
 import cloudpickle
 
@@ -60,6 +60,8 @@ def _executor_main(slot: int, workdir: str, task_q, result_q, env: Dict[str, str
 
 class LocalEngine(Engine):
   """Multi-process engine; see module docstring."""
+
+  colocated_executors = True
 
   def __init__(self, num_executors: int = 2, workdir: Optional[str] = None,
                env: Optional[Dict[str, str]] = None):

@@ -12,7 +12,7 @@ Capability parity with the reference's MNIST examples
   inserted by XLA, not hand-written).
 """
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

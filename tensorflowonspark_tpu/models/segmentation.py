@@ -7,7 +7,6 @@ built TPU-first in flax: depthwise-separable encoder blocks, transpose-conv
 decoder with skip connections, bfloat16 compute, per-pixel cross-entropy.
 """
 
-from functools import partial
 from typing import Any, Sequence
 
 import jax

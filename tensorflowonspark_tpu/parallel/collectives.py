@@ -8,9 +8,7 @@ use inside ``shard_map`` sections, keeping axis names consistent with
 ``parallel.mesh``.
 """
 
-from functools import partial
-from typing import Callable, Sequence
-
+from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax

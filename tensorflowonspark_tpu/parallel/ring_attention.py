@@ -17,7 +17,6 @@ sequence block.
 """
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,7 @@ names onto mesh axes.
 """
 
 import logging
-from typing import Any, Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 

@@ -24,7 +24,6 @@ names, and the fn returns a dict keyed by output tensor names.
 """
 
 import argparse
-import json
 import logging
 import os
 from typing import Dict, Iterable, List, Optional, Sequence

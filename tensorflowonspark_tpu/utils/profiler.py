@@ -10,7 +10,6 @@ perfetto data TensorBoard can render, and an on-demand capture server.
 import contextlib
 import logging
 import os
-from typing import Optional
 
 logger = logging.getLogger(__name__)
 

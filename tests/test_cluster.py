@@ -190,6 +190,38 @@ def test_inference_lazy_streams_without_driver_collect(engine):
       "lazy inference pre-pulled the whole dataset onto the driver"
 
 
+def test_default_transport_is_shm_on_local_engine(engine):
+  """feed_transport="auto" (the default) resolves to the shared-memory
+  ring on engines whose executors share this host; 32k rows flow through
+  it end-to-end."""
+  from tensorflowonspark_tpu.control import shmring
+  if not shmring.available():
+    pytest.skip("native shmring unavailable")
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+      for x in feed.next_batch(512):
+        total += x
+    with open("total32k.txt", "w") as f:
+      f.write(str(total))
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  assert c.cluster_meta["feed_transport"] == "shm"
+  n = 32_000
+  data = list(range(n))
+  c.train([data[i::16] for i in range(16)], num_epochs=1, feed_timeout=120)
+  c.shutdown(timeout=120)
+  totals = []
+  for slot in range(2):
+    path = os.path.join(engine.executor_workdir(slot), "total32k.txt")
+    if os.path.exists(path):
+      totals.append(int(open(path).read()))
+  assert sum(totals) == sum(range(n))
+
+
 @pytest.mark.parametrize("transport", ["queue", "shm"])
 def test_train_feed_and_shutdown(engine, transport):
   """ENGINE-mode training feed: every row reaches some worker exactly once

@@ -3,7 +3,6 @@ codec (cross-checked against TensorFlow's own protos), schema parser,
 dfutil round-trip (parity: reference tests/test_dfutil.py:30-73 and the
 Scala DFUtilTest/SimpleTypeParserTest semantics)."""
 
-import os
 
 import numpy as np
 import pytest

@@ -11,7 +11,6 @@ CPU processes with the gloo transport.
 
 import os
 
-import pytest
 
 from tensorflowonspark_tpu import cluster as tos_cluster
 from tensorflowonspark_tpu.cluster import InputMode
@@ -21,7 +20,6 @@ from tensorflowonspark_tpu.engine import LocalEngine
 def distributed_main(args, ctx):
   import numpy as np
   import jax
-  import jax.numpy as jnp
   from jax.sharding import NamedSharding, PartitionSpec as P
 
   ctx.initialize_distributed()

@@ -6,7 +6,6 @@ for hdfs_path) onto the TPU modules.
 """
 
 import os
-import tempfile
 
 import pytest
 
