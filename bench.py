@@ -31,9 +31,6 @@ TFM_LAYERS, TFM_DMODEL, TFM_HEADS, TFM_DFF = 12, 768, 12, 3072
 TFM_VOCAB, TFM_SEQ, TFM_BATCH = 32000, 1024, 8
 TFM_WARMUP, TFM_MEASURE = 2, 8
 
-# bf16 peak FLOP/s per chip by TPU generation (public spec sheets)
-PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
-
 if os.environ.get("TOS_BENCH_SMOKE"):
   # tiny shapes so CI can drive the full bench path on CPU
   BATCH, IMAGE, WARMUP, MEASURE = 8, (64, 64, 3), 1, 2
@@ -107,34 +104,23 @@ def _bench_resnet():
   return BATCH * MEASURE / (time.time() - t0)
 
 
-def _resolve_gen(text):
-  """Map a generation hint / device_kind string to a known PEAK_BF16 key."""
-  text = (text or "").lower()
-  for alias, g in (("v5 lite", "v5e"), ("v5lite", "v5e"), ("v6 lite", "v6e"),
-                   ("v6lite", "v6e")):
-    if alias in text:
-      return g
-  # longest key first so "v5p" isn't shadowed by a hypothetical "v5"
-  for g in sorted(PEAK_BF16, key=len, reverse=True):
-    if g in text:
-      return g
-  return None
-
-
 def _chip_peak_flops():
   """(generation_label, bf16_peak) — label and peak always agree; an
   unrecognized chip is labeled as assumed so the MFU is never silently
   computed against the wrong denominator."""
-  gen = _resolve_gen(os.environ.get("PALLAS_AXON_TPU_GEN", ""))
+  from tensorflowonspark_tpu.utils import profiler
+  gen = profiler.resolve_chip_generation(
+      os.environ.get("PALLAS_AXON_TPU_GEN", ""))
   if gen is None:
     try:
       import jax
-      gen = _resolve_gen(getattr(jax.devices()[0], "device_kind", ""))
+      gen = profiler.resolve_chip_generation(
+          getattr(jax.devices()[0], "device_kind", ""))
     except Exception:  # noqa: BLE001 - peak lookup is best-effort
       pass
   if gen is None:
-    return "v5e(assumed)", PEAK_BF16["v5e"]
-  return gen, PEAK_BF16[gen]
+    return "v5e(assumed)", profiler.PEAK_BF16_FLOPS["v5e"]
+  return gen, profiler.PEAK_BF16_FLOPS[gen]
 
 
 def _bench_transformer():
@@ -177,12 +163,12 @@ def _bench_transformer():
   jax.block_until_ready(loss)
   dt = time.time() - t0
 
+  from tensorflowonspark_tpu.utils import profiler
   tokens_per_sec = TFM_BATCH * TFM_SEQ * TFM_MEASURE / dt
-  # PaLM-style accounting: 6N per token for fwd+bwd matmuls plus the
-  # attention term 12·L·d_model·seq (query·key + attention·value, fwd+bwd)
-  flops_per_token = 6.0 * n_params + 12.0 * TFM_LAYERS * TFM_DMODEL * TFM_SEQ
+  flops_per_token = profiler.transformer_flops_per_token(
+      n_params, TFM_LAYERS, TFM_DMODEL, TFM_SEQ)
   gen, peak = _chip_peak_flops()
-  mfu = flops_per_token * tokens_per_sec / peak
+  mfu = profiler.mfu(flops_per_token, tokens_per_sec, peak)
   return {"transformer_tokens_per_sec": round(tokens_per_sec, 1),
           "transformer_mfu": round(mfu, 4),
           "transformer_params": n_params,

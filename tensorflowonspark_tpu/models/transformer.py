@@ -458,6 +458,48 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
                          int(top_k))(params, prompt.astype(jnp.int32), rng)
 
 
+def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
+                            temperature: float = 0.0, top_k: int = 0,
+                            seed: int = 0):
+  """Build a ``predict_fn(params, batch)`` for ``pipeline.export_bundle``.
+
+  The batched KV-cache serving loop as a pipeline bundle: TFModel.transform
+  batches rows with ``yield_batch``, and each batch decodes through
+  :func:`greedy_generate_kv` (prefill once, then O(1) attention per new
+  token). ``batch`` maps an input tensor name to a stacked int32 prompt
+  array [B, prompt_len] (prompts in a partition must share a length);
+  returns ``{"tokens": [B, prompt_len + num_steps]}``.
+
+  The jitted decode is cached per (config, batch, prompt_len, num_steps),
+  so steady-state serving reuses one compilation per shape. With
+  ``temperature > 0`` the sampling key is folded with the batch content
+  and a per-process call counter, so different batches (and repeated
+  serves of the same batch) draw different streams — never the fixed-key
+  repetition ``greedy_generate_kv``'s explicit-rng guard exists to
+  prevent.
+  """
+  state = {"calls": 0}
+
+  def predict_fn(params, batch):
+    import zlib
+    import numpy as np
+    prompts = np.asarray(next(iter(batch.values())), np.int32)
+    if prompts.ndim == 1:          # one column of scalar token ids
+      prompts = prompts[:, None]
+    rng = None
+    if temperature > 0:
+      state["calls"] += 1
+      rng = jax.random.fold_in(
+          jax.random.fold_in(jax.random.PRNGKey(seed),
+                             zlib.crc32(prompts.tobytes())),
+          state["calls"])
+    out = greedy_generate_kv(params, cfg, jnp.asarray(prompts), num_steps,
+                             temperature=temperature, top_k=top_k, rng=rng)
+    return {"tokens": np.asarray(out)}
+
+  return predict_fn
+
+
 def causal_lm_loss(logits, tokens):
   """Next-token cross-entropy (shifted); ignores the final position."""
   import optax

@@ -11,6 +11,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tensorflowonspark_tpu.parallel import collectives as C
@@ -69,6 +70,82 @@ class TestCollectives:
     assert float(total[0]) == float(x.sum())
     # shard i moves to slot i+1: slot 0 now holds the last shard
     np.testing.assert_allclose(np.asarray(rotated[:2]), [14.0, 15.0])
+
+  def test_hierarchical_all_reduce_matches_psum(self, devices):
+    """reduce_scatter(ICI) → psum(DCN) → all_gather(ICI) must equal the
+    flat psum over both axes (and the mean variant the pmean)."""
+    mesh = M.build_mesh(M.MeshSpec(data=2, fsdp=4), devices=devices)
+    # 8 dim-0 shards of 4 rows each: the ICI reduce_scatter needs the local
+    # shard's scatter dim divisible by the fsdp axis size (4)
+    x = jnp.arange(256.0).reshape(32, 8)
+
+    def flat(v):
+      return lax.psum(v, (M.AXIS_FSDP, M.AXIS_DATA))
+
+    def tiered(v):
+      return C.hierarchical_all_reduce(v, ici_axis=M.AXIS_FSDP,
+                                       dcn_axis=M.AXIS_DATA)
+
+    spec = P((M.AXIS_DATA, M.AXIS_FSDP))
+    got_flat = jax.jit(C.shard_map_fn(flat, mesh, spec, spec))(x)
+    got_tier = jax.jit(C.shard_map_fn(tiered, mesh, spec, spec))(x)
+    np.testing.assert_allclose(np.asarray(got_tier), np.asarray(got_flat),
+                               rtol=1e-6)
+    mean = jax.jit(C.shard_map_fn(
+        lambda v: C.hierarchical_all_reduce(v, M.AXIS_FSDP, M.AXIS_DATA,
+                                            mean=True), mesh, spec, spec))(x)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(got_flat) / 8,
+                               rtol=1e-6)
+
+  def test_sync_gradients_averages_pytree(self, devices):
+    mesh = M.build_mesh(M.MeshSpec(data=8), devices=devices)
+    grads = {"w": jnp.arange(8.0), "b": jnp.ones((8, 2))}
+
+    def body(g):
+      return C.sync_gradients(g, M.AXIS_DATA)
+
+    spec = {"w": P(M.AXIS_DATA), "b": P(M.AXIS_DATA)}
+    out = jax.jit(C.shard_map_fn(body, mesh, (spec,), spec))(grads)
+    # every shard of w becomes the mean of the 8 single-element shards
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.full(8, np.arange(8.0).mean()))
+    np.testing.assert_allclose(np.asarray(out["b"]), np.ones((8, 2)))
+
+  def test_broadcast_from(self, devices):
+    mesh = M.build_mesh(M.MeshSpec(data=8), devices=devices)
+    x = jnp.arange(8.0)
+    out = jax.jit(C.shard_map_fn(
+        lambda v: C.broadcast_from(v, M.AXIS_DATA, src_index=3),
+        mesh, P(M.AXIS_DATA), P(M.AXIS_DATA)))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+  def test_global_norm_cross_shard(self, devices):
+    mesh = M.build_mesh(M.MeshSpec(data=8), devices=devices)
+    tree = {"a": jnp.arange(8.0), "b": -jnp.arange(16.0).reshape(8, 2)}
+    expected = float(jnp.sqrt(sum(jnp.sum(v * v)
+                                  for v in tree.values())))
+    spec = {"a": P(M.AXIS_DATA), "b": P(M.AXIS_DATA)}
+    out = jax.jit(C.shard_map_fn(
+        lambda t: C.global_norm(t, M.AXIS_DATA) * jnp.ones(1),
+        mesh, (spec,), P()))(tree)
+    np.testing.assert_allclose(float(out[0]), expected, rtol=1e-6)
+
+  def test_clip_by_global_norm(self, devices):
+    mesh = M.build_mesh(M.MeshSpec(data=8), devices=devices)
+    tree = {"g": jnp.full(8, 3.0)}   # global norm = sqrt(8*9) ~ 8.49
+    spec = {"g": P(M.AXIS_DATA)}
+
+    def body(t):
+      clipped, norm = C.clip_by_global_norm(t, 1.0, M.AXIS_DATA)
+      return clipped, norm * jnp.ones(1)
+
+    clipped, norm = jax.jit(C.shard_map_fn(
+        body, mesh, (spec,), (spec, P())))(tree)
+    np.testing.assert_allclose(float(norm[0]), float(np.sqrt(72)), rtol=1e-6)
+    # clipped global norm is exactly max_norm
+    np.testing.assert_allclose(
+        float(np.sqrt((np.asarray(clipped["g"]) ** 2).sum())), 1.0,
+        rtol=1e-5)
 
 
 class TestRingAttention:
