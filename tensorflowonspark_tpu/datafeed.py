@@ -43,9 +43,10 @@ class DataFeed(object):
     self.input_tensors = ([input_mapping[col] for col in
                            sorted(input_mapping)] if input_mapping else None)
     # the input stream rides the shared-memory ring when the node
-    # advertises one (feed_transport='shm'); output/control stay on the hub
-    from tensorflowonspark_tpu.node import input_channel
-    self._queue_in = input_channel(hub, qname_in)
+    # advertises one (feed_transport='shm'), PLUS the hub queue for
+    # feeders on other hosts; output/control stay on the hub
+    from tensorflowonspark_tpu.node import consumer_channel
+    self._queue_in = consumer_channel(hub, qname_in)
     self._queue_out = hub.get_queue(qname_out)
     self._buffer = collections.deque()
 

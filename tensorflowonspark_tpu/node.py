@@ -456,15 +456,120 @@ def _get_hub(cluster_info: List[dict], executor_id: int, authkey: bytes):
   raise RuntimeError("no cluster node found for executor %d" % executor_id)
 
 
+def _open_advertised_ring(hub, qname: str):
+  """The node's shm ring adapter, or None (not advertised / unreachable).
+
+  One shared resolution for the producer and consumer paths so their
+  fallback behavior cannot drift."""
+  if qname != "input":
+    return None
+  ring_name = hub.get("ring_name")
+  if not ring_name:
+    return None
+  from tensorflowonspark_tpu.control import shmring
+  try:
+    return shmring.RingQueueAdapter(shmring.open_cached(ring_name))
+  except Exception as e:  # noqa: BLE001 - cross-host/absent/released ring
+    logger.warning("advertised shm ring %r unreachable from this process "
+                   "(%s); using the hub queue", ring_name, type(e).__name__)
+    return None
+
+
 def input_channel(hub, qname: str = "input"):
-  """The node's input stream: the shared-memory ring when the node
-  advertises one (feed_transport='shm'), else the hub queue. Both expose
-  the same put/get/join surface (control.shmring.RingQueueAdapter)."""
-  if qname == "input":
-    ring_name = hub.get("ring_name")
-    if ring_name:
-      from tensorflowonspark_tpu.control import shmring
-      return shmring.RingQueueAdapter(shmring.open_cached(ring_name))
+  """PRODUCER-side input stream: the shared-memory ring when the node
+  advertises one (feed_transport='shm') and it is reachable from this
+  process, else the hub queue. Both expose the same put/get/join surface
+  (control.shmring.RingQueueAdapter).
+
+  A feeder task scheduled onto a DIFFERENT host (multi-host Spark) cannot
+  open the node's ring — it falls back to the hub queue, and the node's
+  consumer drains both (:class:`DualInput`)."""
+  ring = _open_advertised_ring(hub, qname)
+  return ring if ring is not None else hub.get_queue(qname)
+
+
+class DualInput(object):
+  """CONSUMER-side input draining the shm ring AND the hub queue.
+
+  Co-host feeders (and the end-of-feed markers from shutdown tasks, which
+  always run on the node's own executor) arrive on the ring; feeders on
+  other hosts fall back to the hub queue. Per-partition row order is
+  preserved because any single feeder uses exactly one channel.
+  ``task_done`` routes to whichever channel produced the last batch, so
+  queue join backpressure still works for remote feeders.
+
+  An end-of-feed ``None`` arriving on the ring (shutdown marker, or the
+  adapter's synthesized marker when the ring closes) is HELD BACK while
+  the hub queue still has rows — a marker must never overtake remote
+  feeders' in-flight data.
+  """
+
+  def __init__(self, ring, queue):
+    self._ring = ring
+    self._queue = queue
+    self._last = None
+    self._stash = None    # ring tail (from the marker on) awaiting drain
+
+  def _from(self, ch, got):
+    self._last = ch
+    return got
+
+  def _deliver_ring(self, got, max_items: int):
+    if None in got and not self._queue.empty():
+      idx = got.index(None)
+      self._stash = got[idx:]
+      prefix = got[:idx]
+      if prefix:
+        return self._from(self._ring, prefix)
+      queued = self._queue.get_many(max_items, block=False)
+      if queued:
+        return self._from(self._queue, queued)
+      # the queue drained between the check and the read: release now
+      out, self._stash = self._stash, None
+      return self._from(self._ring, out)
+    return self._from(self._ring, got)
+
+  def get_many(self, max_items: int, block: bool = True, timeout=None):
+    if self._stash is not None:
+      queued = self._queue.get_many(max_items, block=False)
+      if queued:
+        return self._from(self._queue, queued)
+      out, self._stash = self._stash, None
+      return self._from(self._ring, out)
+    got = self._ring.get_many(max_items, block=False)
+    if got:
+      return self._deliver_ring(got, max_items)
+    got = self._queue.get_many(max_items, block=False)
+    if got:
+      return self._from(self._queue, got)
+    if not block:
+      return []
+    half = (timeout if timeout is not None else 1.0) / 2.0
+    got = self._ring.get_many(max_items, block=True, timeout=half)
+    if got:
+      return self._deliver_ring(got, max_items)
+    got = self._queue.get_many(max_items, block=True, timeout=half)
+    if got:
+      return self._from(self._queue, got)
+    return []
+
+  def task_done(self, n: int = 1) -> None:
+    if self._last is not None:
+      self._last.task_done(n)
+
+  def qsize(self) -> int:
+    return self._ring.qsize() + self._queue.qsize()
+
+  def empty(self) -> bool:
+    return self.qsize() == 0
+
+
+def consumer_channel(hub, qname: str = "input"):
+  """The node-side input stream: ring+queue dual when a ring is
+  advertised and reachable (see :class:`DualInput`), else the hub queue."""
+  ring = _open_advertised_ring(hub, qname)
+  if ring is not None:
+    return DualInput(ring, hub.get_queue(qname))
   return hub.get_queue(qname)
 
 

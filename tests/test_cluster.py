@@ -222,6 +222,49 @@ def test_default_transport_is_shm_on_local_engine(engine):
   assert sum(totals) == sum(range(n))
 
 
+def test_remote_feeder_falls_back_to_hub_queue(engine):
+  """Multi-host story: a feeder that cannot reach a node's shm ring feeds
+  through the hub queue, and the node's DualInput consumer drains BOTH
+  channels. Simulated by injecting rows straight into the hub queue (what
+  input_channel's fallback does on a remote host) while the normal feed
+  uses the ring."""
+  from tensorflowonspark_tpu.control import feedhub, shmring
+  if not shmring.available():
+    pytest.skip("native shmring unavailable")
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+      for x in feed.next_batch(64):
+        total += x
+    with open("total_dual.txt", "w") as f:
+      f.write(str(total))
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  assert c.cluster_meta["feed_transport"] == "shm"
+  # "remote" rows: put into every node's hub queue directly, bypassing
+  # the ring — exactly the remote-feeder fallback path
+  remote_rows = list(range(1000, 1200))
+  for n in c.cluster_info:
+    hub = feedhub.connect(tuple(n["hub_addr"]),
+                          c.cluster_meta["authkey"])
+    hub.get_queue("input").put_many(remote_rows, block=True, timeout=30)
+  # normal (ring) feed + end-of-feed markers via shutdown
+  local_rows = list(range(200))
+  c.train([local_rows[i::4] for i in range(4)], num_epochs=1,
+          feed_timeout=60)
+  c.shutdown(timeout=120)
+
+  totals = []
+  for slot in range(2):
+    path = os.path.join(engine.executor_workdir(slot), "total_dual.txt")
+    if os.path.exists(path):
+      totals.append(int(open(path).read()))
+  assert sum(totals) == sum(local_rows) + 2 * sum(remote_rows)
+
+
 @pytest.mark.parametrize("transport", ["queue", "shm"])
 def test_train_feed_and_shutdown(engine, transport):
   """ENGINE-mode training feed: every row reaches some worker exactly once

@@ -65,6 +65,80 @@ class TestShmRing:
       q = shmring.RingQueueAdapter(ring)
       assert q.get_many(4, timeout=0.2) == []      # timeout, NOT closed
 
+  def test_dual_input_holds_marker_until_queue_drained(self):
+    """An end-of-feed None on the ring must not overtake rows still in the
+    hub queue (remote feeders') — DualInput stashes it until drained."""
+    from collections import deque
+    from tensorflowonspark_tpu.node import DualInput
+
+    class StubQueue:
+      def __init__(self, rows):
+        self._rows = deque(rows)
+        self.acked = 0
+
+      def get_many(self, n, block=True, timeout=None):
+        out = []
+        while self._rows and len(out) < n:
+          out.append(self._rows.popleft())
+        return out
+
+      def empty(self):
+        return not self._rows
+
+      def qsize(self):
+        return len(self._rows)
+
+      def task_done(self, n=1):
+        self.acked += n
+
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      adapter = shmring.RingQueueAdapter(ring)
+      adapter.put_many([1, 2])
+      adapter.put_many([None])          # shutdown's end-of-feed marker
+      stub = StubQueue([10, 11, 12])
+      dual = DualInput(adapter, stub)
+
+      assert dual.get_many(8, timeout=0.5) == [1, 2]
+      dual.task_done(2)
+      # marker encountered but queue non-empty: queue rows come first
+      assert dual.get_many(8, timeout=0.5) == [10, 11, 12]
+      dual.task_done(3)
+      assert stub.acked == 3            # task_done routed to the queue
+      # queue drained: the stashed marker is finally released
+      assert dual.get_many(8, timeout=0.5) == [None]
+
+  def test_dual_input_holds_synthesized_close_marker(self):
+    """A ring closed without an in-band marker synthesizes one — which must
+    ALSO wait for the hub queue to drain."""
+    from collections import deque
+    from tensorflowonspark_tpu.node import DualInput
+
+    class StubQueue:
+      def __init__(self, rows):
+        self._rows = deque(rows)
+
+      def get_many(self, n, block=True, timeout=None):
+        out = []
+        while self._rows and len(out) < n:
+          out.append(self._rows.popleft())
+        return out
+
+      def empty(self):
+        return not self._rows
+
+      def qsize(self):
+        return len(self._rows)
+
+      def task_done(self, n=1):
+        pass
+
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      adapter = shmring.RingQueueAdapter(ring)
+      ring.close_write()                # producer died, no marker
+      dual = DualInput(adapter, StubQueue([7, 8]))
+      assert dual.get_many(8, timeout=0.5) == [7, 8]
+      assert dual.get_many(8, timeout=0.5) == [None]
+
   def test_read_timeout(self):
     with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
       t0 = time.monotonic()
