@@ -123,7 +123,7 @@ def _chip_peak_flops():
   return gen, profiler.PEAK_BF16_FLOPS[gen]
 
 
-def _bench_transformer():
+def _bench_transformer(**cfg_overrides):
   """Decoder-only LM training: tokens/sec + MFU on one chip."""
   import numpy as np
   import jax
@@ -132,7 +132,8 @@ def _bench_transformer():
 
   cfg = tfm.TransformerConfig(
       vocab_size=TFM_VOCAB, num_layers=TFM_LAYERS, num_heads=TFM_HEADS,
-      d_model=TFM_DMODEL, d_ff=TFM_DFF, max_seq_len=TFM_SEQ, remat=True)
+      d_model=TFM_DMODEL, d_ff=TFM_DFF, max_seq_len=TFM_SEQ, remat=True,
+      **cfg_overrides)
   state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=TFM_SEQ)
   n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))
 
@@ -189,8 +190,19 @@ def main():
   img_per_sec = _bench_resnet()
   try:
     extra = _bench_transformer()
-  except Exception as e:  # noqa: BLE001 - resnet number still stands alone
-    extra = {"transformer_error": str(e)[:300]}
+  except Exception as e:  # noqa: BLE001 - don't lose the round's one bench
+    # shot to a kernel-lowering surprise: retry on the known-safe XLA-only
+    # paths (dense attention, flax LayerNorm) and say so in the JSON
+    sys.stderr.write("transformer bench failed on fused paths: %s\n" % e)
+    try:
+      extra = _bench_transformer(attention_impl="dense",
+                                 layer_norm_impl="flax")
+      extra["transformer_fallback"] = \
+          "fused kernels failed (%s); measured dense/XLA paths" % \
+          type(e).__name__
+    except Exception as e2:  # noqa: BLE001 - resnet number stands alone
+      extra = {"transformer_error": str(e2)[:300],
+               "transformer_fused_error": str(e)[:300]}
   _emit(img_per_sec, extra=extra)
 
 

@@ -78,24 +78,30 @@ def main(argv=None) -> int:
   projected = [[tuple(row[col_index[c]] for c in ordered_cols)
                 for row in part] for part in partitions]
 
+  out_names = [output_mapping[t] for t in sorted(output_mapping)] \
+      if output_mapping else ["prediction"]
   engine = get_engine(args.engine, num_executors=args.num_executors)
+  count = 0
   try:
     model = TFModel({"export_dir": args.export_dir,
                      "input_mapping": input_mapping,
                      "output_mapping": output_mapping,
                      "batch_size": args.batch_size})
-    results = model.transform(engine, projected)
+    # collect=False: predictions stream to the output file one window of
+    # partitions at a time — the driver never holds the full result set
+    stream = model.transform(engine, projected, collect=False)
+    if hasattr(stream, "toLocalIterator"):   # Spark hands back a lazy RDD
+      stream = stream.toLocalIterator()
+    with open(args.output, "w") as f:
+      for row in stream:
+        values = row if isinstance(row, tuple) else (row,)
+        f.write(json.dumps(dict(zip(out_names, values))) + "\n")
+        count += 1
   finally:
     engine.stop()
 
-  out_names = [output_mapping[t] for t in sorted(output_mapping)] \
-      if output_mapping else ["prediction"]
-  with open(args.output, "w") as f:
-    for row in results:
-      values = row if isinstance(row, tuple) else (row,)
-      f.write(json.dumps(dict(zip(out_names, values))) + "\n")
-  logger.info("wrote %d prediction(s) to %s", len(results), args.output)
-  print("wrote %d predictions to %s" % (len(results), args.output))
+  logger.info("wrote %d prediction(s) to %s", count, args.output)
+  print("wrote %d predictions to %s" % (count, args.output))
   return 0
 
 

@@ -530,28 +530,32 @@ class DualInput(object):
     return self._from(self._ring, got)
 
   def get_many(self, max_items: int, block: bool = True, timeout=None):
+    import time as _time
     if self._stash is not None:
       queued = self._queue.get_many(max_items, block=False)
       if queued:
         return self._from(self._queue, queued)
       out, self._stash = self._stash, None
       return self._from(self._ring, out)
-    got = self._ring.get_many(max_items, block=False)
-    if got:
-      return self._deliver_ring(got, max_items)
-    got = self._queue.get_many(max_items, block=False)
-    if got:
-      return self._from(self._queue, got)
-    if not block:
-      return []
-    half = (timeout if timeout is not None else 1.0) / 2.0
-    got = self._ring.get_many(max_items, block=True, timeout=half)
-    if got:
-      return self._deliver_ring(got, max_items)
-    got = self._queue.get_many(max_items, block=True, timeout=half)
-    if got:
-      return self._from(self._queue, got)
-    return []
+    # same blocking contract as the single-channel queues: timeout=None
+    # blocks until data arrives (alternating short polls of both channels)
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+      got = self._ring.get_many(max_items, block=False)
+      if got:
+        return self._deliver_ring(got, max_items)
+      got = self._queue.get_many(max_items, block=False)
+      if got:
+        return self._from(self._queue, got)
+      if not block:
+        return []
+      remaining = None if deadline is None else deadline - _time.monotonic()
+      if remaining is not None and remaining <= 0:
+        return []
+      wait = 0.25 if remaining is None else min(remaining, 0.25)
+      got = self._ring.get_many(max_items, block=True, timeout=wait)
+      if got:
+        return self._deliver_ring(got, max_items)
 
   def task_done(self, n: int = 1) -> None:
     if self._last is not None:

@@ -85,6 +85,13 @@ class StubRDD:
     return [row for part in self._run_partitions(self._part_fns)
             for row in part]
 
+  def toLocalIterator(self):
+    """Rows one partition at a time (like Spark: the driver holds at most
+    one partition)."""
+    for pf in self._part_fns:
+      for row in list(pf()):
+        yield row
+
   def foreachPartition(self, fn):
     self._run_partitions([
         (lambda pf=pf: (fn(iter(list(pf()))), ())[1])

@@ -167,6 +167,11 @@ class TestLazyMapPartitions:
     assert hasattr(lazy, "mapPartitions"), "expected an RDD-like handle"
     assert sorted(lazy.collect()) == [5, 9]
 
+  def test_spark_lazy_local_iterator(self, spark_engine):
+    # the CLI's streaming path: consume the RDD via toLocalIterator
+    lazy = spark_engine.map_partitions_lazy([[1, 2], [3]], _square_sum)
+    assert sorted(lazy.toLocalIterator()) == [5, 9]
+
 
 class TestLocalEngine:
   """Process-isolation behaviors only real subprocess executors exhibit."""
