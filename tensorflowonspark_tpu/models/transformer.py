@@ -37,8 +37,9 @@ class TransformerConfig:
   dtype: Any = jnp.bfloat16
   remat: bool = True
   use_ring_attention: bool = False   # set True when seq is mesh-sharded
-  # "auto": Pallas flash attention on TPU, dense elsewhere; or force
-  # "flash" / "dense"
+  # "auto": Pallas flash attention on TPU, dense elsewhere; "flash" forces
+  # the kernel everywhere (interpret mode off-TPU — how CPU CI exercises
+  # the production attention path); "dense" opts out
   attention_impl: str = "auto"
   # "auto": fused Pallas LayerNorm (ops.layer_norm) on TPU, flax elsewhere;
   # "fused" forces the kernel everywhere (interpret mode off-TPU — how CPU
@@ -59,6 +60,12 @@ class TransformerConfig:
   def __post_init__(self):
     if self.moe_experts > 0 and self.moe_every < 1:
       raise ValueError("moe_every must be >= 1 when moe_experts > 0")
+    if self.attention_impl not in ("auto", "flash", "dense"):
+      raise ValueError("attention_impl must be 'auto', 'flash' or 'dense', "
+                       "got %r" % (self.attention_impl,))
+    if self.layer_norm_impl not in ("auto", "fused", "flax"):
+      raise ValueError("layer_norm_impl must be 'auto', 'fused' or 'flax', "
+                       "got %r" % (self.layer_norm_impl,))
 
   @property
   def head_dim(self) -> int:
@@ -83,16 +90,24 @@ def _rotary(x, positions):
 def _flash_eligible(cfg: TransformerConfig, seq_len: int) -> bool:
   """Whether the Pallas flash kernel should handle this attention.
 
-  Requires a TPU backend (Pallas doesn't lower elsewhere outside
-  interpret mode — so an explicit attention_impl="flash" still falls back
-  to dense off-TPU) and a block-divisible sequence length; "dense" always
-  opts out.
+  "auto" uses the kernel on TPU only; "flash" FORCES it everywhere —
+  interpret mode off-TPU, which is how CPU CI trains through the
+  production attention path (same convention as ``layer_norm_impl``);
+  "dense" always opts out. Either way the sequence must divide into
+  kernel blocks.
   """
   if cfg.attention_impl == "dense":
     return False
-  if jax.default_backend() != "tpu":
-    return False
-  return seq_len % min(128, max(1, seq_len)) == 0
+  divisible = seq_len % min(128, max(1, seq_len)) == 0
+  if cfg.attention_impl == "flash":
+    if not divisible:
+      # forcing must be honest: never silently degrade to dense
+      raise ValueError(
+          "attention_impl='flash' but the (local) sequence length %d does "
+          "not divide into kernel blocks — pad the sequence or use 'auto'"
+          % seq_len)
+    return True
+  return jax.default_backend() == "tpu" and divisible
 
 
 def _fused_ln_eligible(cfg: TransformerConfig) -> bool:
@@ -161,15 +176,17 @@ class Attention(nn.Module):
     q = _rotary(q, positions)
     k = _rotary(k, positions)
 
+    interp = jax.default_backend() != "tpu"   # forced-flash CI runs
     if cfg.use_ring_attention and self.mesh is not None:
       seq_shards = self.mesh.shape.get(mesh_lib.AXIS_SEQUENCE, 1)
       local_seq = q.shape[1] // max(1, seq_shards)
       out = ra.ring_attention(q, k, v, self.mesh, causal=True,
-                              use_flash=_flash_eligible(cfg, local_seq))
+                              use_flash=_flash_eligible(cfg, local_seq),
+                              interpret=interp)
     else:
       if _flash_eligible(cfg, q.shape[1]):
         from tensorflowonspark_tpu.ops import flash_attention
-        out = flash_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=interp)
       else:
         out = ra.full_attention(q, k, v, causal=True)
 

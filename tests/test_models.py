@@ -236,3 +236,54 @@ class TestTransformer:
       state, loss = step(state, tokens)
       losses.append(float(loss))
     assert losses[-1] < losses[1] * 0.8
+
+  def test_forced_flash_matches_dense_in_model(self):
+    """attention_impl="flash" trains the model through the Pallas kernels
+    (interpret mode off-TPU) on the same trajectory as dense attention —
+    the production attention path exercised by CPU CI."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+
+    tokens = jnp.asarray(np.tile(np.arange(32) % 8, (4, 1)), jnp.int32)
+    losses = {}
+    for impl in ("dense", "flash"):
+      cfg = tfm.TransformerConfig(vocab_size=32, num_layers=2, num_heads=2,
+                                  d_model=32, d_ff=64, max_seq_len=32,
+                                  remat=False, dtype=jnp.float32,
+                                  attention_impl=impl)
+      state = tfm.create_state(jax.random.PRNGKey(0), cfg,
+                               learning_rate=1e-2, seq_len=32)
+
+      @jax.jit
+      def step(state, tokens):
+        def loss_fn(p):
+          return tfm.causal_lm_loss(
+              state.apply_fn({"params": p}, tokens), tokens)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+      traj = []
+      for _ in range(4):
+        state, loss = step(state, tokens)
+        traj.append(float(loss))
+      losses[impl] = traj
+    np.testing.assert_allclose(losses["flash"], losses["dense"],
+                               atol=2e-4, rtol=2e-4)
+
+  def test_forced_flash_rejects_indivisible_seq(self):
+    """attention_impl='flash' must fail loudly, never silently fall back
+    to dense, when the sequence doesn't divide into kernel blocks."""
+    import pytest
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=192,
+                                remat=False, attention_impl="flash")
+    with pytest.raises(ValueError, match="divide into kernel blocks"):
+      tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=192)
+
+  def test_config_rejects_unknown_impls(self):
+    import pytest
+    from tensorflowonspark_tpu.models import transformer as tfm
+    with pytest.raises(ValueError, match="attention_impl"):
+      tfm.TransformerConfig(attention_impl="Flash")
+    with pytest.raises(ValueError, match="layer_norm_impl"):
+      tfm.TransformerConfig(layer_norm_impl="pallas")

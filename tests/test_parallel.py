@@ -470,6 +470,42 @@ class TestShardedTrainStep:
     np.testing.assert_allclose(losses["fused"], losses["flax"],
                                atol=1e-5, rtol=1e-5)
 
+  def test_ring_flash_in_model_matches_dense(self, devices):
+    """Sequence-parallel training with the flash kernels forced inside the
+    ring (attention_impl="flash") follows the dense trajectory — the
+    production long-context path, exercised via interpret mode on CPU."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+
+    mesh = M.build_mesh(M.MeshSpec(data=2, sequence=2), devices=devices[:4])
+    seq = 32
+    losses = {}
+    for impl in ("dense", "flash"):
+      cfg = tfm.TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                                  d_model=64, d_ff=128, max_seq_len=seq,
+                                  remat=False, dtype=jnp.float32,
+                                  use_ring_attention=True,
+                                  attention_impl=impl)
+      state, sharding = tfm.create_sharded_state(jax.random.PRNGKey(0), cfg,
+                                                 mesh, learning_rate=1e-2,
+                                                 seq_len=seq)
+
+      def loss_fn(params, tokens, apply_fn=state.apply_fn):
+        return tfm.causal_lm_loss(apply_fn({"params": params}, tokens),
+                                  tokens)
+
+      step = SH.make_train_step(loss_fn, mesh, sharding,
+                                batch_extra_axes=(M.AXIS_SEQUENCE,))
+      base = np.tile(np.arange(seq) % 16, (4, 1)).astype("int32")
+      tokens = SH.shard_batch(jnp.asarray(base), mesh,
+                              extra_axes=(M.AXIS_SEQUENCE,))
+      traj = []
+      for _ in range(4):
+        state, loss = step(state, tokens)
+        traj.append(float(loss))
+      losses[impl] = traj
+    np.testing.assert_allclose(losses["flash"], losses["dense"],
+                               atol=2e-4, rtol=2e-4)
+
   def test_moe_transformer_sharded_over_expert_axis(self, devices):
     """The MoE flagship trains with experts sharded over the expert axis
     inside one jitted SPMD step."""
