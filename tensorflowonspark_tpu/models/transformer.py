@@ -13,6 +13,7 @@ Logical axis names map to mesh axes through
   batch -> data+fsdp, sequence -> sequence axis.
 """
 
+import dataclasses
 import functools
 from dataclasses import dataclass
 from typing import Any, Optional
@@ -366,6 +367,13 @@ def _generate_fn(cfg: TransformerConfig, plen: int, num_steps: int):
   """Cached jitted decode loop; params/buf are runtime args so repeated
   generate calls reuse one compilation and params are never baked in as
   compile-time constants."""
+  total = plen + num_steps
+  if cfg.attention_impl == "flash" and total % min(128, max(1, total)) != 0:
+    # the generation buffer's length (plen + num_steps) is an internal
+    # shape callers don't control block-alignment of — a forced-flash
+    # model must still generate, so degrade to "auto" here (flash when
+    # the buffer divides, dense otherwise) rather than raise
+    cfg = dataclasses.replace(cfg, attention_impl="auto")
   model = Transformer(cfg)
 
   def decode(params, buf):

@@ -280,6 +280,19 @@ class TestTransformer:
     with pytest.raises(ValueError, match="divide into kernel blocks"):
       tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=192)
 
+  def test_forced_flash_model_still_generates_unaligned_lengths(self):
+    """greedy_generate's buffer (plen + num_steps) is an internal shape —
+    a forced-flash model must generate at any length (the generate path
+    degrades to auto/dense for unaligned buffers instead of raising)."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=256,
+                                remat=False, attention_impl="flash")
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=128)
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    out = tfm.greedy_generate(state.params, cfg, prompt, num_steps=131)
+    assert out.shape == (1, 133)          # 133 % 128 != 0: dense fallback
+
   def test_config_rejects_unknown_impls(self):
     import pytest
     from tensorflowonspark_tpu.models import transformer as tfm

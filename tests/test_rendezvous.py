@@ -143,6 +143,39 @@ class TestServerClient:
 
 
 class TestServerRobustness:
+  def test_many_concurrent_clients_register_and_barrier(self):
+    """Pod-scale control plane: 32 concurrent clients register, await the
+    full roster, and clear two barrier rounds — the load pattern the
+    per-connection buffered serve loop exists for."""
+    n = 32
+    s = Server(n)
+    addr = ("127.0.0.1", s.start()[1])
+    errors = []
+
+    def node(i):
+      try:
+        c = Client(addr)
+        c.register(_meta(i, host="h%d" % (i % 4), pid=1000 + i))
+        c.await_reservations(timeout=60)
+        for rnd in (1, 2):
+          c.barrier_wait(rnd, required=n, timeout=60, task_id=i)
+        c.close()
+      except Exception as e:  # noqa: BLE001 - surfaced via the errors list
+        errors.append((i, repr(e)))
+
+    try:
+      threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join(timeout=90)
+      assert not errors, errors[:3]
+      assert all(not t.is_alive() for t in threads)
+      assert s.reservations.done()
+      assert len({m["executor_id"] for m in s.reservations.get()}) == n
+    finally:
+      s.stop()
+
   def test_stalled_client_does_not_serialize_control_plane(self):
     """A peer stalled mid-message must not delay other clients: reads are
     buffered per connection, never blocking read-to-completion."""
