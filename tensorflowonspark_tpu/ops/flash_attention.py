@@ -27,8 +27,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+# Per-row statistics (logsumexp, Δ) ride through kernels with this many
+# trailing lanes: Mosaic's layout verifier rejects blocked 1-D operands and
+# (1, blk) blocks of 2-D arrays, but a [rows, LANES] array blocked
+# (blk, LANES) satisfies the (8, 128)-or-full-dim tiling rule with 16×
+# less padding than a full 128-lane broadcast.
+LANES = 8
 
 
 def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base=0, k_base=0):
@@ -51,7 +59,7 @@ def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base=0, k_base=0):
 # --- kernels ---------------------------------------------------------------
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, qb_ref, kb_ref, o_ref, lse_ref, *,
+def _attn_fwd_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                      blk_q: int, blk_k: int, kv_len: int, causal: bool,
                      scale: float):
   qi = pl.program_id(1)
@@ -61,32 +69,35 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, qb_ref, kb_ref, o_ref, lse_ref, *,
   n_kblocks = kv_len // blk_k
 
   def body(ki, carry):
-    m, l, acc = carry
-    k = lax.dynamic_slice_in_dim(k_ref[0], ki * blk_k, blk_k, 0)
-    v = lax.dynamic_slice_in_dim(v_ref[0], ki * blk_k, blk_k, 0)
+    m, l, acc = carry                               # [blk_q,1] ×2, [blk_q,D]
+    # block loads straight from VMEM refs — dynamic_slice on a loaded
+    # value has no Mosaic lowering
+    k = k_ref[0, pl.ds(ki * blk_k, blk_k), :]
+    v = v_ref[0, pl.ds(ki * blk_k, blk_k), :]
     s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
-    m_blk = jnp.max(s, axis=-1)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_blk)
     m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
-    p = jnp.exp(s - m_safe[:, None])
+    p = jnp.exp(s - m_safe)
     p = jnp.where(s <= NEG_INF, 0.0, p)
     corr = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - m_safe))
-    l_new = l * corr + jnp.sum(p, axis=-1)
-    acc_new = acc * corr[:, None] + p @ v.astype(jnp.float32)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + p @ v.astype(jnp.float32)
     return m_new, l_new, acc_new
 
-  m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
-  l0 = jnp.zeros((blk_q,), jnp.float32)
+  m0 = jnp.full((blk_q, 1), NEG_INF, jnp.float32)
+  l0 = jnp.zeros((blk_q, 1), jnp.float32)
   acc0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
   m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
 
   l_safe = jnp.where(l == 0.0, 1.0, l)
-  o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-  lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+  o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+  lse_col = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))  # [blk_q, 1]
+  lse_ref[0] = jnp.broadcast_to(lse_col, (blk_q, LANES))
 
 
-def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        qb_ref, kb_ref, dq_ref, *, blk_q: int, blk_k: int,
+def _attn_bwd_dq_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dq_ref, *, blk_q: int, blk_k: int,
                         kv_len: int, causal: bool, scale: float):
   """dQ for one q-block: dQ = scale · Σ_k [P ⊙ (dO·Vᵀ − Δ)] · K."""
   qi = pl.program_id(1)
@@ -94,20 +105,19 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   k_base = kb_ref[0]
   q = q_ref[0].astype(jnp.float32) * scale
   do = do_ref[0].astype(jnp.float32)                # [blk_q, D]
-  lse = lse_ref[0]                                  # [blk_q]
-  delta = delta_ref[0]                              # [blk_q]
+  lse = lse_ref[0][:, 0:1]                          # [blk_q, 1]
+  delta = delta_ref[0][:, 0:1]                      # [blk_q, 1]
   n_kblocks = kv_len // blk_k
 
   def body(ki, dq):
-    k = lax.dynamic_slice_in_dim(k_ref[0], ki * blk_k, blk_k, 0)
-    v = lax.dynamic_slice_in_dim(v_ref[0], ki * blk_k, blk_k, 0)
+    k = k_ref[0, pl.ds(ki * blk_k, blk_k), :]
+    v = v_ref[0, pl.ds(ki * blk_k, blk_k), :]
     s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
     lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
-    p = jnp.exp(s - lse_safe[:, None])
-    p = jnp.where(jnp.logical_or(s <= NEG_INF, (lse <= NEG_INF)[:, None]),
-                  0.0, p)
+    p = jnp.exp(s - lse_safe)
+    p = jnp.where(jnp.logical_or(s <= NEG_INF, lse <= NEG_INF), 0.0, p)
     dp = do @ v.astype(jnp.float32).T               # [blk_q, blk_k]
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta)
     return dq + ds @ k.astype(jnp.float32)
 
   dq0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
@@ -115,8 +125,8 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         qb_ref, kb_ref, dk_ref, dv_ref, *, blk_q: int,
+def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dk_ref, dv_ref, *, blk_q: int,
                          blk_k: int, q_len: int, causal: bool,
                          scale: float):
   """dK/dV for one k-block: dV = Σ_q Pᵀ·dO; dK = scale · Σ_q dSᵀ·Q."""
@@ -129,20 +139,17 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
   def body(qi, carry):
     dk, dv = carry
-    q = lax.dynamic_slice_in_dim(q_ref[0], qi * blk_q, blk_q, 0) \
-        .astype(jnp.float32) * scale
-    do = lax.dynamic_slice_in_dim(do_ref[0], qi * blk_q, blk_q, 0) \
-        .astype(jnp.float32)
-    lse = lax.dynamic_slice_in_dim(lse_ref[0], qi * blk_q, blk_q, 0)
-    delta = lax.dynamic_slice_in_dim(delta_ref[0], qi * blk_q, blk_q, 0)
+    q = q_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32) * scale
+    do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
+    delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
     s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
     lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
-    p = jnp.exp(s - lse_safe[:, None])
-    p = jnp.where(jnp.logical_or(s <= NEG_INF, (lse <= NEG_INF)[:, None]),
-                  0.0, p)
+    p = jnp.exp(s - lse_safe)
+    p = jnp.where(jnp.logical_or(s <= NEG_INF, lse <= NEG_INF), 0.0, p)
     dv_new = dv + p.T @ do
     dp = do @ v.T
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta)
     dk_new = dk + ds.T @ q
     return dk_new, dv_new
 
@@ -175,13 +182,16 @@ def _unfold(x, b, h):
   return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _base_arrays(q_base, kv_base, bh):
-  qb = jnp.broadcast_to(jnp.asarray(q_base, jnp.int32), (bh,))
-  kb = jnp.broadcast_to(jnp.asarray(kv_base, jnp.int32), (bh,))
+def _base_arrays(q_base, kv_base):
+  """Position bases as (1,)-shaped int32 scalar-prefetch operands.
+
+  Traced scalars (ring attention derives them from ``lax.axis_index``)
+  ride to the kernel through SMEM via ``PrefetchScalarGridSpec`` — 1-D
+  blocked VMEM operands fail Mosaic layout verification on real TPUs.
+  """
+  qb = jnp.reshape(jnp.asarray(q_base, jnp.int32), (1,))
+  kb = jnp.reshape(jnp.asarray(kv_base, jnp.int32), (1,))
   return qb, kb
-
-
-_BASE_SPEC = pl.BlockSpec((1,), lambda i, j: (i,))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
@@ -192,31 +202,33 @@ def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret):
   blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
   scale = 1.0 / (d ** 0.5)
   qf, kf, vf = _fold(q), _fold(k), _fold(v)
-  qb, kb = _base_arrays(q_base, kv_base, b * h)
+  qb, kb = _base_arrays(q_base, kv_base)
 
   kernel = functools.partial(_attn_fwd_kernel, blk_q=blk_q, blk_k=blk_k,
                              kv_len=s_kv, causal=causal, scale=scale)
   out, lse = pl.pallas_call(
       kernel,
-      grid=(b * h, s_q // blk_q),
-      in_specs=[
-          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0)),
-          pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0)),
-          _BASE_SPEC, _BASE_SPEC,
-      ],
-      out_specs=[
-          pl.BlockSpec((1, blk_q, d), lambda i, j: (i, j, 0)),
-          pl.BlockSpec((1, blk_q), lambda i, j: (i, j)),
-      ],
+      grid_spec=pltpu.PrefetchScalarGridSpec(
+          num_scalar_prefetch=2,
+          grid=(b * h, s_q // blk_q),
+          in_specs=[
+              pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
+              pl.BlockSpec((1, s_kv, d), lambda i, j, *_: (i, 0, 0)),
+              pl.BlockSpec((1, s_kv, d), lambda i, j, *_: (i, 0, 0)),
+          ],
+          out_specs=[
+              pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
+              pl.BlockSpec((1, blk_q, LANES), lambda i, j, *_: (i, j, 0)),
+          ],
+      ),
       out_shape=[
           jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-          jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+          jax.ShapeDtypeStruct((b * h, s_q, LANES), jnp.float32),
       ],
       interpret=interpret,
-  )(qf, kf, vf, qb, kb)
+  )(qb, kb, qf, kf, vf)
 
-  return _unfold(out, b, h), lse.reshape(b, h, s_q)
+  return _unfold(out, b, h), lse[:, :, 0].reshape(b, h, s_q)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
@@ -228,61 +240,66 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
   blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
   scale = 1.0 / (d ** 0.5)
   qf, kf, vf, of, gf = (_fold(x) for x in (q, k, v, out, g))
-  lse_f = lse.reshape(b * h, s_q)
-  qb, kb = _base_arrays(q_base, kv_base, b * h)
+  qb, kb = _base_arrays(q_base, kv_base)
 
   # Δ_i = Σ_d dO·O  (+ the lse cotangent folds in with opposite sign:
   # dS = P ⊙ (dP − Δ + g_lse))
   delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
   if g_lse is not None:
     delta = delta - g_lse.reshape(b * h, s_q)
+  # lse/Δ enter the kernels lane-broadcast (see LANES)
+  lse_f = jnp.broadcast_to(lse.reshape(b * h, s_q)[:, :, None],
+                           (b * h, s_q, LANES))
+  delta = jnp.broadcast_to(delta[:, :, None], (b * h, s_q, LANES))
 
-  full3 = lambda i, j: (i, 0, 0)      # noqa: E731
-  full2 = lambda i, j: (i, 0)         # noqa: E731
-  row3 = lambda i, j: (i, j, 0)       # noqa: E731
-  row2 = lambda i, j: (i, j)          # noqa: E731
+  full3 = lambda i, j, *_: (i, 0, 0)      # noqa: E731
+  row3 = lambda i, j, *_: (i, j, 0)       # noqa: E731
 
   dq = pl.pallas_call(
       functools.partial(_attn_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
                         kv_len=s_kv, causal=causal, scale=scale),
-      grid=(b * h, s_q // blk_q),
-      in_specs=[
-          pl.BlockSpec((1, blk_q, d), row3),
-          pl.BlockSpec((1, s_kv, d), full3),
-          pl.BlockSpec((1, s_kv, d), full3),
-          pl.BlockSpec((1, blk_q, d), row3),
-          pl.BlockSpec((1, blk_q), row2),
-          pl.BlockSpec((1, blk_q), row2),
-          _BASE_SPEC, _BASE_SPEC,
-      ],
-      out_specs=pl.BlockSpec((1, blk_q, d), row3),
+      grid_spec=pltpu.PrefetchScalarGridSpec(
+          num_scalar_prefetch=2,
+          grid=(b * h, s_q // blk_q),
+          in_specs=[
+              pl.BlockSpec((1, blk_q, d), row3),
+              pl.BlockSpec((1, s_kv, d), full3),
+              pl.BlockSpec((1, s_kv, d), full3),
+              pl.BlockSpec((1, blk_q, d), row3),
+              pl.BlockSpec((1, blk_q, LANES), row3),
+              pl.BlockSpec((1, blk_q, LANES), row3),
+          ],
+          out_specs=pl.BlockSpec((1, blk_q, d), row3),
+      ),
       out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
       interpret=interpret,
-  )(qf, kf, vf, gf, lse_f, delta, qb, kb)
+  )(qb, kb, qf, kf, vf, gf, lse_f, delta)
 
   dk, dv = pl.pallas_call(
       functools.partial(_attn_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
                         q_len=s_q, causal=causal, scale=scale),
-      grid=(b * h, s_kv // blk_k),
-      in_specs=[
-          pl.BlockSpec((1, s_q, d), full3),
-          pl.BlockSpec((1, blk_k, d), row3),
-          pl.BlockSpec((1, blk_k, d), row3),
-          pl.BlockSpec((1, s_q, d), full3),
-          pl.BlockSpec((1, s_q), full2),
-          pl.BlockSpec((1, s_q), full2),
-          _BASE_SPEC, _BASE_SPEC,
-      ],
-      out_specs=[
-          pl.BlockSpec((1, blk_k, d), row3),
-          pl.BlockSpec((1, blk_k, d), row3),
-      ],
+      grid_spec=pltpu.PrefetchScalarGridSpec(
+          num_scalar_prefetch=2,
+          grid=(b * h, s_kv // blk_k),
+          in_specs=[
+              pl.BlockSpec((1, s_q, d), full3),
+              pl.BlockSpec((1, blk_k, d), row3),
+              pl.BlockSpec((1, blk_k, d), row3),
+              pl.BlockSpec((1, s_q, d), full3),
+              pl.BlockSpec((1, s_q, LANES), full3),
+              pl.BlockSpec((1, s_q, LANES), full3),
+          ],
+          out_specs=[
+              pl.BlockSpec((1, blk_k, d), row3),
+              pl.BlockSpec((1, blk_k, d), row3),
+          ],
+      ),
       out_shape=[
           jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
           jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
       ],
       interpret=interpret,
-  )(qf, kf, vf, gf, lse_f, delta, qb, kb)
+  )(qb, kb, qf, kf, vf, gf, lse_f, delta)
 
   return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)
 

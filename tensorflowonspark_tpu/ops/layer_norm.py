@@ -4,7 +4,12 @@ One VMEM pass computes mean/variance on the VPU and applies the normalize
 + scale in place — no separate mean/var/normalize HLOs materializing
 intermediates in HBM for long sequences. float32 statistics over bfloat16
 activations; custom VJP with a fused backward (the standard two-reduction
-formulation).
+formulation) that RECOMPUTES the row statistics from the residual ``x``
+instead of storing them: on real TPUs, 1-D blocked operands (stats of
+shape [rows]) fail Mosaic's layout verification against XLA's 1-D T(1024)
+tiling, and recomputing one VPU reduction over data already resident in
+VMEM is cheaper than the extra HBM round-trip anyway. All operands are
+kept 2-D and lane-aligned.
 
 Layout: [..., hidden]; the leading dims are flattened to rows and tiled
 over the grid.
@@ -17,33 +22,45 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _ln_fwd_kernel(x_ref, w_ref, o_ref, mu_ref, rstd_ref, *, eps: float):
+def _stats(x, eps):
+  """Row mean and reciprocal stddev, keepdims ([blk, 1] columns)."""
+  mu = jnp.mean(x, axis=-1, keepdims=True)
+  xc = x - mu
+  var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+  return mu, jax.lax.rsqrt(var + eps)
+
+
+def _ln_fwd_kernel(x_ref, w_ref, o_ref, *, eps: float):
   x = x_ref[...].astype(jnp.float32)                # [blk, H]
-  mu = jnp.mean(x, axis=-1)
-  xc = x - mu[:, None]
-  var = jnp.mean(xc * xc, axis=-1)
-  rstd = jax.lax.rsqrt(var + eps)
-  y = xc * rstd[:, None] * w_ref[...].astype(jnp.float32)[None, :]
+  mu, rstd = _stats(x, eps)
+  y = (x - mu) * rstd * w_ref[...].astype(jnp.float32)
   o_ref[...] = y.astype(o_ref.dtype)
-  mu_ref[...] = mu
-  rstd_ref[...] = rstd
 
 
-def _ln_bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, g_ref, dx_ref, dwp_ref):
+def _ln_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, *, eps: float):
   x = x_ref[...].astype(jnp.float32)
-  w = w_ref[...].astype(jnp.float32)[None, :]
+  w = w_ref[...].astype(jnp.float32)                # [1, H]
   g = g_ref[...].astype(jnp.float32)
-  mu = mu_ref[...]
-  rstd = rstd_ref[...]
-  xhat = (x - mu[:, None]) * rstd[:, None]
+  mu, rstd = _stats(x, eps)
+  xhat = (x - mu) * rstd
   dy = g * w
   # dx = rstd * (dy - mean(dy) - xhat * mean(dy * xhat))
   m1 = jnp.mean(dy, axis=-1, keepdims=True)
   m2 = jnp.mean(dy * xhat, axis=-1, keepdims=True)
-  dx = rstd[:, None] * (dy - m1 - xhat * m2)
+  dx = rstd * (dy - m1 - xhat * m2)
   dx_ref[...] = dx.astype(dx_ref.dtype)
-  # per-block partial of dw (summed over rows); reduced outside
-  dwp_ref[...] = jnp.sum(g * xhat, axis=0)[None, :]
+  # dw accumulates across the (sequential) grid into one [1, H] output —
+  # Mosaic rejects a per-block [n_blocks, H] partial sliced (1, H), so the
+  # reduction happens in-kernel instead of outside
+  rowsum = jnp.sum(g * xhat, axis=0, keepdims=True)
+
+  @pl.when(pl.program_id(0) == 0)
+  def _init():
+    dw_ref[...] = rowsum
+
+  @pl.when(pl.program_id(0) != 0)
+  def _acc():
+    dw_ref[...] += rowsum
 
 
 def layer_norm(x, weight, eps: float = 1e-6, blk_rows: int = 128,
@@ -85,12 +102,12 @@ def layer_norm_sharded(x, weight, mesh, eps: float = 1e-6,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def _ln_vjp(x, weight, eps, blk_rows, interpret):
-  return _ln_fwd(x, weight, eps, blk_rows, interpret)[0]
+  return _ln_fwd(x, weight, eps, blk_rows, interpret)
 
 
 def _ln_fwd_rule(x, weight, eps, blk_rows, interpret):
-  y, mu, rstd = _ln_fwd(x, weight, eps, blk_rows, interpret)
-  return y, (x, weight, mu, rstd)
+  y = _ln_fwd(x, weight, eps, blk_rows, interpret)
+  return y, (x, weight)
 
 
 def _pick_block(rows: int, blk_rows: int) -> int:
@@ -109,61 +126,55 @@ def _ln_fwd(x, weight, eps, blk_rows, interpret):
   for s in shape[:-1]:
     rows *= s
   xf = x.reshape(rows, h)
+  w2 = weight.reshape(1, h)
   blk = _pick_block(rows, blk_rows)
 
-  y, mu, rstd = pl.pallas_call(
+  y = pl.pallas_call(
       functools.partial(_ln_fwd_kernel, eps=eps),
       grid=(rows // blk,),
       in_specs=[
           pl.BlockSpec((blk, h), lambda i: (i, 0)),
-          pl.BlockSpec((h,), lambda i: (0,)),
+          pl.BlockSpec((1, h), lambda i: (0, 0)),
       ],
-      out_specs=[
-          pl.BlockSpec((blk, h), lambda i: (i, 0)),
-          pl.BlockSpec((blk,), lambda i: (i,)),
-          pl.BlockSpec((blk,), lambda i: (i,)),
-      ],
-      out_shape=[
-          jax.ShapeDtypeStruct((rows, h), x.dtype),
-          jax.ShapeDtypeStruct((rows,), jnp.float32),
-          jax.ShapeDtypeStruct((rows,), jnp.float32),
-      ],
+      out_specs=pl.BlockSpec((blk, h), lambda i: (i, 0)),
+      out_shape=jax.ShapeDtypeStruct((rows, h), x.dtype),
       interpret=interpret,
-  )(xf, weight)
-  return y.reshape(shape), mu, rstd
+  )(xf, w2)
+  return y.reshape(shape)
 
 
 def _ln_bwd_rule(eps, blk_rows, interpret, residuals, g):
-  x, weight, mu, rstd = residuals
+  x, weight = residuals
   shape = x.shape
   h = shape[-1]
-  rows = mu.shape[0]
+  rows = 1
+  for s in shape[:-1]:
+    rows *= s
   xf = x.reshape(rows, h)
   gf = g.reshape(rows, h)
+  w2 = weight.reshape(1, h)
   blk = _pick_block(rows, blk_rows)
 
   dx, dw_partial = pl.pallas_call(
-      _ln_bwd_kernel,
+      functools.partial(_ln_bwd_kernel, eps=eps),
       grid=(rows // blk,),
       in_specs=[
           pl.BlockSpec((blk, h), lambda i: (i, 0)),
-          pl.BlockSpec((h,), lambda i: (0,)),
-          pl.BlockSpec((blk,), lambda i: (i,)),
-          pl.BlockSpec((blk,), lambda i: (i,)),
+          pl.BlockSpec((1, h), lambda i: (0, 0)),
           pl.BlockSpec((blk, h), lambda i: (i, 0)),
       ],
       out_specs=[
           pl.BlockSpec((blk, h), lambda i: (i, 0)),
-          pl.BlockSpec((1, h), lambda i: (i, 0)),
+          pl.BlockSpec((1, h), lambda i: (0, 0)),
       ],
       out_shape=[
           jax.ShapeDtypeStruct((rows, h), x.dtype),
-          jax.ShapeDtypeStruct((rows // blk, h), jnp.float32),
+          jax.ShapeDtypeStruct((1, h), jnp.float32),
       ],
       interpret=interpret,
-  )(xf, weight, mu, rstd, gf)
+  )(xf, w2, gf)
 
-  dw = jnp.sum(dw_partial, axis=0).astype(weight.dtype)
+  dw = dw_partial[0].astype(weight.dtype)
   return dx.reshape(shape), dw
 
 

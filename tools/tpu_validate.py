@@ -1,0 +1,201 @@
+"""Validate + time the Pallas kernels on a REAL TPU chip (interpret=False).
+
+Round-1 verdict flagged that every Pallas kernel had only ever executed in
+``interpret=True`` mode on CPU, so real Mosaic lowering (block shapes, lane
+tiling, 1-D iota, scalar blocks) was unproven. This harness runs each kernel
+on the real chip, checks numerics against the dense XLA reference, and times
+both — it is the evidence artifact for "the production code path works".
+
+Usage:  python tools/tpu_validate.py            # full matrix
+        python tools/tpu_validate.py --quick    # one shape per kernel
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(fn, *args, warmup=2, iters=10):
+  import jax
+  for _ in range(warmup):
+    out = fn(*args)
+  jax.block_until_ready(out)
+  t0 = time.perf_counter()
+  for _ in range(iters):
+    out = fn(*args)
+  jax.block_until_ready(out)
+  return (time.perf_counter() - t0) / iters
+
+
+def _dense_attn(q, k, v, causal):
+  import jax.numpy as jnp
+  d = q.shape[-1]
+  s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                 k.astype(jnp.float32)) / (d ** 0.5)
+  if causal:
+    sq, sk = s.shape[-2], s.shape[-1]
+    mask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+    s = jnp.where(mask, s, -1e30)
+  p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+  p = p / jnp.sum(p, axis=-1, keepdims=True)
+  return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def check_flash(results, shapes, dtype_name):
+  import jax
+  import jax.numpy as jnp
+  import importlib
+  fa = importlib.import_module('tensorflowonspark_tpu.ops.flash_attention')
+
+  dtype = dict(bf16=jnp.bfloat16, f32=jnp.float32)[dtype_name]
+  for (b, s, h, d, causal) in shapes:
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, h, d), dtype)
+    v = jax.random.normal(kv, (b, s, h, d), dtype)
+    g = jax.random.normal(kg, (b, s, h, d), dtype)
+
+    flash = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=causal))
+    dense = jax.jit(lambda q, k, v: _dense_attn(q, k, v, causal))
+    name = "flash_fwd[%s b%d s%d h%d d%d %s]" % (
+        dtype_name, b, s, h, d, "causal" if causal else "full")
+    try:
+      out_f = flash(q, k, v)
+      out_d = dense(q, k, v)
+      err = float(jnp.max(jnp.abs(out_f.astype(jnp.float32) -
+                                  out_d.astype(jnp.float32))))
+      tol = 2e-2 if dtype_name == "bf16" else 2e-5
+      t_f = _timeit(flash, q, k, v)
+      t_d = _timeit(dense, q, k, v)
+      results.append(dict(kernel=name, ok=err < tol, max_err=err,
+                          flash_ms=round(t_f * 1e3, 3),
+                          dense_ms=round(t_d * 1e3, 3),
+                          speedup=round(t_d / t_f, 2)))
+    except Exception as e:  # noqa: BLE001 - record, keep going
+      results.append(dict(kernel=name, ok=False,
+                          error=repr(e)[:400]))
+      continue
+
+    # backward
+    name = name.replace("fwd", "bwd")
+    try:
+      loss_f = jax.jit(jax.grad(
+          lambda q, k, v: jnp.sum(
+              fa.flash_attention(q, k, v, causal=causal)
+              .astype(jnp.float32) * g.astype(jnp.float32)),
+          argnums=(0, 1, 2)))
+      loss_d = jax.jit(jax.grad(
+          lambda q, k, v: jnp.sum(
+              _dense_attn(q, k, v, causal)
+              .astype(jnp.float32) * g.astype(jnp.float32)),
+          argnums=(0, 1, 2)))
+      gf = loss_f(q, k, v)
+      gd = loss_d(q, k, v)
+      err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                      b_.astype(jnp.float32))))
+                for a, b_ in zip(gf, gd))
+      tol = 1e-1 if dtype_name == "bf16" else 1e-3
+      t_f = _timeit(loss_f, q, k, v)
+      t_d = _timeit(loss_d, q, k, v)
+      results.append(dict(kernel=name, ok=err < tol, max_err=err,
+                          flash_ms=round(t_f * 1e3, 3),
+                          dense_ms=round(t_d * 1e3, 3),
+                          speedup=round(t_d / t_f, 2)))
+    except Exception as e:  # noqa: BLE001
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+
+def check_layer_norm(results, shapes):
+  import jax
+  import jax.numpy as jnp
+  import importlib
+  ln = importlib.import_module('tensorflowonspark_tpu.ops.layer_norm')
+
+  for (rows, d) in shapes:
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (rows, d), jnp.float32)
+    gamma = jnp.ones((d,), jnp.float32) * 1.1
+
+    fused = jax.jit(lambda x, g: ln.layer_norm(x, g))
+    ref = jax.jit(lambda x, g: (
+        (x - jnp.mean(x, -1, keepdims=True)) *
+        jax.lax.rsqrt(jnp.var(x, -1, keepdims=True) + 1e-6) * g))
+    name = "layer_norm[%dx%d]" % (rows, d)
+    try:
+      err = float(jnp.max(jnp.abs(fused(x, gamma) - ref(x, gamma))))
+      t_f = _timeit(fused, x, gamma)
+      t_r = _timeit(ref, x, gamma)
+      results.append(dict(kernel=name, ok=err < 1e-4, max_err=err,
+                          fused_ms=round(t_f * 1e3, 3),
+                          xla_ms=round(t_r * 1e3, 3),
+                          speedup=round(t_r / t_f, 2)))
+    except Exception as e:  # noqa: BLE001
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+    # gradient path (used by FusedLayerNorm during training)
+    name = "layer_norm_grad[%dx%d]" % (rows, d)
+    try:
+      gf = jax.jit(jax.grad(lambda x, g: jnp.sum(ln.layer_norm(x, g)),
+                            argnums=(0, 1)))
+      gr = jax.jit(jax.grad(
+          lambda x, g: jnp.sum(
+              (x - jnp.mean(x, -1, keepdims=True)) *
+              jax.lax.rsqrt(jnp.var(x, -1, keepdims=True) + 1e-6) * g),
+          argnums=(0, 1)))
+      err = max(float(jnp.max(jnp.abs(a - b_)))
+                for a, b_ in zip(gf(x, gamma), gr(x, gamma)))
+      results.append(dict(kernel=name, ok=err < 1e-3, max_err=err))
+    except Exception as e:  # noqa: BLE001
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--quick", action="store_true")
+  ap.add_argument("--json", default=None, help="write results to this file")
+  args = ap.parse_args(argv)
+
+  import jax
+  dev = jax.devices()[0]
+  print("device: %s (%s)" % (dev, dev.platform), file=sys.stderr)
+  if dev.platform != "tpu":
+    print("WARNING: not a TPU — results are for the %s backend"
+          % dev.platform, file=sys.stderr)
+
+  results = []
+  if args.quick:
+    flash_shapes = [(1, 512, 4, 64, True)]
+    ln_shapes = [(4096, 1024)]
+  else:
+    flash_shapes = [
+        (1, 512, 4, 64, True),
+        (2, 1024, 8, 64, True),
+        (2, 1024, 8, 64, False),
+        (1, 2048, 8, 128, True),
+        (4, 4096, 8, 128, True),
+    ]
+    ln_shapes = [(4096, 1024), (8192, 768), (16384, 4096)]
+
+  for dt in (("bf16",) if args.quick else ("bf16", "f32")):
+    check_flash(results, flash_shapes, dt)
+  check_layer_norm(results, ln_shapes)
+
+  n_ok = sum(1 for r in results if r.get("ok"))
+  for r in results:
+    print(json.dumps(r))
+  print("\n%d/%d kernels ok" % (n_ok, len(results)), file=sys.stderr)
+  if args.json:
+    with open(args.json, "w") as f:
+      json.dump(dict(device=str(dev), results=results), f, indent=1)
+  return 0 if n_ok == len(results) else 1
+
+
+if __name__ == "__main__":
+  sys.exit(main())
