@@ -56,6 +56,21 @@ def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base=0, k_base=0):
   return s
 
 
+def _causal_k_hi(qi, q_base, k_base, blk_q, blk_k, n_kblocks):
+  """Exclusive upper bound on k-blocks visible to q-block ``qi`` under the
+  causal mask — blocks past the diagonal are fully masked, so the online-
+  softmax loop skips them instead of exp()-ing NEG_INF (≈2× FLOPs saved
+  at equal bases; rides the ring offsets for sequence parallelism)."""
+  q_hi = q_base + (qi + 1) * blk_q - 1      # max absolute q position
+  return jnp.clip((q_hi - k_base) // blk_k + 1, 0, n_kblocks)
+
+
+def _causal_q_lo(ki, q_base, k_base, blk_q, blk_k):
+  """First q-block with any row at-or-past k-block ``ki``'s start."""
+  k_lo = k_base + ki * blk_k - q_base       # min k position, q-relative
+  return jnp.clip(k_lo // blk_q, 0, None)
+
+
 # --- kernels ---------------------------------------------------------------
 
 
@@ -88,7 +103,9 @@ def _attn_fwd_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
   m0 = jnp.full((blk_q, 1), NEG_INF, jnp.float32)
   l0 = jnp.zeros((blk_q, 1), jnp.float32)
   acc0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
-  m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
+  hi = _causal_k_hi(qi, q_base, k_base, blk_q, blk_k, n_kblocks) \
+      if causal else n_kblocks
+  m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
 
   l_safe = jnp.where(l == 0.0, 1.0, l)
   o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
@@ -121,7 +138,9 @@ def _attn_bwd_dq_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     return dq + ds @ k.astype(jnp.float32)
 
   dq0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
-  dq = lax.fori_loop(0, n_kblocks, body, dq0)
+  hi = _causal_k_hi(qi, q_base, k_base, blk_q, blk_k, n_kblocks) \
+      if causal else n_kblocks
+  dq = lax.fori_loop(0, hi, body, dq0)
   dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -155,7 +174,8 @@ def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
   dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
   dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
-  dk, dv = lax.fori_loop(0, n_qblocks, body, (dk0, dv0))
+  lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
+  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
   dk_ref[0] = dk.astype(dk_ref.dtype)   # q was pre-scaled; dk absorbs it
   dv_ref[0] = dv.astype(dv_ref.dtype)
 

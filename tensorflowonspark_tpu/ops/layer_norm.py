@@ -110,10 +110,19 @@ def _ln_fwd_rule(x, weight, eps, blk_rows, interpret):
   return y, (x, weight)
 
 
-def _pick_block(rows: int, blk_rows: int) -> int:
+def _pick_block(rows: int, blk_rows: int, h: int, itemsize: int = 0) -> int:
   """Largest block <= blk_rows that divides the row count (always >= 1),
-  so any shape works without padding or uncovered rows."""
+  so any shape works without padding or uncovered rows.
+
+  With ``itemsize`` set (the BACKWARD path), the block is additionally
+  capped so one [blk, H] input block stays <= 1 MiB: the f32 backward at
+  H=4096 with 128-row blocks crashes the real-TPU compile helper, while
+  the forward at the same shape, the bf16 backward at blk=128, and the
+  f32 backward at blk=64 all compile fine — so the cap keys off the
+  actual element footprint and is not applied to the forward."""
   blk = min(blk_rows, rows)
+  if itemsize:
+    blk = min(blk, max(8, (1 << 20) // (h * itemsize)))
   while rows % blk != 0:
     blk -= 1
   return blk
@@ -127,7 +136,7 @@ def _ln_fwd(x, weight, eps, blk_rows, interpret):
     rows *= s
   xf = x.reshape(rows, h)
   w2 = weight.reshape(1, h)
-  blk = _pick_block(rows, blk_rows)
+  blk = _pick_block(rows, blk_rows, h)
 
   y = pl.pallas_call(
       functools.partial(_ln_fwd_kernel, eps=eps),
@@ -153,7 +162,7 @@ def _ln_bwd_rule(eps, blk_rows, interpret, residuals, g):
   xf = x.reshape(rows, h)
   gf = g.reshape(rows, h)
   w2 = weight.reshape(1, h)
-  blk = _pick_block(rows, blk_rows)
+  blk = _pick_block(rows, blk_rows, h, jnp.dtype(x.dtype).itemsize)
 
   dx, dw_partial = pl.pallas_call(
       functools.partial(_ln_bwd_kernel, eps=eps),

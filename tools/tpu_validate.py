@@ -48,12 +48,18 @@ def _dense_attn(q, k, v, causal):
 
 
 def check_flash(results, shapes, dtype_name):
+  import contextlib
   import jax
   import jax.numpy as jnp
   import importlib
   fa = importlib.import_module('tensorflowonspark_tpu.ops.flash_attention')
 
   dtype = dict(bf16=jnp.bfloat16, f32=jnp.float32)[dtype_name]
+  # f32 runs under precision=highest so it is validated at f32 accuracy —
+  # at the MXU's default precision (bf16 mantissa passes for any input
+  # dtype) a bf16-grade tolerance would make the f32 rows redundant
+  prec = (jax.default_matmul_precision("highest") if dtype_name == "f32"
+          else contextlib.nullcontext())
   for (b, s, h, d, causal) in shapes:
     key = jax.random.PRNGKey(0)
     kq, kk, kv, kg = jax.random.split(key, 4)
@@ -67,8 +73,9 @@ def check_flash(results, shapes, dtype_name):
     name = "flash_fwd[%s b%d s%d h%d d%d %s]" % (
         dtype_name, b, s, h, d, "causal" if causal else "full")
     try:
-      out_f = flash(q, k, v)
-      out_d = dense(q, k, v)
+      with prec:
+        out_f = flash(q, k, v)
+        out_d = dense(q, k, v)
       err = float(jnp.max(jnp.abs(out_f.astype(jnp.float32) -
                                   out_d.astype(jnp.float32))))
       tol = 2e-2 if dtype_name == "bf16" else 2e-5
@@ -96,8 +103,9 @@ def check_flash(results, shapes, dtype_name):
               _dense_attn(q, k, v, causal)
               .astype(jnp.float32) * g.astype(jnp.float32)),
           argnums=(0, 1, 2)))
-      gf = loss_f(q, k, v)
-      gd = loss_d(q, k, v)
+      with prec:
+        gf = loss_f(q, k, v)
+        gd = loss_d(q, k, v)
       err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
                                       b_.astype(jnp.float32))))
                 for a, b_ in zip(gf, gd))
@@ -112,27 +120,99 @@ def check_flash(results, shapes, dtype_name):
       results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
 
+def check_flash_block(results):
+  """flash_attention_block with TRACED position bases + merge_partials.
+
+  This is the ring-attention production path: bases reach the kernel
+  through SMEM scalar prefetch as runtime values (inside shard_map they
+  come from ``lax.axis_index``), and the causal-skip loop bounds become
+  data-dependent while-loop trip counts. Computing full causal attention
+  as two merged KV-half partials exercises exactly that, single-chip.
+  """
+  import jax
+  import jax.numpy as jnp
+  import importlib
+  fa = importlib.import_module('tensorflowonspark_tpu.ops.flash_attention')
+
+  b, s, h, d = 2, 1024, 4, 64
+  key = jax.random.PRNGKey(2)
+  kq, kk, kv = jax.random.split(key, 3)
+  q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+  k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+  v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
+  half = s // 2
+
+  @jax.jit
+  def two_block(q, k, v, kv_base0, kv_base1):
+    # bases enter as traced device scalars, like lax.axis_index would
+    o0, l0 = fa.flash_attention_block(q, k[:, :half], v[:, :half],
+                                      0, kv_base0, causal=True)
+    o1, l1 = fa.flash_attention_block(q, k[:, half:], v[:, half:],
+                                      0, kv_base1, causal=True)
+    o, _ = fa.merge_partials(o0, l0, o1, l1)
+    return o
+
+  name = "flash_block_traced_bases[bf16 b%d s%d h%d d%d]" % (b, s, h, d)
+  try:
+    out = two_block(q, k, v, jnp.int32(0), jnp.int32(half))
+    ref = _dense_attn(q, k, v, True)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                ref.astype(jnp.float32))))
+    t = _timeit(two_block, q, k, v, jnp.int32(0), jnp.int32(half))
+    results.append(dict(kernel=name, ok=err < 2e-2, max_err=err,
+                        flash_ms=round(t * 1e3, 3)))
+  except Exception as e:  # noqa: BLE001
+    results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+  # gradient through both partials and the merge (ring bwd path)
+  name = "flash_block_traced_bases_grad"
+  try:
+    g = jax.random.normal(jax.random.PRNGKey(3), (b, s, h, d), jnp.bfloat16)
+    gfn = jax.jit(jax.grad(
+        lambda q, k, v, b0, b1: jnp.sum(
+            two_block.__wrapped__(q, k, v, b0, b1).astype(jnp.float32) *
+            g.astype(jnp.float32)), argnums=(0, 1, 2)))
+    gref = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(
+            _dense_attn(q, k, v, True).astype(jnp.float32) *
+            g.astype(jnp.float32)), argnums=(0, 1, 2)))
+    gb = gfn(q, k, v, jnp.int32(0), jnp.int32(half))
+    gr = gref(q, k, v)
+    err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                    b_.astype(jnp.float32))))
+              for a, b_ in zip(gb, gr))
+    results.append(dict(kernel=name, ok=err < 1e-1, max_err=err))
+  except Exception as e:  # noqa: BLE001
+    results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+
 def check_layer_norm(results, shapes):
   import jax
   import jax.numpy as jnp
   import importlib
   ln = importlib.import_module('tensorflowonspark_tpu.ops.layer_norm')
 
-  for (rows, d) in shapes:
+  for (rows, d), dtype_name in [(s, dt) for s in shapes
+                                for dt in ("f32", "bf16")]:
+    dtype = dict(bf16=jnp.bfloat16, f32=jnp.float32)[dtype_name]
     key = jax.random.PRNGKey(1)
-    x = jax.random.normal(key, (rows, d), jnp.float32)
-    gamma = jnp.ones((d,), jnp.float32) * 1.1
+    x = jax.random.normal(key, (rows, d), dtype)
+    gamma = (jnp.ones((d,), dtype) * 1.1).astype(dtype)
+    tol = 2e-2 if dtype_name == "bf16" else 1e-4
 
     fused = jax.jit(lambda x, g: ln.layer_norm(x, g))
     ref = jax.jit(lambda x, g: (
-        (x - jnp.mean(x, -1, keepdims=True)) *
-        jax.lax.rsqrt(jnp.var(x, -1, keepdims=True) + 1e-6) * g))
-    name = "layer_norm[%dx%d]" % (rows, d)
+        ((x.astype(jnp.float32) -
+          jnp.mean(x.astype(jnp.float32), -1, keepdims=True)) *
+         jax.lax.rsqrt(jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+                       + 1e-6) * g.astype(jnp.float32)).astype(x.dtype)))
+    name = "layer_norm[%s %dx%d]" % (dtype_name, rows, d)
     try:
-      err = float(jnp.max(jnp.abs(fused(x, gamma) - ref(x, gamma))))
+      err = float(jnp.max(jnp.abs(fused(x, gamma).astype(jnp.float32) -
+                                  ref(x, gamma).astype(jnp.float32))))
       t_f = _timeit(fused, x, gamma)
       t_r = _timeit(ref, x, gamma)
-      results.append(dict(kernel=name, ok=err < 1e-4, max_err=err,
+      results.append(dict(kernel=name, ok=err < tol, max_err=err,
                           fused_ms=round(t_f * 1e3, 3),
                           xla_ms=round(t_r * 1e3, 3),
                           speedup=round(t_r / t_f, 2)))
@@ -140,18 +220,18 @@ def check_layer_norm(results, shapes):
       results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
     # gradient path (used by FusedLayerNorm during training)
-    name = "layer_norm_grad[%dx%d]" % (rows, d)
+    name = "layer_norm_grad[%s %dx%d]" % (dtype_name, rows, d)
     try:
-      gf = jax.jit(jax.grad(lambda x, g: jnp.sum(ln.layer_norm(x, g)),
-                            argnums=(0, 1)))
-      gr = jax.jit(jax.grad(
-          lambda x, g: jnp.sum(
-              (x - jnp.mean(x, -1, keepdims=True)) *
-              jax.lax.rsqrt(jnp.var(x, -1, keepdims=True) + 1e-6) * g),
+      gf = jax.jit(jax.grad(
+          lambda x, g: jnp.sum(ln.layer_norm(x, g).astype(jnp.float32)),
           argnums=(0, 1)))
-      err = max(float(jnp.max(jnp.abs(a - b_)))
+      gr = jax.jit(jax.grad(
+          lambda x, g: jnp.sum(ref.__wrapped__(x, g).astype(jnp.float32)),
+          argnums=(0, 1)))
+      err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                      b_.astype(jnp.float32))))
                 for a, b_ in zip(gf(x, gamma), gr(x, gamma)))
-      results.append(dict(kernel=name, ok=err < 1e-3, max_err=err))
+      results.append(dict(kernel=name, ok=err < max(tol, 1e-3), max_err=err))
     except Exception as e:  # noqa: BLE001
       results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
@@ -185,6 +265,7 @@ def main(argv=None):
 
   for dt in (("bf16",) if args.quick else ("bf16", "f32")):
     check_flash(results, flash_shapes, dt)
+  check_flash_block(results)
   check_layer_norm(results, ln_shapes)
 
   n_ok = sum(1 for r in results if r.get("ok"))
