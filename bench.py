@@ -24,19 +24,69 @@ import time
 TARGET_IMG_PER_SEC = 1000.0   # engineering target, not a reference number
 BATCH = 128
 IMAGE = (224, 224, 3)
-WARMUP, MEASURE = 3, 10
+MEASURE = 10   # steps chained per timed dispatch
 
-# Transformer benchmark shape: GPT-2-small-class decoder (124M params)
+# Transformer benchmark shape: GPT-2-small-class decoder (124M params).
+# batch 16 without remat is the single-chip throughput sweet spot on v5e
+# (batch 8: 83k tok/s; batch 16: 88k; batch 24+ OOMs without remat; remat
+# costs ~21% at batch 16) — remat stays available for memory-bound configs.
 TFM_LAYERS, TFM_DMODEL, TFM_HEADS, TFM_DFF = 12, 768, 12, 3072
-TFM_VOCAB, TFM_SEQ, TFM_BATCH = 32000, 1024, 8
-TFM_WARMUP, TFM_MEASURE = 2, 8
+TFM_VOCAB, TFM_SEQ, TFM_BATCH = 32000, 1024, 16
+TFM_REMAT = False
+TFM_MEASURE = 8
 
 if os.environ.get("TOS_BENCH_SMOKE"):
   # tiny shapes so CI can drive the full bench path on CPU
-  BATCH, IMAGE, WARMUP, MEASURE = 8, (64, 64, 3), 1, 2
+  BATCH, IMAGE, MEASURE = 8, (64, 64, 3), 3
   TFM_LAYERS, TFM_DMODEL, TFM_HEADS, TFM_DFF = 2, 128, 4, 256
   TFM_VOCAB, TFM_SEQ, TFM_BATCH = 512, 128, 2
-  TFM_WARMUP, TFM_MEASURE = 1, 2
+  TFM_MEASURE = 3
+
+
+def _steps_per_sec(step_fn, state, args, k, label):
+  """Per-step time via a lax.scan-chained K-step dispatch.
+
+  On the tunneled axon device, per-step host loops mis-measure in both
+  directions: ``block_until_ready`` under-syncs (MFU read >100%), and a
+  per-step value fetch adds a full RPC round-trip per step. Chaining K
+  steps inside ONE jitted scan and subtracting a 1-step baseline isolates
+  true on-device step time (verified self-consistent across K).
+  """
+  import functools
+  import time as _time
+  import jax
+  from jax import lax
+
+  @functools.partial(jax.jit, static_argnames=("k",))
+  def multi(state, k):
+    def body(st, _):
+      st, loss = step_fn(st, *args)
+      return st, loss
+    st, losses = lax.scan(body, state, None, length=k)
+    return st, losses[-1]
+
+  t_compile = _time.time()
+  _, loss = multi(state, 1)
+  first_loss = float(loss)   # full fetch = real sync
+  _, loss = multi(state, k)
+  float(loss)
+  sys.stderr.write("%s compile (1+%d-step) %.1fs loss=%.3f\n"
+                   % (label, k, _time.time() - t_compile, first_loss))
+
+  def _timed(kk):
+    t0 = _time.time()
+    _, loss = multi(state, kk)
+    float(loss)
+    return _time.time() - t0
+
+  # best-of-2 each, and guard the difference: on the RPC-floor-dominated
+  # tunnel dt_k - dt_1 can be noise; fall back to the plain K-run average
+  # (a conservative under-estimate) rather than divide by <= 0
+  dt_k = min(_timed(k), _timed(k))
+  dt_1 = min(_timed(1), _timed(1))
+  if dt_k - dt_1 <= 0.2 * dt_k:
+    return k / dt_k
+  return (k - 1) / (dt_k - dt_1)
 
 
 def _emit(value, unit="images/sec/chip", metric="resnet50_train_throughput",
@@ -87,21 +137,9 @@ def _bench_resnet():
   images = jnp.asarray(rng.rand(BATCH, *IMAGE), jnp.float32)
   labels = jnp.asarray(rng.randint(0, 1000, BATCH), jnp.int32)
 
-  t_compile = time.time()
-  state, loss = resnet.train_step(state, images, labels)
-  jax.block_until_ready(loss)
-  sys.stderr.write("resnet first step (compile) %.1fs loss=%.3f\n"
-                   % (time.time() - t_compile, float(loss)))
-
-  for _ in range(WARMUP):
-    state, loss = resnet.train_step(state, images, labels)
-  jax.block_until_ready(loss)
-
-  t0 = time.time()
-  for _ in range(MEASURE):
-    state, loss = resnet.train_step(state, images, labels)
-  jax.block_until_ready(loss)
-  return BATCH * MEASURE / (time.time() - t0)
+  steps_per_sec = _steps_per_sec(resnet.train_step, state,
+                                 (images, labels), MEASURE, "resnet")
+  return BATCH * steps_per_sec
 
 
 def _chip_peak_flops():
@@ -123,21 +161,22 @@ def _chip_peak_flops():
   return gen, profiler.PEAK_BF16_FLOPS[gen]
 
 
-def _bench_transformer(**cfg_overrides):
+def _bench_transformer(batch=None, **cfg_overrides):
   """Decoder-only LM training: tokens/sec + MFU on one chip."""
   import numpy as np
   import jax
   import jax.numpy as jnp
   from tensorflowonspark_tpu.models import transformer as tfm
 
+  batch = TFM_BATCH if batch is None else batch
+  cfg_overrides.setdefault("remat", TFM_REMAT)
   cfg = tfm.TransformerConfig(
       vocab_size=TFM_VOCAB, num_layers=TFM_LAYERS, num_heads=TFM_HEADS,
-      d_model=TFM_DMODEL, d_ff=TFM_DFF, max_seq_len=TFM_SEQ, remat=True,
+      d_model=TFM_DMODEL, d_ff=TFM_DFF, max_seq_len=TFM_SEQ,
       **cfg_overrides)
   state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=TFM_SEQ)
   n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))
 
-  @jax.jit
   def train_step(state, tokens):
     def loss_fn(params):
       logits = state.apply_fn({"params": params}, tokens)
@@ -146,26 +185,14 @@ def _bench_transformer(**cfg_overrides):
     return state.apply_gradients(grads=grads), loss
 
   rng = np.random.RandomState(0)
-  tokens = jnp.asarray(rng.randint(0, TFM_VOCAB, (TFM_BATCH, TFM_SEQ)),
+  tokens = jnp.asarray(rng.randint(0, TFM_VOCAB, (batch, TFM_SEQ)),
                        jnp.int32)
 
-  t_compile = time.time()
-  state, loss = train_step(state, tokens)
-  jax.block_until_ready(loss)
-  sys.stderr.write("transformer first step (compile) %.1fs loss=%.3f\n"
-                   % (time.time() - t_compile, float(loss)))
-
-  for _ in range(TFM_WARMUP):
-    state, loss = train_step(state, tokens)
-  jax.block_until_ready(loss)
-  t0 = time.time()
-  for _ in range(TFM_MEASURE):
-    state, loss = train_step(state, tokens)
-  jax.block_until_ready(loss)
-  dt = time.time() - t0
+  steps_per_sec = _steps_per_sec(train_step, state, (tokens,),
+                                 TFM_MEASURE, "transformer")
 
   from tensorflowonspark_tpu.utils import profiler
-  tokens_per_sec = TFM_BATCH * TFM_SEQ * TFM_MEASURE / dt
+  tokens_per_sec = batch * TFM_SEQ * steps_per_sec
   flops_per_token = profiler.transformer_flops_per_token(
       n_params, TFM_LAYERS, TFM_DMODEL, TFM_SEQ)
   gen, peak = _chip_peak_flops()
@@ -195,8 +222,12 @@ def main():
     # paths (dense attention, flax LayerNorm) and say so in the JSON
     sys.stderr.write("transformer bench failed on fused paths: %s\n" % e)
     try:
+      # the throughput-tuned primary config (batch 16, no remat) does not
+      # fit when dense attention materializes [B,H,S,S] scores for the
+      # backward — fall back on the memory-safe shape as well
       extra = _bench_transformer(attention_impl="dense",
-                                 layer_norm_impl="flax")
+                                 layer_norm_impl="flax", remat=True,
+                                 batch=min(TFM_BATCH, 8))
       extra["transformer_fallback"] = \
           "fused kernels failed (%s); measured dense/XLA paths" % \
           type(e).__name__
