@@ -19,7 +19,6 @@ import os
 import signal
 import subprocess
 import sys
-import time
 
 TARGET_IMG_PER_SEC = 1000.0   # engineering target, not a reference number
 BATCH = 128
@@ -53,7 +52,7 @@ def _steps_per_sec(step_fn, state, args, k, label):
   true on-device step time (verified self-consistent across K).
   """
   import functools
-  import time as _time
+  import time as _time   # deferred with jax: bench imports nothing heavy at module load
   import jax
   from jax import lax
 

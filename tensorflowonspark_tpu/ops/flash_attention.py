@@ -184,12 +184,21 @@ def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _blocks(s_q, s_kv, blk_q, blk_k):
-  blk_q = min(blk_q, s_q)
-  blk_k = min(blk_k, s_kv)
-  assert s_q % blk_q == 0 and s_kv % blk_k == 0, \
-      "seq (%d, %d) not divisible by blocks (%d, %d)" % (s_q, s_kv,
-                                                         blk_q, blk_k)
-  return blk_q, blk_k
+  """Clamp block sizes so any sequence length works without padding.
+
+  Mosaic accepts a sublane block only if it is a multiple of 8 or equal
+  to the full dimension, so shrink to the largest divisor of ``s`` that
+  is a multiple of 8; when no such divisor exists (e.g. s = 2·499) fall
+  back to one full-dimension block rather than a tiny degenerate one.
+  """
+  def _fit(blk, s):
+    blk = min(blk, s)
+    while blk > 0:
+      if s % blk == 0 and (blk % 8 == 0 or blk == s):
+        return blk
+      blk -= 1
+    return s
+  return _fit(blk_q, s_q), _fit(blk_k, s_kv)
 
 
 def _fold(x):
@@ -327,8 +336,8 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
 # --- public: full attention -------------------------------------------------
 
 
-def flash_attention(q, k, v, causal: bool = True, blk_q: int = 128,
-                    blk_k: int = 128, interpret: bool = False):
+def flash_attention(q, k, v, causal: bool = True, blk_q: int = 256,
+                    blk_k: int = 512, interpret: bool = False):
   """Fused (self-)attention with fused backward. q/k/v: [batch, seq,
   heads, head_dim]; seq must divide by the (clamped) block sizes."""
   return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret)
@@ -358,7 +367,7 @@ _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
-                          blk_q: int = 128, blk_k: int = 128,
+                          blk_q: int = 256, blk_k: int = 512,
                           interpret: bool = False):
   """Partial attention of local queries against ONE KV block.
 

@@ -129,7 +129,7 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
 def ring_attention(q, k, v, mesh, causal: bool = True,
                    axis_name: str = mesh_lib.AXIS_SEQUENCE,
                    batch_axes=None, use_flash: bool = False,
-                   blk_q: int = 128, blk_k: int = 128,
+                   blk_q: int = 256, blk_k: int = 512,
                    interpret: bool = False):
   """Exact full attention over a sequence sharded across ``axis_name``.
 
