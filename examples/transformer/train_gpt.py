@@ -36,6 +36,9 @@ if __name__ == "__main__":
   parser.add_argument("--vocab", type=int, default=1024)
   parser.add_argument("--batch", type=int, default=8)
   parser.add_argument("--steps", type=int, default=10)
+  parser.add_argument("--blocked_loss", action="store_true",
+                      help="fused projection+cross-entropy (peak memory "
+                           "[B,chunk,V] instead of [B,S,V])")
   args = parser.parse_args()
 
   import numpy as np
@@ -58,6 +61,12 @@ if __name__ == "__main__":
                                              mesh, seq_len=args.seq_len)
 
   def loss_fn(params, tokens):
+    if args.blocked_loss:
+      # fused projection+xent: never materializes [batch, seq, vocab]
+      hidden = state.apply_fn({"params": params}, tokens,
+                              return_hidden=True)
+      return tfm.causal_lm_loss_blocked(
+          hidden, tfm.tied_embedding_table(params), tokens)
     return tfm.causal_lm_loss(state.apply_fn({"params": params}, tokens),
                               tokens)
 

@@ -42,6 +42,10 @@ class TransformerConfig:
   # the kernel everywhere (interpret mode off-TPU — how CPU CI exercises
   # the production attention path); "dense" opts out
   attention_impl: str = "auto"
+  # Grouped-query attention: 0 means = num_heads (vanilla MHA); 1 is MQA.
+  # K/V are projected to this many heads and the per-layer KV cache stores
+  # only them — a num_heads/num_kv_heads reduction in serving cache memory
+  num_kv_heads: int = 0
   # "auto": fused Pallas LayerNorm (ops.layer_norm) on TPU, flax elsewhere;
   # "fused" forces the kernel everywhere (interpret mode off-TPU — how CPU
   # CI exercises the production code path); "flax" opts out
@@ -67,11 +71,18 @@ class TransformerConfig:
     if self.layer_norm_impl not in ("auto", "fused", "flax"):
       raise ValueError("layer_norm_impl must be 'auto', 'fused' or 'flax', "
                        "got %r" % (self.layer_norm_impl,))
+    if self.num_kv_heads and self.num_heads % self.num_kv_heads != 0:
+      raise ValueError("num_kv_heads (%d) must divide num_heads (%d)"
+                       % (self.num_kv_heads, self.num_heads))
 
   @property
   def head_dim(self) -> int:
     assert self.d_model % self.num_heads == 0
     return self.d_model // self.num_heads
+
+  @property
+  def kv_heads(self) -> int:
+    return self.num_kv_heads or self.num_heads
 
 
 def _rotary(x, positions):
@@ -155,6 +166,16 @@ def _make_layer_norm(cfg: TransformerConfig, mesh, name: str):
   return nn.LayerNorm(dtype=jnp.float32, use_bias=False, name=name)
 
 
+def _expand_kv(kv, num_heads):
+  """Broadcast grouped KV heads to the full query head count: KV head j
+  serves query heads [j·g, (j+1)·g) for group size g = num_heads/kv_heads
+  (query head i reads KV head i // g)."""
+  hk = kv.shape[2]
+  if hk == num_heads:
+    return kv
+  return jnp.repeat(kv, num_heads // hk, axis=2)
+
+
 class Attention(nn.Module):
   cfg: TransformerConfig
   mesh: Optional[Any] = None
@@ -166,16 +187,23 @@ class Attention(nn.Module):
         feats, axis=-1, dtype=cfg.dtype, use_bias=False, name=name,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.lecun_normal(), logical))
-    qkv_shape = (cfg.num_heads, cfg.head_dim)
-    q = dense(qkv_shape, ("embed", "heads", "kv"), "q")(x)
-    k = dense(qkv_shape, ("embed", "heads", "kv"), "k")(x)
-    v = dense(qkv_shape, ("embed", "heads", "kv"), "v")(x)
+    q = dense((cfg.num_heads, cfg.head_dim),
+              ("embed", "heads", "kv"), "q")(x)
+    # GQA: K/V carry only kv_heads heads (= num_heads unless configured)
+    k = dense((cfg.kv_heads, cfg.head_dim),
+              ("embed", "heads", "kv"), "k")(x)
+    v = dense((cfg.kv_heads, cfg.head_dim),
+              ("embed", "heads", "kv"), "v")(x)
 
     if decode:
       return self._decode_attend(q, k, v)
 
     q = _rotary(q, positions)
     k = _rotary(k, positions)
+    # the training path attends at full head count: broadcast each KV head
+    # to its query group (XLA fuses the repeat; the kernels stay MHA-shaped)
+    k = _expand_kv(k, cfg.num_heads)
+    v = _expand_kv(v, cfg.num_heads)
 
     interp = jax.default_backend() != "tpu"   # forced-flash CI runs
     if cfg.use_ring_attention and self.mesh is not None:
@@ -206,14 +234,20 @@ class Attention(nn.Module):
 
     Writes the new keys/values at the cache cursor, attends the query
     block against everything cached so far, and advances the cursor.
-    Cache shape is [batch, max_seq_len, heads, head_dim] per layer.
+    Cache shape is [batch, max_seq_len, kv_heads, head_dim] per layer —
+    under GQA the cache holds only the grouped KV heads (the serving
+    memory win), and the attention einsums carry an explicit group axis
+    instead of materializing an expanded cache.
     """
     cfg = self.cfg
     b, seg, h, d = q.shape
+    hk = cfg.kv_heads
     cached_k = self.variable(
-        "cache", "cached_k", jnp.zeros, (b, cfg.max_seq_len, h, d), cfg.dtype)
+        "cache", "cached_k", jnp.zeros, (b, cfg.max_seq_len, hk, d),
+        cfg.dtype)
     cached_v = self.variable(
-        "cache", "cached_v", jnp.zeros, (b, cfg.max_seq_len, h, d), cfg.dtype)
+        "cache", "cached_v", jnp.zeros, (b, cfg.max_seq_len, hk, d),
+        cfg.dtype)
     cursor = self.variable("cache", "index",
                            lambda: jnp.zeros((), jnp.int32))
     idx = cursor.value
@@ -228,15 +262,18 @@ class Attention(nn.Module):
     cursor.value = idx + seg
 
     scale = 1.0 / (d ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    # q regrouped [b, seg, kv_head, group, d]: query head i = KV head i//g
+    qg = q.reshape(b, seg, hk, h // hk, d).astype(jnp.float32)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
                         cached_k.value.astype(jnp.float32)) * scale
     q_pos = idx + jnp.arange(seg)[:, None]          # [seg, 1]
     k_pos = jnp.arange(cfg.max_seq_len)[None, :]    # [1, max]
-    mask = (k_pos <= q_pos)[None, None]             # causal + unwritten
+    mask = (k_pos <= q_pos)[None, None, None]       # causal + unwritten
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                     cached_v.value.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs,
+                     cached_v.value.astype(jnp.float32))
+    out = out.reshape(b, seg, h, d).astype(q.dtype)
     return self._out_proj(out)
 
 
@@ -336,7 +373,8 @@ class Transformer(nn.Module):
   mesh: Optional[Any] = None
 
   @nn.compact
-  def __call__(self, tokens, decode: bool = False):
+  def __call__(self, tokens, decode: bool = False,
+               return_hidden: bool = False):
     cfg = self.cfg
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
     emb = nn.Embed(
@@ -357,6 +395,11 @@ class Transformer(nn.Module):
       x = layer(x, positions, True) if decode else layer(x, positions)
 
     x = _make_layer_norm(cfg, self.mesh, "ln_f")(x)
+    if return_hidden:
+      # pre-projection hidden states for the fused blocked loss
+      # (:func:`causal_lm_loss_blocked`) — callers project against the
+      # tied table chunk-by-chunk instead of materializing [B, S, V]
+      return x.astype(cfg.dtype)
     # tied output projection (attend to the embedding table)
     logits = emb.attend(x.astype(cfg.dtype))
     return logits.astype(jnp.float32)
@@ -532,6 +575,58 @@ def causal_lm_loss(logits, tokens):
   logits = logits[:, :-1]
   return optax.softmax_cross_entropy_with_integer_labels(
       logits, targets).mean()
+
+
+def tied_embedding_table(params):
+  """The tied input/output embedding [vocab, d_model] from a Transformer
+  param tree (unboxing flax ``Partitioned`` leaves if present)."""
+  table = params["embed"]["embedding"]
+  if hasattr(table, "unbox"):
+    table = table.unbox()
+  return table
+
+
+def causal_lm_loss_blocked(hidden, table, tokens, chunk: int = 256):
+  """Next-token cross-entropy fused with the tied output projection.
+
+  The [batch, seq, vocab] logits are never materialized: sequence chunks
+  of ``chunk`` positions are projected against ``table``, reduced to
+  (logsumexp, label logit), and discarded; ``jax.checkpoint`` around the
+  chunk body makes the backward recompute each chunk's logits in turn, so
+  peak activation memory is [batch, chunk, vocab] instead of
+  [batch, seq, vocab] (a vocab-sized factor — ~2 GB down to ~500 MB at
+  the bench config, which is what bounded the trainable batch size).
+
+  ``hidden``: final-layer-norm output from
+  ``model.apply(..., return_hidden=True)`` [B, S, D]; ``table``: tied
+  embedding [V, D] (:func:`tied_embedding_table`). Matches
+  :func:`causal_lm_loss` on the same inputs to float tolerance.
+  """
+  targets = tokens[:, 1:]
+  x = hidden[:, :-1]
+  b, s, d = x.shape
+  n = -(-s // chunk)
+  pad = n * chunk - s
+  if pad:
+    x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    targets = jnp.pad(targets, ((0, 0), (0, pad)))
+  mask = (jnp.arange(n * chunk) < s).astype(jnp.float32)
+  xs = x.reshape(b, n, -1, d).transpose(1, 0, 2, 3)     # [n, B, C, D]
+  ts = targets.reshape(b, n, -1).transpose(1, 0, 2)     # [n, B, C]
+  ms = mask.reshape(n, -1)                              # [n, C]
+  tbl = table.astype(x.dtype)
+
+  @jax.checkpoint
+  def body(tot, inp):
+    xc, tc, mc = inp
+    logits = jnp.einsum("bcd,vd->bcv", xc, tbl,
+                        preferred_element_type=jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)             # [B, C]
+    ll = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+    return tot + jnp.sum((lse - ll) * mc[None, :]), None
+
+  total, _ = jax.lax.scan(body, jnp.float32(0.0), (xs, ts, ms))
+  return total / (b * s)
 
 
 def _init_fns(rng, cfg: TransformerConfig, mesh, learning_rate, seq_len,

@@ -5,9 +5,13 @@ Flash-attention-style: the forward streams over K/V blocks with an online
 softmax carried in VMEM scratch, so the [Sq, Sk] score matrix never hits
 HBM — scores are produced on the MXU, normalized on the VPU, accumulated
 in float32 while inputs stay bfloat16. The forward also emits the per-row
-logsumexp; the backward is two fused kernels (dQ over q-blocks, dK/dV over
-k-blocks) using the standard Δ correction, so training never materializes
-dense scores either.
+logsumexp; the backward (standard Δ correction, dense scores never
+materialized) defaults to ONE single-pass kernel producing dQ/dK/dV per
+k-block with dQ accumulated in a grid-resident VMEM block — 5 MXU matmuls
+per (q, k) block pair; ``TFOS_TPU_FLASH_BWD=split`` (or ``bwd="split"``)
+selects the two-kernel plan (dQ over q-blocks, dK/dV over k-blocks; 7
+matmuls/pair). Backward block sizes resolve separately from the forward's
+(``DEFAULT_BWD_BLOCKS``).
 
 :func:`flash_attention` is full (self-)attention. :func:`flash_attention_block`
 computes a PARTIAL attention of local queries against one remote KV block
@@ -71,6 +75,18 @@ def _causal_q_lo(ki, q_base, k_base, blk_q, blk_k):
   return jnp.clip(k_lo // blk_q, 0, None)
 
 
+def _pair_p_ds(s, lse, delta, do, v):
+  """Shared backward math for one (q, k) block pair — P recomputed from
+  the forward's logsumexp (fully-masked rows/entries forced to 0), then
+  dP = dO·Vᵀ and dS = P ⊙ (dP − Δ). Used by all three backward kernels
+  (dQ, dK/dV, fused) so a masking/Δ fix lands everywhere at once."""
+  lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
+  p = jnp.exp(s - lse_safe)
+  p = jnp.where(jnp.logical_or(s <= NEG_INF, lse <= NEG_INF), 0.0, p)
+  ds = p * (do @ v.T - delta)
+  return p, ds
+
+
 # --- kernels ---------------------------------------------------------------
 
 
@@ -130,11 +146,7 @@ def _attn_bwd_dq_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k = k_ref[0, pl.ds(ki * blk_k, blk_k), :]
     v = v_ref[0, pl.ds(ki * blk_k, blk_k), :]
     s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
-    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
-    p = jnp.exp(s - lse_safe)
-    p = jnp.where(jnp.logical_or(s <= NEG_INF, lse <= NEG_INF), 0.0, p)
-    dp = do @ v.astype(jnp.float32).T               # [blk_q, blk_k]
-    ds = p * (dp - delta)
+    _, ds = _pair_p_ds(s, lse, delta, do, v.astype(jnp.float32))
     return dq + ds @ k.astype(jnp.float32)
 
   dq0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
@@ -163,12 +175,8 @@ def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
     delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
     s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
-    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
-    p = jnp.exp(s - lse_safe)
-    p = jnp.where(jnp.logical_or(s <= NEG_INF, lse <= NEG_INF), 0.0, p)
+    p, ds = _pair_p_ds(s, lse, delta, do, v)
     dv_new = dv + p.T @ do
-    dp = do @ v.T
-    ds = p * (dp - delta)
     dk_new = dk + ds.T @ q
     return dk_new, dv_new
 
@@ -177,6 +185,52 @@ def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
   lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
   dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
   dk_ref[0] = dk.astype(dk_ref.dtype)   # q was pre-scaled; dk absorbs it
+  dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _attn_bwd_fused_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
+                           lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *,
+                           blk_q: int, blk_k: int, q_len: int, causal: bool,
+                           scale: float):
+  """Single-pass backward: dK/dV for one k-block plus this k-block's dQ
+  contributions, accumulated into a grid-resident full-sequence dQ output.
+
+  The dQ output's index map ignores the k-grid index, so Mosaic keeps the
+  block in VMEM across the sequential k steps (zeroed at ki == 0, flushed
+  when the batch·head index advances). Scores/probabilities are computed
+  once per (q, k) block pair instead of once in each of two kernels: 5
+  MXU matmuls per pair vs 7 for the split backward.
+  """
+  ki = pl.program_id(1)
+  q_base = qb_ref[0]
+  k_base = kb_ref[0]
+  k = k_ref[0].astype(jnp.float32)                  # [blk_k, D]
+  v = v_ref[0].astype(jnp.float32)
+  n_qblocks = q_len // blk_q
+
+  @pl.when(ki == 0)
+  def _zero_dq():  # noqa: ANN202 - pallas region
+    dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+  def body(qi, carry):
+    dk, dv = carry
+    q = q_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32) * scale
+    do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
+    delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    p, ds = _pair_p_ds(s, lse, delta, do, v)
+    dv_new = dv + p.T @ do
+    dk_new = dk + ds.T @ q                          # q pre-scaled: absorbs it
+    prev = dq_ref[0, pl.ds(qi * blk_q, blk_q), :]
+    dq_ref[0, pl.ds(qi * blk_q, blk_q), :] = prev + (ds @ k) * scale
+    return dk_new, dv_new
+
+  dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
+  dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
+  lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
+  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
+  dk_ref[0] = dk.astype(dk_ref.dtype)
   dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -260,10 +314,39 @@ def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret):
   return _unfold(out, b, h), lse[:, :, 0].reshape(b, h, s_q)
 
 
+def default_bwd_mode() -> str:
+  """Backward kernel selection: ``fused`` (single-pass, default) or
+  ``split`` (separate dQ and dK/dV kernels) via ``TFOS_TPU_FLASH_BWD``."""
+  import os
+  mode = os.environ.get("TFOS_TPU_FLASH_BWD", "fused")
+  if mode not in ("fused", "split"):
+    raise ValueError("TFOS_TPU_FLASH_BWD must be 'fused' or 'split', got %r"
+                     % (mode,))
+  return mode
+
+
+# The backward prefers different tiles than the forward (v5e fetch-timed
+# sweeps at b4 s4096 h8 d128): the fused single-pass kernel wants smaller
+# q-blocks — each (q,k) pair read-modify-writes a blk_q-row slice of the
+# resident dQ accumulator, and 128 rows keeps that RMW on the critical
+# path shorter — while the split kernels match the forward's (256, 512).
+# Resolved per-mode here; override with blk_bwd_q/blk_bwd_k.
+DEFAULT_BWD_BLOCKS = {"fused": (128, 512), "split": (256, 512)}
+
+
+def _resolve_bwd(bwd, blk_bwd_q, blk_bwd_k):
+  bwd = bwd or default_bwd_mode()
+  if bwd not in DEFAULT_BWD_BLOCKS:
+    raise ValueError("bwd must be 'fused' or 'split', got %r" % (bwd,))
+  dq_blk, dk_blk = DEFAULT_BWD_BLOCKS[bwd]
+  return (bwd, dq_blk if blk_bwd_q is None else blk_bwd_q,
+          dk_blk if blk_bwd_k is None else blk_bwd_k)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
-                                             "interpret"))
+                                             "interpret", "bwd"))
 def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
-              blk_k, interpret):
+              blk_k, interpret, bwd="fused"):
   b, s_q, h, d = q.shape
   s_kv = k.shape[1]
   blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
@@ -283,6 +366,37 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
 
   full3 = lambda i, j, *_: (i, 0, 0)      # noqa: E731
   row3 = lambda i, j, *_: (i, j, 0)       # noqa: E731
+
+  if bwd == "fused":
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_attn_bwd_fused_kernel, blk_q=blk_q, blk_k=blk_k,
+                          q_len=s_q, causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * h, s_kv // blk_k),
+            in_specs=[
+                pl.BlockSpec((1, s_q, d), full3),
+                pl.BlockSpec((1, blk_k, d), row3),
+                pl.BlockSpec((1, blk_k, d), row3),
+                pl.BlockSpec((1, s_q, d), full3),
+                pl.BlockSpec((1, s_q, LANES), full3),
+                pl.BlockSpec((1, s_q, LANES), full3),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, s_q, d), full3),   # dQ: resident across ki
+                pl.BlockSpec((1, blk_k, d), row3),
+                pl.BlockSpec((1, blk_k, d), row3),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qb, kb, qf, kf, vf, gf, lse_f, delta)
+    return (_unfold(dq, b, h).astype(q.dtype), _unfold(dk, b, h),
+            _unfold(dv, b, h))
 
   dq = pl.pallas_call(
       functools.partial(_attn_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
@@ -337,27 +451,37 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
 
 
 def flash_attention(q, k, v, causal: bool = True, blk_q: int = 256,
-                    blk_k: int = 512, interpret: bool = False):
+                    blk_k: int = 512, interpret: bool = False,
+                    bwd: str = None, blk_bwd_q: int = None,
+                    blk_bwd_k: int = None):
   """Fused (self-)attention with fused backward. q/k/v: [batch, seq,
-  heads, head_dim]; seq must divide by the (clamped) block sizes."""
-  return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret)
+  heads, head_dim]; seq must divide by the (clamped) block sizes.
+  ``bwd``: 'fused' (single-pass dQ/dK/dV) or 'split' (two kernels);
+  defaults to :func:`default_bwd_mode`. The backward uses its own block
+  sizes (``DEFAULT_BWD_BLOCKS`` per mode unless overridden)."""
+  bwd, blk_bwd_q, blk_bwd_k = _resolve_bwd(bwd, blk_bwd_q, blk_bwd_k)
+  return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret, bwd,
+                    blk_bwd_q, blk_bwd_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret, bwd, blk_bwd_q,
+               blk_bwd_k):
   out, _ = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret)
   return out
 
 
-def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, bwd, blk_bwd_q,
+               blk_bwd_k):
   out, lse = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret)
   return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, blk_q, blk_k, interpret, residuals, g):
+def _flash_bwd(causal, blk_q, blk_k, interpret, bwd, blk_bwd_q, blk_bwd_k,
+               residuals, g):
   q, k, v, out, lse = residuals
-  return _bwd_impl(q, k, v, out, lse, g, None, 0, 0, causal, blk_q, blk_k,
-                   interpret)
+  return _bwd_impl(q, k, v, out, lse, g, None, 0, 0, causal, blk_bwd_q,
+                   blk_bwd_k, interpret, bwd)
 
 
 _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
@@ -368,7 +492,8 @@ _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
                           blk_q: int = 256, blk_k: int = 512,
-                          interpret: bool = False):
+                          interpret: bool = False, bwd: str = None,
+                          blk_bwd_q: int = None, blk_bwd_k: int = None):
   """Partial attention of local queries against ONE KV block.
 
   q: [B, Sq, H, D] at absolute positions ``q_base + arange(Sq)``;
@@ -378,29 +503,31 @@ def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
   with :func:`merge_partials`. Differentiable in q/k/v (including through
   the lse output).
   """
+  bwd, blk_bwd_q, blk_bwd_k = _resolve_bwd(bwd, blk_bwd_q, blk_bwd_k)
   return _flash_block_vjp(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                          interpret)
+                          interpret, bwd, blk_bwd_q, blk_bwd_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash_block_vjp(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                     interpret):
+                     interpret, bwd, blk_bwd_q, blk_bwd_k):
   return _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
                    interpret)
 
 
 def _flash_block_fwd(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                     interpret):
+                     interpret, bwd, blk_bwd_q, blk_bwd_k):
   out, lse = _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
                        interpret)
   return (out, lse), (q, k, v, out, lse, q_base, kv_base)
 
 
-def _flash_block_bwd(causal, blk_q, blk_k, interpret, residuals, cotangents):
+def _flash_block_bwd(causal, blk_q, blk_k, interpret, bwd, blk_bwd_q,
+                     blk_bwd_k, residuals, cotangents):
   q, k, v, out, lse, q_base, kv_base = residuals
   g, g_lse = cotangents
   dq, dk, dv = _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base,
-                         causal, blk_q, blk_k, interpret)
+                         causal, blk_bwd_q, blk_bwd_k, interpret, bwd)
   zero_base = np.zeros((), jax.dtypes.float0)
   return dq, dk, dv, zero_base, zero_base
 

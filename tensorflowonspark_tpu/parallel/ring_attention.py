@@ -89,7 +89,8 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
 
 
 def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
-                      blk_k: int, interpret: bool):
+                      blk_k: int, interpret: bool, blk_bwd_q=None,
+                      blk_bwd_k=None):
   """shard_map body: ring attention with Pallas flash-attention blocks.
 
   Each ring step computes the partial attention of the local queries
@@ -115,7 +116,8 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
     src = (my - step) % n
     o_j, lse_j = flash_attention_block(
         q, k_blk, v_blk, my * s_local, src * s_local, causal=causal,
-        blk_q=blk_q, blk_k=blk_k, interpret=interpret)
+        blk_q=blk_q, blk_k=blk_k, interpret=interpret,
+        blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k)
     o, lse = merge_partials(o, lse, o_j.astype(jnp.float32), lse_j)
     perm = [(i, (i + 1) % n) for i in range(n)]
     k_blk = lax.ppermute(k_blk, axis_name, perm)
@@ -130,7 +132,8 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
                    axis_name: str = mesh_lib.AXIS_SEQUENCE,
                    batch_axes=None, use_flash: bool = False,
                    blk_q: int = 256, blk_k: int = 512,
-                   interpret: bool = False):
+                   interpret: bool = False, blk_bwd_q: int = None,
+                   blk_bwd_k: int = None):
   """Exact full attention over a sequence sharded across ``axis_name``.
 
   Args:
@@ -141,6 +144,8 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
     use_flash: compute each ring step's block with the fused Pallas kernel
       (ops.flash_attention_block) instead of dense block math — the
       memory-optimal path on TPU (``interpret=True`` for CPU tests).
+      ``blk_q``/``blk_k`` tile the forward; ``blk_bwd_q``/``blk_bwd_k``
+      tile the backward (None = per-mode DEFAULT_BWD_BLOCKS).
 
   Returns attention output with the same sharding as ``q``.
   """
@@ -153,6 +158,7 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
   if use_flash:
     fn = functools.partial(_ring_flash_local, axis_name=axis_name,
                            causal=causal, blk_q=blk_q, blk_k=blk_k,
+                           blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k,
                            interpret=interpret)
   else:
     fn = functools.partial(_ring_attn_local, axis_name=axis_name,

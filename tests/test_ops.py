@@ -137,6 +137,29 @@ class TestFlashAttention:
                                np.asarray(ref, np.float32),
                                atol=3e-2, rtol=3e-2)
 
+  @pytest.mark.parametrize("mode", ["fused", "split"])
+  def test_backward_modes_match_dense(self, mode):
+    """Both backward plans — fused single-pass (default) and split
+    two-kernel (TFOS_TPU_FLASH_BWD=split fallback) — produce dense-XLA
+    gradients for q, k and v."""
+    rng = np.random.RandomState(3)
+    B, S, H, D = 2, 128, 4, 32
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+               for _ in range(3))
+    t = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    for causal in (True, False):
+      ref = jax.grad(
+          lambda q, k, v: jnp.sum(t * ra.full_attention(
+              q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+      got = jax.grad(
+          lambda q, k, v: jnp.sum(t * flash_attention(
+              q, k, v, causal=causal, blk_q=32, blk_k=32,
+              blk_bwd_q=32, blk_bwd_k=32,
+              interpret=True, bwd=mode)), argnums=(0, 1, 2))(q, k, v)
+      for a, b in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
   def test_indivisible_seq_shrinks_blocks(self):
     # 100 doesn't divide by 32: blocks shrink to the largest divisor (25)
     # instead of asserting, and the result still matches dense attention

@@ -90,34 +90,44 @@ def check_flash(results, shapes, dtype_name):
                           error=repr(e)[:400]))
       continue
 
-    # backward
-    name = name.replace("fwd", "bwd")
+    # backward — both kernel plans (fused single-pass is the default;
+    # split two-kernel is the fallback behind TFOS_TPU_FLASH_BWD)
+    base = name.replace("fwd", "bwd")
+    # the dense reference gradient is mode-independent: compute/time once
     try:
-      loss_f = jax.jit(jax.grad(
-          lambda q, k, v: jnp.sum(
-              fa.flash_attention(q, k, v, causal=causal)
-              .astype(jnp.float32) * g.astype(jnp.float32)),
-          argnums=(0, 1, 2)))
       loss_d = jax.jit(jax.grad(
           lambda q, k, v: jnp.sum(
               _dense_attn(q, k, v, causal)
               .astype(jnp.float32) * g.astype(jnp.float32)),
           argnums=(0, 1, 2)))
       with prec:
-        gf = loss_f(q, k, v)
         gd = loss_d(q, k, v)
-      err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
-                                      b_.astype(jnp.float32))))
-                for a, b_ in zip(gf, gd))
-      tol = 1e-1 if dtype_name == "bf16" else 1e-3
-      t_f = _timeit(loss_f, q, k, v)
       t_d = _timeit(loss_d, q, k, v)
-      results.append(dict(kernel=name, ok=err < tol, max_err=err,
-                          flash_ms=round(t_f * 1e3, 3),
-                          dense_ms=round(t_d * 1e3, 3),
-                          speedup=round(t_d / t_f, 2)))
     except Exception as e:  # noqa: BLE001
-      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+      results.append(dict(kernel=base + "{dense-ref}", ok=False,
+                          error=repr(e)[:400]))
+      continue
+    for bwd_mode in ("fused", "split"):
+      name = "%s{%s}" % (base, bwd_mode)
+      try:
+        loss_f = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                fa.flash_attention(q, k, v, causal=causal, bwd=bwd_mode)
+                .astype(jnp.float32) * g.astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        with prec:
+          gf = loss_f(q, k, v)
+        err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                        b_.astype(jnp.float32))))
+                  for a, b_ in zip(gf, gd))
+        tol = 1e-1 if dtype_name == "bf16" else 1e-3
+        t_f = _timeit(loss_f, q, k, v)
+        results.append(dict(kernel=name, ok=err < tol, max_err=err,
+                            flash_ms=round(t_f * 1e3, 3),
+                            dense_ms=round(t_d * 1e3, 3),
+                            speedup=round(t_d / t_f, 2)))
+      except Exception as e:  # noqa: BLE001
+        results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
 
 def check_flash_block(results):
