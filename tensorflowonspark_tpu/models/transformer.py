@@ -46,6 +46,10 @@ class TransformerConfig:
   # K/V are projected to this many heads and the per-layer KV cache stores
   # only them — a num_heads/num_kv_heads reduction in serving cache memory
   num_kv_heads: int = 0
+  # Project Q, K and V with ONE matmul (heads axis = num_heads + 2·kv_heads,
+  # sliced after): one bigger MXU op instead of three smaller ones. Changes
+  # the parameter tree ("qkv" instead of "q"/"k"/"v")
+  fuse_qkv: bool = False
   # "auto": fused Pallas LayerNorm (ops.layer_norm) on TPU, flax elsewhere;
   # "fused" forces the kernel everywhere (interpret mode off-TPU — how CPU
   # CI exercises the production code path); "flax" opts out
@@ -71,6 +75,9 @@ class TransformerConfig:
     if self.layer_norm_impl not in ("auto", "fused", "flax"):
       raise ValueError("layer_norm_impl must be 'auto', 'fused' or 'flax', "
                        "got %r" % (self.layer_norm_impl,))
+    if self.num_kv_heads < 0:
+      raise ValueError("num_kv_heads must be >= 0, got %d"
+                       % (self.num_kv_heads,))
     if self.num_kv_heads and self.num_heads % self.num_kv_heads != 0:
       raise ValueError("num_kv_heads (%d) must divide num_heads (%d)"
                        % (self.num_kv_heads, self.num_heads))
@@ -187,13 +194,31 @@ class Attention(nn.Module):
         feats, axis=-1, dtype=cfg.dtype, use_bias=False, name=name,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.lecun_normal(), logical))
-    q = dense((cfg.num_heads, cfg.head_dim),
-              ("embed", "heads", "kv"), "q")(x)
-    # GQA: K/V carry only kv_heads heads (= num_heads unless configured)
-    k = dense((cfg.kv_heads, cfg.head_dim),
-              ("embed", "heads", "kv"), "k")(x)
-    v = dense((cfg.kv_heads, cfg.head_dim),
-              ("embed", "heads", "kv"), "v")(x)
+    def heads_axis(n_heads):
+      # the "heads" logical axis maps to the tensor-parallel mesh axis;
+      # a head count the axis can't divide (grouped KV heads, or the
+      # fused h+2·hk projection) must fall back to replication or state
+      # init fails on the divisibility check
+      t = 1 if self.mesh is None else \
+          self.mesh.shape.get(mesh_lib.AXIS_TENSOR, 1)
+      return "heads" if n_heads % max(1, t) == 0 else None
+
+    if cfg.fuse_qkv:
+      # one MXU matmul for all three projections, sliced on the heads axis
+      h, hk = cfg.num_heads, cfg.kv_heads
+      qkv = dense((h + 2 * hk, cfg.head_dim),
+                  ("embed", heads_axis(h + 2 * hk), "kv"), "qkv")(x)
+      q = qkv[..., :h, :]
+      k = qkv[..., h:h + hk, :]
+      v = qkv[..., h + hk:, :]
+    else:
+      q = dense((cfg.num_heads, cfg.head_dim),
+                ("embed", heads_axis(cfg.num_heads), "kv"), "q")(x)
+      # GQA: K/V carry only kv_heads heads (= num_heads unless configured)
+      k = dense((cfg.kv_heads, cfg.head_dim),
+                ("embed", heads_axis(cfg.kv_heads), "kv"), "k")(x)
+      v = dense((cfg.kv_heads, cfg.head_dim),
+                ("embed", heads_axis(cfg.kv_heads), "kv"), "v")(x)
 
     if decode:
       return self._decode_attend(q, k, v)

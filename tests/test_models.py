@@ -378,6 +378,39 @@ class TestTransformer:
     kv = tfm.greedy_generate_kv(state.params, cfg, prompt, num_steps=8)
     np.testing.assert_array_equal(np.asarray(kv), np.asarray(full))
 
+  def test_fused_qkv_trains_and_decodes(self):
+    """fuse_qkv=True (one projection matmul, sliced) must train to the
+    cycle task and keep the KV-cache decode agreeing with recompute,
+    composed with GQA."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=16, num_layers=2, num_heads=4,
+                                num_kv_heads=2, d_model=64, d_ff=128,
+                                max_seq_len=32, remat=False, fuse_qkv=True)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg,
+                             learning_rate=3e-3, seq_len=24)
+    assert any("qkv" in "/".join(map(str, p))
+               for p, _ in jax.tree_util.tree_flatten_with_path(
+                   state.params)[0])
+    cycle = np.tile(np.arange(8), 10)
+    tokens = jnp.asarray(np.stack([cycle[i:i + 24] for i in range(8)]),
+                         jnp.int32)
+
+    @jax.jit
+    def step(state, tokens):
+      def loss_fn(p):
+        return tfm.causal_lm_loss(
+            state.apply_fn({"params": p}, tokens), tokens)
+      loss, grads = jax.value_and_grad(loss_fn)(state.params)
+      return state.apply_gradients(grads=grads), loss
+
+    for _ in range(150):
+      state, loss = step(state, tokens)
+    assert float(loss) < 0.1, float(loss)
+    prompt = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    full = tfm.greedy_generate(state.params, cfg, prompt, num_steps=8)
+    kv = tfm.greedy_generate_kv(state.params, cfg, prompt, num_steps=8)
+    np.testing.assert_array_equal(np.asarray(kv), np.asarray(full))
+
   def test_blocked_loss_matches_full(self):
     """causal_lm_loss_blocked (fused projection+xent, [B,chunk,V] peak
     memory) matches causal_lm_loss exactly in f32, including value AND

@@ -376,6 +376,31 @@ class TestExpertParallel:
 
 
 class TestShardedTrainStep:
+  def test_gqa_kv_heads_replicate_when_indivisible(self, devices):
+    """GQA K/V projections whose head count the tensor axis can't divide
+    fall back to replication instead of failing state init (kv_heads=2
+    on tensor=4); the model still initializes sharded and takes a step."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+
+    mesh = M.build_mesh(M.MeshSpec(data=2, tensor=4), devices=devices)
+    cfg = tfm.TransformerConfig(vocab_size=32, num_layers=2, num_heads=8,
+                                num_kv_heads=2, d_model=32, d_ff=64,
+                                max_seq_len=16, remat=False,
+                                dtype=jnp.float32)
+    state, sharding = tfm.create_sharded_state(jax.random.PRNGKey(0), cfg,
+                                               mesh, seq_len=16)
+
+    def loss_fn(params, tokens):
+      return tfm.causal_lm_loss(
+          state.apply_fn({"params": params}, tokens), tokens)
+
+    step = SH.make_train_step(loss_fn, mesh, sharding)
+    rng = np.random.RandomState(0)
+    tokens = SH.shard_batch(
+        jnp.asarray(rng.randint(0, 32, (4, 16)), jnp.int32), mesh)
+    state, loss = step(state, tokens)
+    assert np.isfinite(float(loss))
+
   def test_transformer_trains_sharded(self, devices):
     """Full dp+sp+tp train loop: loss must decrease on a tiny corpus."""
     from tensorflowonspark_tpu.models import transformer as tfm
