@@ -655,8 +655,12 @@ def causal_lm_loss_blocked(hidden, table, tokens, chunk: int = 256):
 
 
 def _init_fns(rng, cfg: TransformerConfig, mesh, learning_rate, seq_len,
-              init_batch: int = 1):
-  """(params_init_fn, make_state_fn) pair for parallel.sharding init."""
+              init_batch: int = 1, tx=None):
+  """(params_init_fn, make_state_fn) pair for parallel.sharding init.
+
+  ``tx``: any optax GradientTransformation (see :mod:`optim` for the
+  schedule/clipping recipe builder); defaults to plain AdamW at
+  ``learning_rate``."""
   import optax
   from flax.training import train_state
 
@@ -667,23 +671,26 @@ def _init_fns(rng, cfg: TransformerConfig, mesh, learning_rate, seq_len,
     return model.init(rng, tokens)["params"]  # Partitioned-boxed
 
   def make_state(params):
-    tx = optax.adamw(learning_rate, weight_decay=0.01)
+    opt = tx if tx is not None else         optax.adamw(learning_rate, weight_decay=0.01)
     return train_state.TrainState.create(apply_fn=model.apply,
-                                         params=params, tx=tx)
+                                         params=params, tx=opt)
 
   return params_init, make_state
 
 
 def create_state(rng, cfg: TransformerConfig,
-                 learning_rate: float = 3e-4, seq_len: int = 128):
+                 learning_rate: float = 3e-4, seq_len: int = 128,
+                 tx=None):
   """Single-device TrainState (params unboxed, unsharded)."""
   from flax.core import meta
-  params_init, make_state = _init_fns(rng, cfg, None, learning_rate, seq_len)
+  params_init, make_state = _init_fns(rng, cfg, None, learning_rate,
+                                      seq_len, tx=tx)
   return make_state(meta.unbox(params_init()))
 
 
 def create_sharded_state(rng, cfg: TransformerConfig, mesh,
-                         learning_rate: float = 3e-4, seq_len: int = 128):
+                         learning_rate: float = 3e-4, seq_len: int = 128,
+                         tx=None):
   """TrainState initialized directly onto the mesh (TP/FSDP layouts applied
   at init — large models never materialize replicated).
 
@@ -695,5 +702,5 @@ def create_sharded_state(rng, cfg: TransformerConfig, mesh,
   init_batch = mesh_lib.axis_size(mesh, mesh_lib.AXIS_DATA,
                                   mesh_lib.AXIS_FSDP)
   params_init, make_state = _init_fns(rng, cfg, mesh, learning_rate, seq_len,
-                                      init_batch=init_batch)
+                                      init_batch=init_batch, tx=tx)
   return sh.init_sharded_state(params_init, make_state, mesh)

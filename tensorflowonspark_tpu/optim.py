@@ -1,0 +1,72 @@
+"""Optimizer construction: schedules + clipping as first-class config.
+
+The reference delegated all optimization to TF user code (its examples
+hand-build Keras optimizers, e.g. reference examples/mnist/keras/
+mnist_spark.py); a standalone training framework should offer the
+standard LLM recipe — AdamW with linear warmup into cosine (or linear)
+decay and global-norm gradient clipping — as one call. Returns plain
+optax transforms, so anything accepting an optax ``GradientTransformation``
+(``transformer.create_state(tx=...)``, flax TrainState) composes.
+"""
+
+from typing import Optional
+
+SCHEDULES = ("constant", "cosine", "linear")
+
+
+def make_schedule(learning_rate: float, schedule: str = "constant",
+                  warmup_steps: int = 0, decay_steps: int = 0,
+                  end_value: float = 0.0):
+  """An optax schedule: optional linear warmup from 0, then the decay.
+
+  ``decay_steps`` counts AFTER warmup; required for cosine/linear.
+  """
+  import optax
+
+  if schedule not in SCHEDULES:
+    raise ValueError("schedule must be one of %s, got %r"
+                     % (SCHEDULES, schedule))
+  if schedule == "constant":
+    base = optax.constant_schedule(learning_rate)
+  else:
+    if decay_steps <= 0:
+      raise ValueError("decay_steps must be > 0 for %r" % (schedule,))
+    if schedule == "cosine":
+      base = optax.cosine_decay_schedule(learning_rate, decay_steps,
+                                         alpha=end_value / learning_rate
+                                         if learning_rate else 0.0)
+    else:
+      base = optax.linear_schedule(learning_rate, end_value, decay_steps)
+  if warmup_steps > 0:
+    warmup = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+    return optax.join_schedules([warmup, base], [warmup_steps])
+  return base
+
+
+def make_optimizer(learning_rate: float = 3e-4,
+                   weight_decay: float = 0.01,
+                   schedule: str = "constant",
+                   warmup_steps: int = 0,
+                   decay_steps: int = 0,
+                   end_value: float = 0.0,
+                   clip_norm: float = 0.0,
+                   b1: float = 0.9, b2: float = 0.95,
+                   tx_extra: Optional[object] = None):
+  """AdamW with the standard training recipe.
+
+  ``clip_norm`` > 0 prepends global-norm gradient clipping; ``tx_extra``
+  (an optax transform) is chained last, e.g. ``optax.ema`` or a custom
+  accumulator.
+  """
+  import optax
+
+  sched = make_schedule(learning_rate, schedule, warmup_steps, decay_steps,
+                        end_value)
+  parts = []
+  if clip_norm and clip_norm > 0:
+    parts.append(optax.clip_by_global_norm(clip_norm))
+  parts.append(optax.adamw(sched, b1=b1, b2=b2,
+                           weight_decay=weight_decay))
+  if tx_extra is not None:
+    parts.append(tx_extra)
+  return optax.chain(*parts) if len(parts) > 1 else parts[0]
