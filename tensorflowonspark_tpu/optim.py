@@ -43,6 +43,17 @@ def make_schedule(learning_rate: float, schedule: str = "constant",
   return base
 
 
+def default_decay_mask(params):
+  """True for params that should receive weight decay: matrices and
+  larger (kernels, embedding tables); False for vectors/scalars
+  (LayerNorm scales/offsets, biases) — the standard LLM recipe.
+  """
+  import jax
+
+  return jax.tree_util.tree_map(lambda p: getattr(p, "ndim", 0) >= 2,
+                                params)
+
+
 def make_optimizer(learning_rate: float = 3e-4,
                    weight_decay: float = 0.01,
                    schedule: str = "constant",
@@ -51,22 +62,28 @@ def make_optimizer(learning_rate: float = 3e-4,
                    end_value: float = 0.0,
                    clip_norm: float = 0.0,
                    b1: float = 0.9, b2: float = 0.95,
+                   decay_mask="auto",
                    tx_extra: Optional[object] = None):
   """AdamW with the standard training recipe.
 
   ``clip_norm`` > 0 prepends global-norm gradient clipping; ``tx_extra``
   (an optax transform) is chained last, e.g. ``optax.ema`` or a custom
-  accumulator.
+  accumulator. ``decay_mask`` controls which params get weight decay:
+  ``"auto"`` (default) decays only ndim>=2 params (kernels/embeddings,
+  not norms/biases), ``None`` decays everything, or pass an explicit
+  optax-style mask (pytree of bools or callable).
   """
   import optax
 
   sched = make_schedule(learning_rate, schedule, warmup_steps, decay_steps,
                         end_value)
+  if decay_mask == "auto":
+    decay_mask = default_decay_mask if weight_decay else None
   parts = []
   if clip_norm and clip_norm > 0:
     parts.append(optax.clip_by_global_norm(clip_norm))
   parts.append(optax.adamw(sched, b1=b1, b2=b2,
-                           weight_decay=weight_decay))
+                           weight_decay=weight_decay, mask=decay_mask))
   if tx_extra is not None:
     parts.append(tx_extra)
   return optax.chain(*parts) if len(parts) > 1 else parts[0]

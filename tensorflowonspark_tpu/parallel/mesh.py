@@ -53,6 +53,58 @@ class MeshSpec:
             AXIS_SEQUENCE: self.sequence, AXIS_TENSOR: self.tensor}
 
 
+def _topology_mesh_devices(devices, shape, names):
+  """Topology-aware device assignment via ``jax.experimental.mesh_utils``.
+
+  On TPU, device enumeration order does NOT track ICI adjacency — a plain
+  ``reshape`` can land the innermost (tensor) axis on non-neighboring chips.
+  ``create_device_mesh`` permutes devices using their physical ``coords`` so
+  inner mesh axes ride the fastest ICI loops; on multi-slice topologies
+  ``create_hybrid_device_mesh`` keeps exactly one axis (the outermost one
+  whose degree the slice count divides — ``data`` first in canonical order)
+  across the DCN boundary and everything else inside a slice.
+
+  Returns the device ndarray, or None when not applicable (non-TPU devices,
+  or no axis can absorb the slice count) — callers fall back to enumeration
+  order, which is correct for CPU/virtual meshes.
+  """
+  if not devices or getattr(devices[0], "platform", "") != "tpu":
+    return None
+  from jax.experimental import mesh_utils
+
+  try:
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices > 1:
+      # only gradient-sync / stage-boundary axes tolerate DCN latency;
+      # tensor/sequence/expert collectives are per-layer and must stay on ICI
+      dcn_ok = (AXIS_DATA, AXIS_FSDP, AXIS_PIPELINE)
+      dcn_shape, per_slice_shape = [], []
+      carried = False
+      for name, deg in zip(names, shape):
+        if (not carried and name in dcn_ok and deg >= n_slices
+            and deg % n_slices == 0):
+          dcn_shape.append(n_slices)
+          per_slice_shape.append(deg // n_slices)
+          carried = True
+        else:
+          dcn_shape.append(1)
+          per_slice_shape.append(deg)
+      if not carried:
+        logger.warning(
+            "no mesh axis in %s can absorb %d slices; falling back to "
+            "enumeration order (cross-slice collectives will ride DCN "
+            "suboptimally)", dict(zip(names, shape)), n_slices)
+        return None
+      return mesh_utils.create_hybrid_device_mesh(
+          per_slice_shape, dcn_shape, devices=devices)
+    return mesh_utils.create_device_mesh(shape, devices=devices)
+  except Exception as e:  # noqa: BLE001 - mesh_utils topology tables vary
+    # by generation; an unrecognized topology must not break mesh bring-up
+    logger.warning("topology-aware mesh construction failed (%s); "
+                   "falling back to enumeration order", e)
+    return None
+
+
 def build_mesh(spec: Optional[MeshSpec] = None,
                devices: Optional[Sequence] = None,
                axis_names: Optional[Sequence[str]] = None):
@@ -61,6 +113,10 @@ def build_mesh(spec: Optional[MeshSpec] = None,
   Exactly one axis may be -1; it absorbs whatever device count remains after
   the explicit axes divide in. Axes of degree 1 are kept in the mesh so
   sharding rules can always reference every canonical axis.
+
+  On TPU the device layout is topology-aware (see
+  :func:`_topology_mesh_devices`); elsewhere devices fill the mesh in
+  enumeration order.
   """
   import jax
   from jax.sharding import Mesh
@@ -86,7 +142,9 @@ def build_mesh(spec: Optional[MeshSpec] = None,
 
   names = tuple(axis_names or CANONICAL_ORDER)
   shape = tuple(degrees[a] for a in names)
-  mesh_devices = np.asarray(devices).reshape(shape)
+  mesh_devices = _topology_mesh_devices(devices, shape, names)
+  if mesh_devices is None:
+    mesh_devices = np.asarray(devices).reshape(shape)
   mesh = Mesh(mesh_devices, names)
   logger.info("built mesh %s over %d device(s)",
               dict(zip(names, shape)), n)

@@ -54,6 +54,77 @@ class TestMesh:
     assert M.data_axes(mesh) == (M.AXIS_DATA, M.AXIS_FSDP)
 
 
+class _FakeTPU:
+  """Mock device carrying the attributes mesh_utils inspects."""
+  platform = "tpu"
+  device_kind = "TPU v5e"
+
+  def __init__(self, i, coords, slice_index=0, process_index=0):
+    self.id = i
+    self.coords = coords
+    self.core_on_chip = 0
+    self.process_index = process_index
+    self.slice_index = slice_index
+
+  def __repr__(self):
+    return "FakeTPU(%d, %r, slice=%d)" % (self.id, self.coords,
+                                          self.slice_index)
+
+
+class TestTopologyMesh:
+  """build_mesh must honor physical topology on TPU (VERDICT r2 item 3):
+  the tensor axis lands on ICI neighbors even when jax.devices() enumerates
+  chips out of physical order."""
+
+  def _scrambled_grid(self):
+    coords = [(x, y, 0) for y in range(2) for x in range(4)]
+    order = [0, 3, 1, 2, 7, 4, 6, 5]
+    return [_FakeTPU(i, coords[order[i]]) for i in range(8)]
+
+  def test_tensor_axis_lands_on_neighbors(self):
+    mesh = M.build_mesh(M.MeshSpec(data=-1, tensor=4),
+                        devices=self._scrambled_grid())
+    arr = np.asarray(mesh.devices).reshape(2, 4)
+    for row in arr:
+      xs = sorted(d.coords[0] for d in row)
+      ys = {d.coords[1] for d in row}
+      assert xs == [0, 1, 2, 3], "tensor axis straddles the grid: %r" % row
+      assert len(ys) == 1, "tensor axis crosses rows: %r" % row
+
+  def test_hybrid_mesh_puts_data_on_dcn(self):
+    """Two slices: the data axis absorbs the slice count; every
+    non-data axis stays inside one slice (ICI), per SURVEY §2.4."""
+    devs = []
+    for s in range(2):
+      for i in range(4):
+        devs.append(_FakeTPU(s * 4 + i, (i % 2, i // 2, 0), slice_index=s,
+                             process_index=s))
+    mesh = M.build_mesh(M.MeshSpec(data=2, tensor=4), devices=devs)
+    arr = np.asarray(mesh.devices).reshape(2, 4)
+    for data_idx in range(2):
+      slices = {d.slice_index for d in arr[data_idx]}
+      assert len(slices) == 1, \
+          "tensor axis crosses the DCN boundary: %r" % arr[data_idx]
+
+  def test_cpu_devices_fall_back_to_enumeration(self, devices):
+    mesh = M.build_mesh(M.MeshSpec(data=-1), devices=devices)
+    assert list(np.asarray(mesh.devices).ravel()) == list(devices)
+
+  def test_unabsorbable_slice_count_falls_back(self, caplog):
+    """3 slices over axes of degree 2/4: no axis absorbs 3 — warn and
+    keep enumeration order rather than fail bring-up."""
+    devs = []
+    for s in range(3):
+      for i in range(2):
+        devs.append(_FakeTPU(s * 2 + i, (i, 0, 0), slice_index=s))
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.parallel.mesh"):
+      mesh = M.build_mesh(M.MeshSpec(data=2, tensor=3), devices=devs)
+    assert "falling back to enumeration order" in caplog.text
+    assert list(np.asarray(mesh.devices).ravel()) == devs
+
+
 class TestCollectives:
   def test_psum_and_ring_permute(self, devices):
     mesh = M.build_mesh(M.MeshSpec(data=8), devices=devices)
