@@ -47,6 +47,14 @@ class InputMode(object):
   SPARK = 1
 
 
+class _StreamFeedHandle(object):
+  """Progress of a hooked (D)Stream feed: micro-batches fed + stop flag."""
+
+  def __init__(self):
+    self.rounds = 0
+    self.stopped = False
+
+
 class TPUCluster(object):
   """Handle for a started cluster (parity: TFCluster.py:49-212)."""
 
@@ -67,12 +75,20 @@ class TPUCluster(object):
   # -- data plane ------------------------------------------------------------
 
   def train(self, data_partitions: Sequence, num_epochs: int = 0,
-            feed_timeout: float = 600, qname: str = "input") -> None:
+            feed_timeout: float = 600, qname: str = "input"):
     """Feed partitioned data to the cluster (ENGINE input mode only).
 
     Epochs are implemented by replicating the dataset ``num_epochs`` times
     (parity with epochs-via-RDD.union, reference TFCluster.py:90-94).
+    Returns None for bounded data; a DStream argument returns the stream
+    feed handle from :meth:`train_dstream`.
     """
+    if hasattr(data_partitions, "foreachRDD"):
+      # a Spark DStream handed straight to train(), exactly as the
+      # reference accepted (TFCluster.py:83-85); the handle exposes
+      # rounds-fed / stop-observed progress
+      return self.train_dstream(data_partitions, feed_timeout=feed_timeout,
+                                qname=qname)
     logger.info("feeding training data")
     assert self.input_mode == InputMode.ENGINE, \
         "train() requires InputMode.ENGINE/SPARK"
@@ -108,6 +124,61 @@ class TPUCluster(object):
                     rounds)
         break
     return rounds
+
+  def train_dstream(self, dstream, feed_timeout: float = 600,
+                    qname: str = "input"):
+    """Hook a Spark (D)Stream so every micro-batch RDD is fed as one round
+    (parity: reference TFCluster.train wiring ``dataRDD.foreachRDD(_train)``,
+    TFCluster.py:83-85).
+
+    Feeding happens on Spark's streaming driver thread as batches arrive.
+    After a graceful stop request (``request_stop()``, or a remote
+    ``rendezvous.Client(addr).request_stop()`` — parity with
+    examples/utils/stop_streaming.py) later micro-batches are skipped
+    without being consumed, so the streaming job can be stopped and
+    ``shutdown()`` called. Returns a handle whose ``rounds`` attribute
+    counts the micro-batches fed so far and whose ``stopped`` flag reports
+    whether the stop signal has been observed.
+    """
+    assert self.input_mode == InputMode.ENGINE, \
+        "train_dstream() requires InputMode.ENGINE/SPARK"
+    fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
+                                feed_timeout=feed_timeout, qname=qname)
+    handle = _StreamFeedHandle()
+
+    def _feed(rdd):
+      if self.server.done.is_set():
+        if not handle.stopped:
+          logger.info("stop signal received; skipping further micro-batches "
+                      "after %d rounds", handle.rounds)
+        handle.stopped = True
+        return
+      self.engine.foreach_partition(rdd, fn).wait()
+      handle.rounds += 1
+
+    dstream.foreachRDD(_feed)
+    return handle
+
+  def foreach_batch(self, feed_timeout: float = 600, qname: str = "input"):
+    """A ``(batch_df, batch_id) -> None`` callback for Structured Streaming:
+    ``query = df.writeStream.foreachBatch(cluster.foreach_batch()).start()``.
+
+    The modern equivalent of the DStream hook above: each micro-batch
+    DataFrame is fed as one round; after a stop request batches are
+    skipped. The reference predates Structured Streaming — this is the
+    same capability on the current Spark API.
+    """
+    assert self.input_mode == InputMode.ENGINE, \
+        "foreach_batch() requires InputMode.ENGINE/SPARK"
+    fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
+                                feed_timeout=feed_timeout, qname=qname)
+
+    def _feed(batch_df, batch_id):
+      if self.server.done.is_set():
+        return
+      self.engine.foreach_partition(batch_df, fn).wait()
+
+    return _feed
 
   def request_stop(self) -> None:
     """Signal streaming feeds to stop after the current round."""
@@ -227,7 +298,21 @@ class TPUCluster(object):
     return None
 
   @staticmethod
-  def _replicate(parts: Sequence, epochs: int) -> List:
+  def _replicate(parts: Sequence, epochs: int):
+    """Repeat the dataset ``epochs`` times without touching its rows.
+
+    Engine-native handles (an RDD, or a DataFrame wrapping one) replicate
+    via ``union`` — the reference's epochs idiom (``sc.union([rdd]*N)``,
+    TFCluster.py:90-94) — so the driver never iterates cluster data.
+    Driver-side partition lists are simply concatenated.
+    """
+    if hasattr(parts, "rdd"):           # DataFrame → its RDD
+      parts = parts.rdd
+    if hasattr(parts, "mapPartitions"):  # RDD-like: epochs via union
+      out = parts
+      for _ in range(epochs - 1):
+        out = out.union(parts)
+      return out
     out = []
     for _ in range(epochs):
       out.extend(parts)

@@ -26,8 +26,13 @@ logger = logging.getLogger(__name__)
 _loaded_paths: Dict[str, Schema] = {}
 
 
+def _path_key(path: str) -> str:
+  from tensorflowonspark_tpu.data import fs
+  return path if fs.is_remote(path) else os.path.abspath(path)
+
+
 def is_loaded_path(path: str) -> bool:
-  return os.path.abspath(path) in _loaded_paths
+  return _path_key(path) in _loaded_paths
 
 
 def to_example(row: Sequence, schema: Schema) -> bytes:
@@ -99,13 +104,27 @@ def save_as_tfrecords(partitions: Sequence[Iterable], schema: Schema,
   """Write one ``part-NNNNN.tfrecord`` file per partition.
 
   With an engine, partitions are written by the executors in parallel
-  (parity: reference saveAsNewAPIHadoopFile via executors, dfutil.py:29-41);
-  without one, they are written locally.
+  (parity: reference saveAsNewAPIHadoopFile writing FROM executors,
+  dfutil.py:29-41) and the driver ships only partition HANDLES: a
+  partition may be a zero-arg callable returning an iterable, in which
+  case rows are produced on the executor and the driver allocates O(1)
+  memory regardless of dataset size. Plain lists still work (and are
+  pickled whole, fine for small data). ``output_dir`` may be a remote URI
+  (``gs://...``) — writers stream through fsspec.
   """
-  os.makedirs(output_dir, exist_ok=True)
+  from tensorflowonspark_tpu.data import fs
+  fs.makedirs(output_dir, exist_ok=True)
+  remote = fs.is_remote(output_dir)
 
-  def _write_partition(index: int, rows: Iterable) -> str:
-    path = os.path.join(output_dir, "part-%05d.tfrecord" % index)
+  def _part_path(index: int) -> str:
+    name = "part-%05d.tfrecord" % index
+    return (output_dir.rstrip("/") + "/" + name) if remote \
+        else os.path.join(output_dir, name)
+
+  def _write_partition(index: int, rows) -> str:
+    path = _part_path(index)
+    if callable(rows):
+      rows = rows()
     with tfrecord.TFRecordWriter(path) as w:
       for row in rows:
         w.write(to_example(row, schema))
@@ -114,14 +133,21 @@ def save_as_tfrecords(partitions: Sequence[Iterable], schema: Schema,
   if engine is None:
     return [_write_partition(i, p) for i, p in enumerate(partitions)]
 
-  indexed = [[(i, list(p))] for i, p in enumerate(partitions)]
-
   def _task(it):
     out = []
     for index, rows in it:
       out.append(_write_partition(index, rows))
     return out
 
+  # one engine-partition per output file; callables (or small lists) ship
+  # to the executor, which produces the rows itself — never the driver.
+  # O(#partitions) handles on the driver, never O(rows). One-shot
+  # iterators/generators can't cross the process boundary (cloudpickle
+  # rejects generators) — those alone are materialized here.
+  def _shippable(p):
+    return p if callable(p) or isinstance(p, (list, tuple)) else list(p)
+
+  indexed = [[(i, _shippable(p))] for i, p in enumerate(partitions)]
   return sorted(engine.map_partitions(indexed, _task))
 
 
@@ -135,7 +161,13 @@ def load_tfrecords(path: str, schema: Optional[Schema] = None,
   is inferred from the first record when not given (parity:
   reference loadTFRecords + infer_schema, dfutil.py:44-81).
   """
-  if os.path.isdir(path):
+  from tensorflowonspark_tpu.data import fs
+  if fs.is_remote(path):
+    base = path.rstrip("/")
+    files = sorted(fs.glob_files(base + "/*.tfrecord")) or \
+        sorted(fs.glob_files(base + "/part-*")) or \
+        sorted(fs.glob_files(path))
+  elif os.path.isdir(path):
     files = sorted(glob.glob(os.path.join(path, "*.tfrecord"))) or \
         sorted(glob.glob(os.path.join(path, "part-*")))
   elif os.path.exists(path):
@@ -166,5 +198,5 @@ def load_tfrecords(path: str, schema: Optional[Schema] = None,
     k = max(1, num_partitions)
     partitions = [flat[i::k] for i in range(k)]
 
-  _loaded_paths[os.path.abspath(path)] = inferred
+  _loaded_paths[_path_key(path)] = inferred
   return partitions, inferred

@@ -8,7 +8,6 @@ reader, batch assembly, and a double-buffered host→device prefetch
 iterator so input never stalls the accelerator.
 """
 
-import glob
 import itertools
 import logging
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
@@ -22,9 +21,13 @@ def shard_files(pattern_or_paths, num_shards: int, shard_index: int,
 
   Usage inside a main fn: ``shard_files(pattern, ctx.num_workers,
   ctx.task_index)`` — every worker gets a disjoint, stable subset.
+  Remote patterns (``gs://bucket/data/part-*``) list through fsspec and
+  return scheme-qualified paths (parity: reference readers listed shards
+  through Hadoop's FS layer, e.g. TFNode.hdfs_path call sites).
   """
+  from tensorflowonspark_tpu.data import fs
   if isinstance(pattern_or_paths, str):
-    paths = sorted(glob.glob(pattern_or_paths))
+    paths = sorted(fs.glob_files(pattern_or_paths))
   else:
     paths = sorted(pattern_or_paths)
   if not paths:

@@ -108,20 +108,28 @@ def masked_crc(data: bytes) -> int:
 
 
 class TFRecordWriter(object):
-  """Write records to a TFRecord file."""
+  """Write records to a TFRecord file (local disk or any fsspec scheme).
+
+  Local paths use the native codec when available; remote URIs
+  (``gs://...``) stream through fsspec with the pure-Python framing — the
+  capability the reference got from the tensorflow-hadoop jar writing
+  straight to HDFS (reference dfutil.py:29-41).
+  """
 
   def __init__(self, path: str):
+    from tensorflowonspark_tpu.data import fs
     self.path = path
-    lib = _load_native()
+    lib = _load_native() if not fs.is_remote(path) else None
     self._lib = lib
     if lib is not None:
-      self._handle = lib.tos_writer_open(path.encode())
+      from tensorflowonspark_tpu.utils import paths as _paths
+      self._handle = lib.tos_writer_open(_paths.strip_scheme(path).encode())
       if not self._handle:
         raise OSError("cannot open %s for writing" % path)
       self._file = None
     else:
       self._handle = None
-      self._file = open(path, "wb")
+      self._file = fs.open_file(path, "wb")
 
   def write(self, record: bytes) -> None:
     if self._handle is not None:
@@ -150,20 +158,26 @@ class TFRecordWriter(object):
 
 
 class TFRecordReader(object):
-  """Iterate records of a TFRecord file."""
+  """Iterate records of a TFRecord file (local disk or any fsspec scheme).
+
+  Remote URIs stream record-at-a-time through fsspec's buffered reads —
+  whole files are never downloaded up front.
+  """
 
   def __init__(self, path: str):
+    from tensorflowonspark_tpu.data import fs
     self.path = path
-    lib = _load_native()
+    lib = _load_native() if not fs.is_remote(path) else None
     self._lib = lib
     if lib is not None:
-      self._handle = lib.tos_reader_open(path.encode())
+      from tensorflowonspark_tpu.utils import paths as _paths
+      self._handle = lib.tos_reader_open(_paths.strip_scheme(path).encode())
       if not self._handle:
         raise OSError("cannot open %s" % path)
       self._file = None
     else:
       self._handle = None
-      self._file = open(path, "rb")
+      self._file = fs.open_file(path, "rb")
 
   def __iter__(self) -> Iterator[bytes]:
     return self

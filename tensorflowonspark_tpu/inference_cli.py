@@ -78,8 +78,15 @@ def main(argv=None) -> int:
   projected = [[tuple(row[col_index[c]] for c in ordered_cols)
                 for row in part] for part in partitions]
 
-  out_names = [output_mapping[t] for t in sorted(output_mapping)] \
-      if output_mapping else ["prediction"]
+  if output_mapping:
+    out_names = [output_mapping[t] for t in sorted(output_mapping)]
+  else:
+    # transformSchema parity: without an explicit mapping the bundle's
+    # recorded signature names the output columns (TFModel.scala:294-311);
+    # the shared helper keeps this in lockstep with TFModel.transform's
+    # value order
+    from tensorflowonspark_tpu.pipeline import signature_output_names
+    out_names = signature_output_names(args.export_dir) or ["prediction"]
   engine = get_engine(args.engine, num_executors=args.num_executors)
   count = 0
   try:

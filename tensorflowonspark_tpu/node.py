@@ -76,9 +76,11 @@ class TPUNodeContext(object):
     return paths.absolute_path(path, self.default_fs, self.working_dir)
 
   def get_data_feed(self, train_mode=True, qname_in="input",
-                    qname_out="output", input_mapping=None):
+                    qname_out="output", input_mapping=None,
+                    liveness_timeout=600.0):
     from tensorflowonspark_tpu.datafeed import DataFeed
-    return DataFeed(self.hub, train_mode, qname_in, qname_out, input_mapping)
+    return DataFeed(self.hub, train_mode, qname_in, qname_out, input_mapping,
+                    liveness_timeout=liveness_timeout)
 
   def release_port(self) -> None:
     """Release the reserved coordinator port prior to starting JAX distributed

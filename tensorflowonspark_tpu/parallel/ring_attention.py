@@ -90,7 +90,7 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
 
 def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
                       blk_k: int, interpret: bool, blk_bwd_q=None,
-                      blk_bwd_k=None):
+                      blk_bwd_k=None, bwd=None):
   """shard_map body: ring attention with Pallas flash-attention blocks.
 
   Each ring step computes the partial attention of the local queries
@@ -117,7 +117,7 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
     o_j, lse_j = flash_attention_block(
         q, k_blk, v_blk, my * s_local, src * s_local, causal=causal,
         blk_q=blk_q, blk_k=blk_k, interpret=interpret,
-        blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k)
+        blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k, bwd=bwd)
     o, lse = merge_partials(o, lse, o_j.astype(jnp.float32), lse_j)
     perm = [(i, (i + 1) % n) for i in range(n)]
     k_blk = lax.ppermute(k_blk, axis_name, perm)
@@ -133,7 +133,7 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
                    batch_axes=None, use_flash: bool = False,
                    blk_q: int = 256, blk_k: int = 512,
                    interpret: bool = False, blk_bwd_q: int = None,
-                   blk_bwd_k: int = None):
+                   blk_bwd_k: int = None, bwd: str = None):
   """Exact full attention over a sequence sharded across ``axis_name``.
 
   Args:
@@ -145,7 +145,10 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
       (ops.flash_attention_block) instead of dense block math — the
       memory-optimal path on TPU (``interpret=True`` for CPU tests).
       ``blk_q``/``blk_k`` tile the forward; ``blk_bwd_q``/``blk_bwd_k``
-      tile the backward (None = per-mode DEFAULT_BWD_BLOCKS).
+      tile the backward (None = per-mode DEFAULT_BWD_BLOCKS); ``bwd``
+      picks the backward implementation per call ("fused"/"split",
+      None = the TFOS_TPU_FLASH_BWD env default) — the same per-call
+      override flash_attention itself offers.
 
   Returns attention output with the same sharding as ``q``.
   """
@@ -158,7 +161,7 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
   if use_flash:
     fn = functools.partial(_ring_flash_local, axis_name=axis_name,
                            causal=causal, blk_q=blk_q, blk_k=blk_k,
-                           blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k,
+                           blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k, bwd=bwd,
                            interpret=interpret)
   else:
     fn = functools.partial(_ring_attn_local, axis_name=axis_name,

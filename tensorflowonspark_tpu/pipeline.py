@@ -145,15 +145,108 @@ class TFParams(Params, HasBatchSize, HasClusterSize, HasNumPS,
 
 
 def export_bundle(params, predict_fn, export_dir: str,
-                  is_chief: bool = True) -> str:
-  """Write the model bundle (orbax params + pickled predict fn)."""
+                  is_chief: bool = True, example_batch=None,
+                  output_signature: Optional[Dict] = None) -> str:
+  """Write the model bundle (orbax params + pickled predict fn).
+
+  When ``example_batch`` (a dict of input arrays) is given, the predict fn
+  runs once at export time and the bundle records an output SIGNATURE —
+  output names, dtypes and trailing shapes — so serving derives its output
+  schema from the model without the caller re-declaring it
+  (parity: Scala ``TFModel.transformSchema`` deriving output columns from
+  the graph, reference TFModel.scala:294-311). ``output_signature`` may
+  instead declare it explicitly: ``{name: {"dtype": ..., "shape": [...]}}``.
+  """
   import cloudpickle
   from tensorflowonspark_tpu.utils import compat
 
   target = compat.export_model(params, export_dir, is_chief)
   with open(os.path.join(target, "predict.pkl"), "wb") as f:
     cloudpickle.dump(predict_fn, f)
+
+  signature = dict(output_signature) if output_signature else None
+  inputs = None
+  if example_batch is not None:
+    import numpy as np
+    inputs = sorted(example_batch)
+    out = predict_fn(params, example_batch)
+    if not isinstance(out, dict):
+      out = {"output": out}
+    signature = {
+        name: {"dtype": str(np.asarray(a).dtype),
+               # leading batch dim is caller-determined; record the rest
+               "shape": [None] + list(np.asarray(a).shape[1:])}
+        for name, a in out.items()}
+  if signature is not None:
+    with open(os.path.join(target, "signature.json"), "w") as f:
+      import json
+      json.dump({"inputs": inputs, "outputs": signature}, f, indent=2)
   return target
+
+
+def load_signature(export_dir: str) -> Optional[Dict]:
+  """The bundle's recorded IO signature, or None for pre-signature
+  bundles: ``{"inputs": [names] | None, "outputs": {name: {dtype, shape}}}``.
+  """
+  path = os.path.join(export_dir, "signature.json")
+  if not os.path.exists(path):
+    return None
+  import json
+  with open(path) as f:
+    return json.load(f)
+
+
+def signature_output_names(export_dir: str) -> Optional[List[str]]:
+  """The bundle signature's output columns in serving order (sorted), or
+  None for pre-signature bundles. The ONE derivation both TFModel.transform
+  and the inference CLI use, so column names and value order can never
+  drift apart (transformSchema parity, reference TFModel.scala:294-311)."""
+  sig = load_signature(export_dir)
+  if sig and sig.get("outputs"):
+    return sorted(sig["outputs"])
+  return None
+
+
+def _transform_worker_slot() -> int:
+  """This task's host-local worker index for chip placement.
+
+  LocalEngine executors export ``TOS_EXECUTOR_SLOT``; Spark tasks derive a
+  deterministic slot from their partition id (the reference's deterministic
+  placement-by-worker-index, gpu_info.py:80-91 — partition ids spread
+  round-robin over a host's worker slots). Anything else gets slot 0.
+  """
+  slot = os.environ.get("TOS_EXECUTOR_SLOT")
+  if slot is not None:
+    return int(slot)
+  try:
+    from pyspark import TaskContext
+    ctx = TaskContext.get()
+    if ctx is not None:
+      return ctx.partitionId()
+  except ImportError:
+    pass
+  return 0
+
+
+def _allocate_transform_chips(chips_per_node: int) -> None:
+  """Claim this task's disjoint chip share before JAX initializes.
+
+  No-op without ``chips_per_node``, in test mode, or when already
+  allocated / no TPU topology is visible.
+  """
+  if not chips_per_node or os.environ.get("TOS_TPU_TEST_MODE"):
+    return
+  if os.environ.get("TOS_CHIP_ENV_APPLIED"):
+    return  # a prior task on this executor process already claimed chips
+  from tensorflowonspark_tpu.utils import tpu_info
+  topo = tpu_info.get_topology()
+  if topo is None:
+    return
+  workers_per_host = max(1, topo.chips_per_host // chips_per_node)
+  slot = _transform_worker_slot() % workers_per_host
+  tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
+      chips_per_node, slot, workers_per_host, generation=topo.generation))
+  os.environ["TOS_CHIP_ENV_APPLIED"] = "1"
 
 
 # per-executor-process bundle cache (parity: pipeline.py:495-499)
@@ -270,13 +363,22 @@ class TFModel(TFParams):
     input_mapping = args.get("input_mapping") or {}
     output_mapping = args.get("output_mapping") or {}
     batch_size = args.get("batch_size", 100)
+    chips_per_node = args.get("chips_per_node", 0) or 0
 
     input_tensors = [input_mapping[c] for c in sorted(input_mapping)] \
         if input_mapping else None
     output_tensors = sorted(output_mapping) if output_mapping else None
+    if output_tensors is None:
+      # transformSchema parity: the bundle's recorded signature declares
+      # the output columns ahead of execution (TFModel.scala:294-311)
+      output_tensors = signature_output_names(export_dir)
 
     def _transform_partition(iterator):
       import numpy as np
+      # N parallel inference tasks on one TPU host must claim DISJOINT
+      # chips (the same allocation parallel/runner.py does, parity
+      # TFParallel.py:43-56) — before the bundle load initializes JAX
+      _allocate_transform_chips(chips_per_node)
       params, predict_fn = load_bundle(export_dir)
       results = []
       n_cols = len(input_tensors) if input_tensors else 1

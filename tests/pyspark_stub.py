@@ -12,17 +12,51 @@ engine contract:
   threads and preserves partition order;
 - ``rdd.barrier().mapPartitions`` gang-runs all partitions with a real
   threading.Barrier behind ``BarrierTaskContext.barrier()`` and placement
-  info via ``getTaskInfos()``.
+  info via ``getTaskInfos()``;
+- TASK RETRY: a failing task attempt is re-run up to
+  ``spark.task.maxFailures`` (default 4, like Spark's TaskSetManager);
+  the attempt number is visible through ``TaskContext.attemptNumber()``.
+  Barrier stages retry the WHOLE gang (Spark aborts and resubmits every
+  barrier task when one fails);
+- SPECULATION: with ``spark.speculation=true``, ``collect`` launches a
+  duplicate attempt of each task and takes the first result — BOTH
+  attempts run their side effects, which is exactly the hazard the
+  framework's duplicate-registration defenses exist for (never enabled
+  for barrier stages, as in Spark).
 
 Install with ``sys.modules["pyspark"] = tests.pyspark_stub`` (see
 test_engine.py's fixture) so SparkEngine's ``from pyspark import ...``
 resolves here.
+
+pyspark itself cannot be installed in this image (no package installs
+permitted); see tests/SPARK_VALIDATION.md for what that means for the
+validation tier and what this stub does/doesn't prove.
 """
 
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
 _COLLECT_TIMEOUT = 60
+
+
+class TaskContext:
+  """Per-task-attempt context (thread-local, like pyspark's)."""
+
+  _local = threading.local()
+
+  def __init__(self, partition_id, attempt_number):
+    self._partition_id = partition_id
+    self._attempt_number = attempt_number
+
+  @classmethod
+  def get(cls):
+    return getattr(cls._local, "ctx", None)
+
+  def partitionId(self):
+    return self._partition_id
+
+  def attemptNumber(self):
+    return self._attempt_number
 
 
 def _slices(data, n):
@@ -76,9 +110,59 @@ class StubRDD:
     return StubRDD(self.sc, [
         (lambda pf=pf: fn(iter(list(pf())))) for pf in self._part_fns])
 
+  def _max_failures(self):
+    return int(self.sc.getConf().get("spark.task.maxFailures", "4"))
+
+  def _speculative(self):
+    return self.sc.getConf().get("spark.speculation",
+                                 "false").lower() == "true"
+
+  def _run_attempt(self, pid, attempt, thunk):
+    TaskContext._local.ctx = TaskContext(pid, attempt)
+    try:
+      return list(thunk())
+    finally:
+      TaskContext._local.ctx = None
+
+  def _run_task(self, pid, thunk, max_failures):
+    """One logical task = up to ``max_failures`` attempts (TaskSetManager
+    semantics: the task fails only when every attempt failed)."""
+    last = None
+    for attempt in range(max_failures):
+      try:
+        return self._run_attempt(pid, attempt, thunk)
+      except Exception as e:  # noqa: BLE001 - retried like a Spark task
+        last = e
+    raise RuntimeError(
+        "Task %d in stage failed %d times, most recent failure: %r"
+        % (pid, max_failures, last)) from last
+
   def _run_partitions(self, thunks):
-    with ThreadPoolExecutor(max_workers=max(1, len(thunks))) as ex:
-      futures = [ex.submit(lambda t=t: list(t())) for t in thunks]
+    max_failures = self._max_failures()
+    speculative = self._speculative()
+    with ThreadPoolExecutor(max_workers=max(1, len(thunks) * 2)) as ex:
+      futures = [ex.submit(self._run_task, i, t, max_failures)
+                 for i, t in enumerate(thunks)]
+      if speculative:
+        # a speculative copy of every task: the first SUCCESSFUL attempt
+        # chain wins the result slot (Spark marks the task successful if
+        # any attempt survives), but both attempts RUN (side effects
+        # included) — Spark's hazard, surfaced deliberately
+        import concurrent.futures as cf
+        copies = [ex.submit(self._run_task, i, t, max_failures)
+                  for i, t in enumerate(thunks)]
+        out = []
+        for f, c in zip(futures, copies):
+          done, pending = cf.wait([f, c], timeout=_COLLECT_TIMEOUT,
+                                  return_when=cf.FIRST_COMPLETED)
+          winner = next((x for x in done if x.exception() is None), None)
+          if winner is None and pending:
+            done2, _ = cf.wait(pending, timeout=_COLLECT_TIMEOUT)
+            winner = next((x for x in done2 if x.exception() is None), None)
+          if winner is None:
+            raise next(iter(done)).exception()
+          out.append(winner.result())
+        return out
       return [f.result(timeout=_COLLECT_TIMEOUT) for f in futures]
 
   def collect(self):
@@ -97,8 +181,52 @@ class StubRDD:
         (lambda pf=pf: (fn(iter(list(pf()))), ())[1])
         for pf in self._part_fns])
 
+  def union(self, other):
+    """Concatenate partitions, like Spark's UnionRDD (the epochs idiom:
+    ``sc.union([rdd]*N)``, reference TFCluster.py:90-94)."""
+    return StubRDD(self.sc, list(self._part_fns) + list(other._part_fns))
+
   def barrier(self):
     return _StubBarrierRDD(self)
+
+
+class _GangRDD(StubRDD):
+  """Barrier-stage result RDD: one task failing aborts and re-runs the
+  WHOLE gang (Spark resubmits every task of a failed barrier stage), and
+  speculation never applies to barrier stages."""
+
+  def __init__(self, sc, make_gang):
+    gate, thunks = make_gang()
+    super().__init__(sc, thunks)
+    self._gate = gate
+    self._make_gang = make_gang
+
+  def _run_partitions(self, thunks):
+    import concurrent.futures as cf
+    max_failures = self._max_failures()
+    last = None
+    gate = self._gate
+    for stage_attempt in range(max_failures):
+      if stage_attempt:
+        gate, thunks = self._make_gang()
+      with ThreadPoolExecutor(max_workers=max(1, len(thunks))) as ex:
+        futures = [ex.submit(self._run_attempt, i, stage_attempt, t)
+                   for i, t in enumerate(thunks)]
+        cf.wait(futures, timeout=_COLLECT_TIMEOUT,
+                return_when=cf.FIRST_EXCEPTION)
+        errs = [f.exception() for f in futures if f.done()
+                and f.exception() is not None]
+        if not errs and all(f.done() for f in futures):
+          return [f.result() for f in futures]
+        # abort the barrier so gang members blocked in barrier() stop NOW
+        # (Spark kills the surviving tasks of a failed barrier stage)
+        gate.abort()
+        for f in futures:
+          f.cancel() or f.exception(timeout=_COLLECT_TIMEOUT)
+        last = errs[0] if errs else TimeoutError("barrier gang timed out")
+    raise RuntimeError(
+        "Barrier stage failed %d times, most recent failure: %r"
+        % (max_failures, last)) from last
 
 
 class _StubBarrierRDD:
@@ -108,20 +236,84 @@ class _StubBarrierRDD:
   def mapPartitions(self, fn):
     rdd = self._rdd
     n = rdd.getNumPartitions()
-    gate = threading.Barrier(n)
-    infos = [_TaskInfo("stub-host:%d" % (40000 + i)) for i in range(n)]
 
-    def _bind(pid, pf):
-      def _run():
-        BarrierTaskContext._local.ctx = BarrierTaskContext(pid, infos, gate)
-        try:
-          return fn(iter(list(pf())))
-        finally:
-          BarrierTaskContext._local.ctx = None
-      return _run
+    def _make_gang():
+      # a FRESH barrier per stage attempt — a broken barrier from a failed
+      # attempt must not poison the retry
+      gate = threading.Barrier(n)
+      infos = [_TaskInfo("stub-host:%d" % (40000 + i)) for i in range(n)]
 
-    return StubRDD(rdd.sc, [
-        _bind(i, pf) for i, pf in enumerate(rdd._part_fns)])
+      def _bind(pid, pf):
+        def _run():
+          BarrierTaskContext._local.ctx = BarrierTaskContext(pid, infos,
+                                                             gate)
+          try:
+            return fn(iter(list(pf())))
+          finally:
+            BarrierTaskContext._local.ctx = None
+        return _run
+
+      return gate, [_bind(i, pf) for i, pf in enumerate(rdd._part_fns)]
+
+    return _GangRDD(rdd.sc, _make_gang)
+
+
+class StubDStream:
+  """A queue-backed discretized stream (pyspark.streaming surface).
+
+  Micro-batch dispatch mirrors Spark Streaming's driver-side JobGenerator:
+  ``foreachRDD`` callbacks run sequentially on one scheduler thread, one
+  micro-batch at a time, in arrival order.
+  """
+
+  def __init__(self, ssc, rdds):
+    self._ssc = ssc
+    self._rdds = list(rdds)
+    self._hooks = []
+
+  def foreachRDD(self, fn):
+    self._hooks.append(fn)
+
+
+class StreamingContext:
+  """Minimal StreamingContext: queueStream + start/stop/awaitTermination."""
+
+  def __init__(self, sc, batchDuration=0.01):
+    self.sc = sc
+    self._interval = batchDuration
+    self._streams = []
+    self._thread = None
+    self._stop_event = threading.Event()
+
+  def queueStream(self, rdds, oneAtATime=True):
+    ds = StubDStream(self, rdds)
+    self._streams.append(ds)
+    return ds
+
+  def start(self):
+    def _generate():
+      pending = [list(ds._rdds) for ds in self._streams]
+      while not self._stop_event.is_set() and any(pending):
+        for ds, queue in zip(self._streams, pending):
+          if queue and not self._stop_event.is_set():
+            rdd = queue.pop(0)
+            for hook in ds._hooks:
+              hook(rdd)
+        self._stop_event.wait(self._interval)
+    self._thread = threading.Thread(target=_generate, daemon=True,
+                                    name="stub-streaming-scheduler")
+    self._thread.start()
+
+  def awaitTermination(self, timeout=None):
+    if self._thread is not None:
+      self._thread.join(timeout)
+
+  def stop(self, stopSparkContext=True, stopGraceFully=False):
+    self._stop_event.set()
+    if self._thread is not None:
+      self._thread.join(_COLLECT_TIMEOUT)
+    if stopSparkContext:
+      self.sc.stop()
 
 
 class _Conf:

@@ -58,6 +58,61 @@ class TestDataFeed:
     batch = feed.next_batch(5)
     assert batch == {"x": [1, 2], "y": ["a", "b"]}
 
+
+class TestLiveness:
+  """A dead feeder must raise, not hang (VERDICT r2 weakness 6; consumer-
+  side extension of the reference's feeder error polling,
+  TFSparkNode.py:508-515)."""
+
+  def test_worker_error_surfaces_in_next_batch(self, hub):
+    hub.get_queue("error").put("Traceback: boom in feeder")
+    feed = DataFeed(hub, liveness_timeout=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="boom in feeder"):
+      feed.next_batch(4)
+    assert time.monotonic() - t0 < 10.0
+    # peek-and-put-back: shutdown's check must still see the error
+    assert hub.get_queue("error").get_many(4, block=False) \
+        == ["Traceback: boom in feeder"]
+
+  def test_silent_feeder_death_raises_after_deadline(self, hub):
+    from tensorflowonspark_tpu.datafeed import FeedStalledError
+    feed = DataFeed(hub, liveness_timeout=2.5)
+    t0 = time.monotonic()
+    with pytest.raises(FeedStalledError, match="presumed dead"):
+      feed.next_batch(4)
+    elapsed = time.monotonic() - t0
+    assert 2.0 < elapsed < 15.0
+
+  def test_error_mid_feed_after_some_batches(self, hub):
+    """Feeder delivers data, then dies with a traceback: the consumer gets
+    the delivered batch, then the error — within seconds, not never."""
+    q = hub.get_queue("input")
+    q.put_many([1, 2, 3, 4])
+    feed = DataFeed(hub, liveness_timeout=30.0)
+    assert feed.next_batch(4) == [1, 2, 3, 4]
+
+    def _die_late():
+      time.sleep(0.5)
+      hub.get_queue("error").put("worker exploded")
+
+    threading.Thread(target=_die_late, daemon=True).start()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="worker exploded"):
+      feed.next_batch(4)
+    assert time.monotonic() - t0 < 10.0
+
+  def test_terminating_state_stops_instead_of_raising(self, hub):
+    feed = DataFeed(hub, liveness_timeout=30.0)
+
+    def _terminate_late():
+      time.sleep(0.5)
+      hub.set("state", "terminating")
+
+    threading.Thread(target=_terminate_late, daemon=True).start()
+    assert feed.next_batch(4) == []
+    assert feed.should_stop()
+
   def test_batch_results_roundtrip(self, hub):
     feed = DataFeed(hub, train_mode=False)
     feed.batch_results([10, 20, 30])

@@ -123,6 +123,43 @@ class TestInferenceCLISubprocess:
     preds = [json.loads(l)["pred"] for l in open(out_path)]
     assert preds == [15.0, 25.0]
 
+  def test_mapping_free_cli_uses_bundle_signature(self, tmp_path):
+    """Without --output_mapping the CLI derives output columns from the
+    signature recorded at export (transformSchema parity,
+    reference TFModel.scala:294-311)."""
+    from tensorflowonspark_tpu import pipeline
+    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.data.schema import parse_schema
+
+    def predict_fn(params, batch):
+      x = np.asarray(batch["v"], "float32")
+      return {"doubled": x * params["k"], "negated": -x}
+
+    export_dir = str(tmp_path / "model")
+    pipeline.export_bundle(
+        {"k": np.float32(2.0)}, predict_fn, export_dir,
+        example_batch={"v": np.zeros((1,), "float32")})
+    dfutil.save_as_tfrecords([[(3.0,), (4.0,)]],
+                             parse_schema("struct<v:float>"),
+                             str(tmp_path / "data"))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out_path = str(tmp_path / "preds.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tensorflowonspark_tpu.inference_cli",
+         "--export_dir", export_dir,
+         "--input", str(tmp_path / "data"),
+         "--schema_hint", "struct<v:float>",
+         "--output", out_path],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(l) for l in open(out_path)]
+    assert rows == [{"doubled": 6.0, "negated": -3.0},
+                    {"doubled": 8.0, "negated": -4.0}]
+
 
 class TestCompatRoundtrip:
   def test_export_import_model(self, tmp_path):

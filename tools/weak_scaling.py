@@ -43,8 +43,12 @@ def run_child(n_devices: int) -> int:
   from tensorflowonspark_tpu.parallel import mesh as mesh_lib
   from tensorflowonspark_tpu.parallel import sharding as sh
 
-  assert len(jax.devices()) == n_devices
-  mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=n_devices))
+  # an inherited XLA_FLAGS may pin a LARGER device count than requested
+  # (force_cpu_platform preserves it); take the first n rather than fail
+  assert len(jax.devices()) >= n_devices, \
+      "need %d devices, have %d" % (n_devices, len(jax.devices()))
+  mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=n_devices),
+                             devices=jax.devices()[:n_devices])
   cfg = tfm.TransformerConfig(vocab_size=256, num_layers=2, num_heads=4,
                               d_model=128, d_ff=512, max_seq_len=SEQ,
                               dtype=jnp.float32)
