@@ -1,12 +1,26 @@
-"""Pipeline parallelism: GPipe-style microbatched stage execution.
+"""Pipeline parallelism: microbatched stage execution over a mesh axis.
 
 A capability the reference lacked (SURVEY.md §2.3: "Pipeline parallelism:
 No"), here implemented TPU-natively: stages live on consecutive devices of
 the ``pipeline`` mesh axis, activations advance between neighbors with
 ``lax.ppermute`` (ICI neighbor exchange), and microbatches are interleaved
-down the pipe in a static ``lax.fori_loop`` schedule — fully jittable and
-differentiable (the backward pass pipelines in reverse automatically
-through the ppermute transpose).
+down the pipe in a static schedule — fully jittable.
+
+Two schedules:
+
+- :func:`pipeline_apply` — GPipe fill-drain forward, differentiable
+  through JAX AD (the backward pipelines in reverse through the ppermute
+  transpose). Simple, composes with ``jax.grad``; activation storage grows
+  with the number of microbatches.
+- :func:`pipeline_train_step` — 1F1B: ONE loop interleaving each stage's
+  forwards with backward steps of earlier microbatches, grads produced by
+  per-stage ``jax.vjp`` with rematerialized stage forwards. Peak
+  *intermediate-activation* storage is a ring buffer of ``2 * n_stages``
+  microbatch inputs per device, independent of microbatch count — the
+  memory property the 1F1B schedule exists for. (The model INPUT/target
+  microbatches themselves are replicated along the pipeline axis, like
+  in :func:`pipeline_apply`; for deep stacks it is the loop residuals,
+  not the inputs, that dominate.)
 
 Constraints: every stage maps activations of one shape to the same shape
 (true for stacked Transformer blocks), and stage parameters are stacked on
@@ -22,6 +36,21 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+
+def _split_microbatches(arr, num_microbatches: int, mesh):
+  """Reshape [batch, ...] → [n_micro, micro_batch, ...], checking both the
+  microbatch split and that each microbatch divides over the data axes
+  (otherwise shard_map fails with an opaque spec error)."""
+  b = arr.shape[0]
+  assert b % num_microbatches == 0, \
+      "batch %d not divisible into %d microbatches" % (b, num_microbatches)
+  micro_b = b // num_microbatches
+  data_size = mesh_lib.axis_size(mesh, *mesh_lib.data_axes(mesh))
+  assert micro_b % data_size == 0, \
+      "microbatch size %d (batch %d / %d microbatches) not divisible by " \
+      "the data-axis extent %d" % (micro_b, b, num_microbatches, data_size)
+  return arr.reshape((num_microbatches, micro_b) + arr.shape[1:])
 
 
 def _pipeline_local(stage_params, x_micro, stage_fn: Callable,
@@ -82,12 +111,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh,
   """
   from jax import shard_map
 
-  n_stages = mesh.shape[axis_name]
-  b = x.shape[0]
-  assert b % num_microbatches == 0, \
-      "batch %d not divisible into %d microbatches" % (b, num_microbatches)
-  x_micro = x.reshape((num_microbatches, b // num_microbatches) +
-                      x.shape[1:])
+  x_micro = _split_microbatches(x, num_microbatches, mesh)
 
   param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
   fn = functools.partial(_pipeline_local, stage_fn=stage_fn,
@@ -104,4 +128,140 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh,
   x_spec = P(None, batch_axes or None)
   out = shard_map(_local, mesh=mesh, in_specs=(param_specs, x_spec),
                   out_specs=x_spec, check_vma=False)(stage_params, x_micro)
-  return out.reshape((b,) + x.shape[1:])
+  return out.reshape(x.shape)
+
+
+def _1f1b_local(stage_params, x_micro, t_micro, stage_fn: Callable,
+                loss_fn: Callable, axis_name: str, other_axes: tuple):
+  """shard_map body: the 1F1B schedule for one device (= one stage).
+
+  Per global step ``t`` every stage runs, in lockstep:
+
+  - a FORWARD of microbatch ``m_f = t - s`` (masked outside
+    ``[0, n_micro)``), storing its input in a ring buffer of ``2S`` slots;
+  - a BACKWARD of microbatch ``m_b = t - (2S - 1) + s``: the stage input
+    is read back from the ring, the stage forward is rematerialized under
+    ``jax.vjp``, and the incoming cotangent is the next stage's grad from
+    the previous step (the last stage seeds from the loss). Ring-slot
+    lifetime analysis: input of ``m`` is written at ``t = m + s`` and read
+    at ``t = m + 2S - 1 - s``, a gap of at most ``2S - 1`` steps, so 2S
+    slots never collide.
+
+  Activations flow ``s -> s+1`` and cotangents ``s -> s-1`` by ppermute,
+  one hop per step; total steps ``n_micro + 2S - 1``.
+  """
+  S = lax.axis_size(axis_name)
+  s = lax.axis_index(axis_name)
+  n_micro = x_micro.shape[0]
+  ring = 2 * S
+  total_steps = n_micro + 2 * S - 1
+  inv_micro = jnp.float32(1.0 / n_micro)
+
+  fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+  bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+  params = jax.tree.map(lambda p: p[0], stage_params)  # squeeze stage axis
+  act0 = jnp.zeros_like(x_micro[0])
+  ring0 = jnp.zeros((ring,) + x_micro.shape[1:], x_micro.dtype)
+  # accumulate grads in f32 (like loss_acc): summing n_micro pre-scaled
+  # contributions in bf16 would swamp the small addends
+  grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+  def body(t, carry):
+    fwd_recv, bwd_recv, ring_buf, grads, loss_acc = carry
+
+    # ---- forward slot: microbatch t - s enters this stage ----
+    m_f = t - s
+    f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
+    mf_c = jnp.clip(m_f, 0, n_micro - 1)
+    inj = lax.dynamic_index_in_dim(x_micro, mf_c, 0, keepdims=False)
+    inp = jnp.where(s == 0, inj, fwd_recv)
+    slot_f = mf_c % ring
+    cur = lax.dynamic_index_in_dim(ring_buf, slot_f, 0, keepdims=False)
+    ring_buf = lax.dynamic_update_index_in_dim(
+        ring_buf, jnp.where(f_valid, inp, cur), slot_f, 0)
+    y = stage_fn(params, inp)
+
+    # ---- backward slot: microbatch t - (2S-1) + s leaves this stage ----
+    m_b = t - (2 * S - 1) + s
+    b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
+    mb_c = jnp.clip(m_b, 0, n_micro - 1)
+    saved = lax.dynamic_index_in_dim(ring_buf, mb_c % ring, 0,
+                                     keepdims=False)
+    y_b, vjp_fn = jax.vjp(stage_fn, params, saved)
+    tgt = lax.dynamic_index_in_dim(t_micro, mb_c, 0, keepdims=False)
+    lval, loss_vjp = jax.vjp(loss_fn, y_b, tgt)
+    # cotangent dtype must match the loss primal's (bf16 losses included)
+    g_loss = loss_vjp(inv_micro.astype(lval.dtype))[0]
+    g_in = jnp.where(s == S - 1, g_loss.astype(y_b.dtype), bwd_recv)
+    g_par, g_x = vjp_fn(g_in)
+    grads = jax.tree.map(
+        lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)).astype(
+            jnp.float32),
+        grads, g_par)
+    loss_acc = loss_acc + jnp.where(
+        jnp.logical_and(b_valid, s == S - 1), lval.astype(jnp.float32), 0.0)
+
+    fwd_recv = lax.ppermute(y, axis_name, fwd_perm)
+    bwd_recv = lax.ppermute(g_x, axis_name, bwd_perm)
+    return fwd_recv, bwd_recv, ring_buf, grads, loss_acc
+
+  _, _, _, grads, loss_acc = lax.fori_loop(
+      0, total_steps, body, (act0, act0, ring0, grads0,
+                             jnp.zeros((), jnp.float32)))
+
+  # only the last stage accumulated loss; share it down the pipe, and
+  # average loss/grads over the data (and any other non-pipeline) axes
+  loss = lax.psum(loss_acc, axis_name) * inv_micro
+  if other_axes:
+    loss = lax.pmean(loss, other_axes)
+    grads = jax.tree.map(lambda g: lax.pmean(g, other_axes), grads)
+  # back to the param dtype, re-growing the leading stage axis so
+  # out_spec P(axis_name) stacks stages
+  grads = jax.tree.map(lambda g, p: g.astype(p.dtype)[None], grads, params)
+  return loss, grads
+
+
+def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
+                        stage_params, x, targets, mesh,
+                        num_microbatches: int,
+                        axis_name: str = mesh_lib.AXIS_PIPELINE):
+  """1F1B pipelined loss + gradients in one pass.
+
+  Unlike ``jax.grad`` over :func:`pipeline_apply` (whole-loop AD storing
+  every iteration's activations), the 1F1B schedule interleaves forward
+  and backward in a single loop and keeps only a ``2 * n_stages``-slot
+  stage-input ring per device — constant in the number of microbatches —
+  with one rematerialized stage forward per backward step (the standard
+  1F1B / remat trade). Input/target microbatches are still replicated
+  down the pipe; the saving is in loop residuals.
+
+  Args:
+    stage_fn: ``(params_for_one_stage, activation) -> activation`` with
+      matching input/output shapes.
+    loss_fn: ``(final_activation_micro, target_micro) -> scalar`` (mean
+      over the microbatch), differentiable in its first argument.
+    stage_params: pytree stacked on a leading stage axis of size
+      ``mesh.shape[axis_name]``.
+    x: [batch, ...] inputs; ``targets``: [batch, ...] per-example targets.
+    num_microbatches: must divide batch.
+
+  Returns ``(loss, grads)`` — loss is the mean over the global batch;
+  grads match ``stage_params``' stacked layout.
+  """
+  from jax import shard_map
+
+  x_micro = _split_microbatches(x, num_microbatches, mesh)
+  t_micro = _split_microbatches(targets, num_microbatches, mesh)
+
+  param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+  batch_axes = mesh_lib.data_axes(mesh)
+  x_spec = P(None, batch_axes or None)
+  other_axes = tuple(a for a in mesh.axis_names if a != axis_name)
+  fn = functools.partial(_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn,
+                         axis_name=axis_name, other_axes=other_axes)
+  loss, grads = shard_map(
+      fn, mesh=mesh, in_specs=(param_specs, x_spec, x_spec),
+      out_specs=(P(), param_specs), check_vma=False)(
+          stage_params, x_micro, t_micro)
+  return loss, grads

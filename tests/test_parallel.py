@@ -322,6 +322,80 @@ class TestPipelineParallel:
                                atol=1e-4, rtol=1e-4)
 
 
+class TestPipeline1F1B:
+  """The 1F1B schedule: loss+grads in one interleaved loop with an
+  O(n_stages) activation ring — must agree with plain sequential AD."""
+
+  def _setup(self):
+    from tensorflowonspark_tpu.parallel import pipeline_parallel as PP
+    rng = np.random.RandomState(7)
+    n_stages, d, b = 4, 16, 8
+    W = jnp.asarray(rng.randn(n_stages, d, d) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.randn(b, d), jnp.float32)
+    t = jnp.asarray(rng.randn(b, d), jnp.float32)
+
+    def stage_fn(w, a):
+      return jnp.tanh(a @ w)
+
+    def loss_fn(y, tgt):
+      return jnp.mean((y - tgt) ** 2)
+
+    def seq_loss(W):
+      a = x
+      for i in range(n_stages):
+        a = stage_fn(W[i], a)
+      return loss_fn(a, t)
+
+    return PP, stage_fn, loss_fn, W, x, t, seq_loss
+
+  @pytest.mark.parametrize("n_micro", [2, 4, 8])
+  def test_matches_sequential_grads(self, devices, n_micro):
+    PP, stage_fn, loss_fn, W, x, t, seq_loss = self._setup()
+    mesh = M.build_mesh(M.MeshSpec(pipeline=4), devices=devices[:4])
+    loss, grads = jax.jit(lambda W, x, t: PP.pipeline_train_step(
+        stage_fn, loss_fn, W, x, t, mesh, num_microbatches=n_micro))(W, x, t)
+    np.testing.assert_allclose(float(loss), float(seq_loss(W)),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads),
+                               np.asarray(jax.grad(seq_loss)(W)),
+                               atol=1e-4, rtol=1e-4)
+
+  def test_bf16_params_and_loss(self, devices):
+    """bf16 end-to-end: the loss-vjp cotangent matches the loss dtype and
+    grads accumulate in f32 before casting back to the param dtype."""
+    PP, stage_fn, loss_fn, W, x, t, seq_loss = self._setup()
+    Wb = W.astype(jnp.bfloat16)
+    xb, tb = x.astype(jnp.bfloat16), t.astype(jnp.bfloat16)
+    mesh = M.build_mesh(M.MeshSpec(pipeline=4), devices=devices[:4])
+    loss, grads = jax.jit(lambda W, x, t: PP.pipeline_train_step(
+        stage_fn, loss_fn, W, x, t, mesh, num_microbatches=4))(Wb, xb, tb)
+    assert jax.tree.leaves(grads)[0].dtype == jnp.bfloat16
+    np.testing.assert_allclose(float(loss), float(seq_loss(W)), atol=0.05)
+    np.testing.assert_allclose(np.asarray(grads, np.float32),
+                               np.asarray(jax.grad(seq_loss)(W)),
+                               atol=0.05)
+
+  def test_microbatch_data_divisibility_asserts(self, devices):
+    PP, stage_fn, loss_fn, W, x, t, _ = self._setup()
+    mesh = M.build_mesh(M.MeshSpec(data=2, pipeline=4), devices=devices)
+    with pytest.raises(AssertionError, match="data-axis extent"):
+      PP.pipeline_train_step(stage_fn, loss_fn, W, x, t, mesh,
+                             num_microbatches=8)  # micro_b=1, data=2
+
+  def test_with_data_parallel_axis(self, devices):
+    """DP x PP: per-shard losses/grads pmean over the data axis so the
+    result equals the global-batch computation."""
+    PP, stage_fn, loss_fn, W, x, t, seq_loss = self._setup()
+    mesh = M.build_mesh(M.MeshSpec(data=2, pipeline=4), devices=devices)
+    loss, grads = jax.jit(lambda W, x, t: PP.pipeline_train_step(
+        stage_fn, loss_fn, W, x, t, mesh, num_microbatches=4))(W, x, t)
+    np.testing.assert_allclose(float(loss), float(seq_loss(W)),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads),
+                               np.asarray(jax.grad(seq_loss)(W)),
+                               atol=1e-4, rtol=1e-4)
+
+
 class TestExpertParallel:
   def test_matches_reference(self, devices):
     from tensorflowonspark_tpu.parallel import expert_parallel as EP
