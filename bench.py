@@ -198,7 +198,8 @@ def _chip_peak_flops():
   return gen, profiler.PEAK_BF16_FLOPS[gen]
 
 
-def _bench_transformer(batch=None, loss_impl="full", **cfg_overrides):
+def _bench_transformer(batch=None, seq=None, loss_impl="full",
+                       **cfg_overrides):
   """Decoder-only LM training: tokens/sec + MFU on one chip."""
   import numpy as np
   import jax
@@ -206,12 +207,13 @@ def _bench_transformer(batch=None, loss_impl="full", **cfg_overrides):
   from tensorflowonspark_tpu.models import transformer as tfm
 
   batch = TFM_BATCH if batch is None else batch
+  seq = TFM_SEQ if seq is None else seq
   cfg_overrides.setdefault("remat", TFM_REMAT)
   cfg = tfm.TransformerConfig(
       vocab_size=TFM_VOCAB, num_layers=TFM_LAYERS, num_heads=TFM_HEADS,
-      d_model=TFM_DMODEL, d_ff=TFM_DFF, max_seq_len=TFM_SEQ,
+      d_model=TFM_DMODEL, d_ff=TFM_DFF, max_seq_len=seq,
       **cfg_overrides)
-  state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=TFM_SEQ)
+  state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=seq)
   n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))
 
   def train_step(state, tokens):
@@ -229,16 +231,16 @@ def _bench_transformer(batch=None, loss_impl="full", **cfg_overrides):
     return state.apply_gradients(grads=grads), loss
 
   rng = np.random.RandomState(0)
-  tokens = jnp.asarray(rng.randint(0, TFM_VOCAB, (batch, TFM_SEQ)),
+  tokens = jnp.asarray(rng.randint(0, TFM_VOCAB, (batch, seq)),
                        jnp.int32)
 
   steps_per_sec = _steps_per_sec(train_step, state, (tokens,),
                                  TFM_MEASURE, "transformer")
 
   from tensorflowonspark_tpu.utils import profiler
-  tokens_per_sec = batch * TFM_SEQ * steps_per_sec
+  tokens_per_sec = batch * seq * steps_per_sec
   flops_per_token = profiler.transformer_flops_per_token(
-      n_params, TFM_LAYERS, TFM_DMODEL, TFM_SEQ)
+      n_params, TFM_LAYERS, TFM_DMODEL, seq)
   gen, peak = _chip_peak_flops()
   mfu = profiler.mfu(flops_per_token, tokens_per_sec, peak)
   return {"transformer_tokens_per_sec": round(tokens_per_sec, 1),
@@ -299,6 +301,30 @@ def _bench_long_context():
 _PARTIAL = {"value": 0.0, "extra": None}
 
 
+def _sweep():
+  """MFU-hunt mode (`TOS_BENCH_SWEEP=1`, manual runs only — the driver
+  contract of one JSON line does not apply): measure the transformer bench
+  across the candidate configs from the round-2 verdict (fused QKV on
+  chip, s=2048, fused-vs-flax LayerNorm) and print one JSON object with
+  all of them."""
+  results = {}
+  for name, kw in [
+      ("b16_s1024_base", {}),
+      ("b16_s1024_fuseqkv", {"fuse_qkv": True}),
+      ("b16_s1024_flaxln", {"layer_norm_impl": "flax"}),
+      ("b8_s2048", {"batch": 8, "seq": 2048}),
+      ("b8_s2048_fuseqkv", {"batch": 8, "seq": 2048, "fuse_qkv": True}),
+  ]:
+    try:
+      r = _bench_transformer(**kw)
+      results[name] = {"tok_s": r["transformer_tokens_per_sec"],
+                       "mfu": r["transformer_mfu"]}
+    except Exception as e:  # noqa: BLE001 - keep sweeping
+      results[name] = {"error": str(e)[:200]}
+    sys.stderr.write("sweep %s: %r\n" % (name, results[name]))
+  print(json.dumps({"sweep": results}))
+
+
 def main():
   import time as _time
   # preflight gets its own watchdog (budget + margin): subprocess.run can
@@ -321,6 +347,10 @@ def main():
 
   import jax
   sys.stderr.write("bench devices: %r\n" % (jax.devices(),))
+
+  if os.environ.get("TOS_BENCH_SWEEP"):
+    _sweep()
+    return
 
   img_per_sec = _bench_resnet()
   _PARTIAL["value"] = img_per_sec
