@@ -772,3 +772,103 @@ def create_sharded_state(rng, cfg: TransformerConfig, mesh,
   params_init, make_state = _init_fns(rng, cfg, mesh, learning_rate, seq_len,
                                       init_batch=init_batch, tx=tx)
   return sh.init_sharded_state(params_init, make_state, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel training (1F1B over the block stack)
+# ---------------------------------------------------------------------------
+
+def pipeline_partition_params(params, n_stages: int):
+  """Split a Transformer param tree for the 1F1B pipeline.
+
+  Returns ``(outer_params, stage_params)``: the embedding table and final
+  norm stay outer (first/last stage work); the homogeneous ``layer_i``
+  blocks stack into ``[n_stages, layers_per_stage, ...]`` leaves, stage
+  ``s`` owning the contiguous chunk ``[s*k, (s+1)*k)``.
+  """
+  num_layers = sum(1 for k in params if k.startswith("layer_"))
+  assert num_layers % n_stages == 0, \
+      "%d layers do not split into %d stages" % (num_layers, n_stages)
+  k = num_layers // n_stages
+  layers = [params["layer_%d" % i] for i in range(num_layers)]
+  stage = jax.tree.map(
+      lambda *ls: jnp.stack(ls).reshape((n_stages, k) + ls[0].shape), *layers)
+  # everything that is not a pipelined block is outer (first/last stage
+  # work) — keyed negatively so model variants with extra top-level params
+  # (untied head, learned positions) are carried instead of silently lost
+  outer = {key: v for key, v in params.items()
+           if not key.startswith("layer_")}
+  return outer, stage
+
+
+def pipeline_unpartition_grads(g_outer, g_stage, num_layers: int):
+  """Rebuild the full param-tree layout from pipeline grads."""
+  flat = jax.tree.map(
+      lambda g: g.reshape((num_layers,) + g.shape[2:]), g_stage)
+  tree = dict(g_outer)
+  for i in range(num_layers):
+    tree["layer_%d" % i] = jax.tree.map(lambda g, _i=i: g[_i], flat)
+  return tree
+
+
+def make_pipeline_train_step(cfg: TransformerConfig, mesh,
+                             num_microbatches: int):
+  """A ``(params, tokens) -> (loss, grads)`` step training the Transformer
+  with the 1F1B schedule over the mesh's ``pipeline`` axis.
+
+  Stage sharding is explicit in the pipeline's shard_map, so blocks run
+  with ``mesh=None`` (no inner sharding constraints); the embed runs on
+  the first stage and the final-norm + tied projection + loss on the last,
+  via ``parallel.pipeline_parallel.pipeline_lm_train_step`` — the tied
+  table's embed- and head-side grad contributions are summed across those
+  stages. Homogeneous layers only (``moe_experts == 0``: MoE layers have a
+  different param tree and cannot stack into uniform stages).
+  """
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  from tensorflowonspark_tpu.parallel import pipeline_parallel as PP
+
+  assert cfg.moe_experts == 0, "pipeline stages must be homogeneous"
+  n_stages = mesh.shape[mesh_lib.AXIS_PIPELINE]
+  block = Block(cfg, None)
+  embed_mod = TiedEmbed(cfg, None)
+  ln_f = _make_layer_norm(cfg, None, "ln_f")
+
+  def embed_fn(outer, tokens):
+    x = embed_mod.apply({"params": outer["embed"]}, tokens)
+    return x.astype(cfg.dtype)
+
+  def stage_fn(stage_p, x):
+    positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+
+    def body(carry, layer_p):
+      return block.apply({"params": layer_p}, carry, positions), None
+
+    x, _ = lax.scan(body, x, stage_p)
+    return x
+
+  def head_loss_fn(outer, x, targets):
+    x = ln_f.apply({"params": outer["ln_f"]}, x)
+    # the one tied-projection definition (TiedEmbed.attend), not a copy
+    logits = embed_mod.apply({"params": outer["embed"]}, x.astype(cfg.dtype),
+                             method="attend")
+    return causal_lm_loss(logits.astype(jnp.float32), targets)
+
+  def partitioned_step(outer, stage, tokens):
+    """(outer_params, stage_params, tokens) -> (loss, g_outer, g_stage) —
+    for training loops that keep params (and optimizer state) in the
+    pipeline layout across steps, avoiding the per-step restack."""
+    return PP.pipeline_lm_train_step(
+        embed_fn, stage_fn, head_loss_fn, outer, stage, tokens, tokens,
+        mesh, num_microbatches)
+
+  def step(params, tokens):
+    # convenience layout: restacks the layer tree each step — fine for
+    # validation/small models; large-scale loops should hold the
+    # partitioned layout and call ``step.partitioned`` directly
+    outer, stage = pipeline_partition_params(params, n_stages)
+    loss, g_outer, g_stage = partitioned_step(outer, stage, tokens)
+    return loss, pipeline_unpartition_grads(g_outer, g_stage,
+                                            cfg.num_layers)
+
+  step.partitioned = partitioned_step
+  return step

@@ -131,97 +131,6 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh,
   return out.reshape(x.shape)
 
 
-def _1f1b_local(stage_params, x_micro, t_micro, stage_fn: Callable,
-                loss_fn: Callable, axis_name: str, other_axes: tuple):
-  """shard_map body: the 1F1B schedule for one device (= one stage).
-
-  Per global step ``t`` every stage runs, in lockstep:
-
-  - a FORWARD of microbatch ``m_f = t - s`` (masked outside
-    ``[0, n_micro)``), storing its input in a ring buffer of ``2S`` slots;
-  - a BACKWARD of microbatch ``m_b = t - (2S - 1) + s``: the stage input
-    is read back from the ring, the stage forward is rematerialized under
-    ``jax.vjp``, and the incoming cotangent is the next stage's grad from
-    the previous step (the last stage seeds from the loss). Ring-slot
-    lifetime analysis: input of ``m`` is written at ``t = m + s`` and read
-    at ``t = m + 2S - 1 - s``, a gap of at most ``2S - 1`` steps, so 2S
-    slots never collide.
-
-  Activations flow ``s -> s+1`` and cotangents ``s -> s-1`` by ppermute,
-  one hop per step; total steps ``n_micro + 2S - 1``.
-  """
-  S = lax.axis_size(axis_name)
-  s = lax.axis_index(axis_name)
-  n_micro = x_micro.shape[0]
-  ring = 2 * S
-  total_steps = n_micro + 2 * S - 1
-  inv_micro = jnp.float32(1.0 / n_micro)
-
-  fwd_perm = [(i, (i + 1) % S) for i in range(S)]
-  bwd_perm = [(i, (i - 1) % S) for i in range(S)]
-
-  params = jax.tree.map(lambda p: p[0], stage_params)  # squeeze stage axis
-  act0 = jnp.zeros_like(x_micro[0])
-  ring0 = jnp.zeros((ring,) + x_micro.shape[1:], x_micro.dtype)
-  # accumulate grads in f32 (like loss_acc): summing n_micro pre-scaled
-  # contributions in bf16 would swamp the small addends
-  grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-
-  def body(t, carry):
-    fwd_recv, bwd_recv, ring_buf, grads, loss_acc = carry
-
-    # ---- forward slot: microbatch t - s enters this stage ----
-    m_f = t - s
-    f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
-    mf_c = jnp.clip(m_f, 0, n_micro - 1)
-    inj = lax.dynamic_index_in_dim(x_micro, mf_c, 0, keepdims=False)
-    inp = jnp.where(s == 0, inj, fwd_recv)
-    slot_f = mf_c % ring
-    cur = lax.dynamic_index_in_dim(ring_buf, slot_f, 0, keepdims=False)
-    ring_buf = lax.dynamic_update_index_in_dim(
-        ring_buf, jnp.where(f_valid, inp, cur), slot_f, 0)
-    y = stage_fn(params, inp)
-
-    # ---- backward slot: microbatch t - (2S-1) + s leaves this stage ----
-    m_b = t - (2 * S - 1) + s
-    b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
-    mb_c = jnp.clip(m_b, 0, n_micro - 1)
-    saved = lax.dynamic_index_in_dim(ring_buf, mb_c % ring, 0,
-                                     keepdims=False)
-    y_b, vjp_fn = jax.vjp(stage_fn, params, saved)
-    tgt = lax.dynamic_index_in_dim(t_micro, mb_c, 0, keepdims=False)
-    lval, loss_vjp = jax.vjp(loss_fn, y_b, tgt)
-    # cotangent dtype must match the loss primal's (bf16 losses included)
-    g_loss = loss_vjp(inv_micro.astype(lval.dtype))[0]
-    g_in = jnp.where(s == S - 1, g_loss.astype(y_b.dtype), bwd_recv)
-    g_par, g_x = vjp_fn(g_in)
-    grads = jax.tree.map(
-        lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)).astype(
-            jnp.float32),
-        grads, g_par)
-    loss_acc = loss_acc + jnp.where(
-        jnp.logical_and(b_valid, s == S - 1), lval.astype(jnp.float32), 0.0)
-
-    fwd_recv = lax.ppermute(y, axis_name, fwd_perm)
-    bwd_recv = lax.ppermute(g_x, axis_name, bwd_perm)
-    return fwd_recv, bwd_recv, ring_buf, grads, loss_acc
-
-  _, _, _, grads, loss_acc = lax.fori_loop(
-      0, total_steps, body, (act0, act0, ring0, grads0,
-                             jnp.zeros((), jnp.float32)))
-
-  # only the last stage accumulated loss; share it down the pipe, and
-  # average loss/grads over the data (and any other non-pipeline) axes
-  loss = lax.psum(loss_acc, axis_name) * inv_micro
-  if other_axes:
-    loss = lax.pmean(loss, other_axes)
-    grads = jax.tree.map(lambda g: lax.pmean(g, other_axes), grads)
-  # back to the param dtype, re-growing the leading stage axis so
-  # out_spec P(axis_name) stacks stages
-  grads = jax.tree.map(lambda g, p: g.astype(p.dtype)[None], grads, params)
-  return loss, grads
-
-
 def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                         stage_params, x, targets, mesh,
                         num_microbatches: int,
@@ -249,19 +158,194 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
   Returns ``(loss, grads)`` — loss is the mean over the global batch;
   grads match ``stage_params``' stacked layout.
   """
+  # the degenerate full-model pipe: identity embed, no outer params, the
+  # head is just the loss — ONE implementation of the schedule invariants
+  loss, _, grads = pipeline_lm_train_step(
+      lambda _outer, xx: xx, stage_fn,
+      lambda _outer, y, tgt: loss_fn(y, tgt),
+      {}, stage_params, x, targets, mesh, num_microbatches,
+      axis_name=axis_name)
+  return loss, grads
+
+
+def _1f1b_lm_local(outer_params, stage_params, tok_micro, tgt_micro,
+                   embed_fn: Callable, stage_fn: Callable,
+                   head_loss_fn: Callable, axis_name: str,
+                   other_axes: tuple):
+  """shard_map body: the 1F1B schedule for one device (= one stage), with
+  embed on stage 0, the block stack pipelined, head+loss on the last stage.
+
+  The schedule — per global step ``t`` every stage runs, in lockstep:
+
+  - a FORWARD of microbatch ``m_f = t - s`` (masked outside
+    ``[0, n_micro)``), storing its input in a ring buffer of ``2S`` slots.
+    Stage 0's forward slot first embeds the entering microbatch's tokens
+    (``lax.cond`` keeps the embed off other stages);
+  - a BACKWARD of microbatch ``m_b = t - (2S - 1) + s``: the stage input
+    is read back from the ring, the stage forward is rematerialized under
+    ``jax.vjp``, and the incoming cotangent is the next stage's grad from
+    the previous step. The last stage's backward slot runs head+loss under
+    ``jax.vjp`` w.r.t. ``outer_params``, seeding the cotangent chain;
+    stage 0's backward slot pushes its input cotangent through the embed's
+    vjp, accumulating the embed side of ``outer_params``' grads. With tied
+    embeddings the table's two contributions live on different stages and
+    are summed by the closing psum over the pipeline axis.
+
+  Ring-slot lifetime analysis: input of ``m`` is written at ``t = m + s``
+  and read at ``t = m + 2S - 1 - s``, a gap of at most ``2S - 1`` steps,
+  so 2S slots never collide. Activations flow ``s -> s+1`` and cotangents
+  ``s -> s-1`` by ppermute, one hop per step; total steps
+  ``n_micro + 2S - 1``. Grads accumulate in f32 (summing n_micro
+  pre-scaled contributions in bf16 would swamp the small addends) and are
+  cast back to the param dtype at the end.
+  """
+  S = lax.axis_size(axis_name)
+  s = lax.axis_index(axis_name)
+  n_micro = tok_micro.shape[0]
+  ring = 2 * S
+  total_steps = n_micro + 2 * S - 1
+  inv_micro = jnp.float32(1.0 / n_micro)
+
+  fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+  bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+  params = jax.tree.map(lambda p: p[0], stage_params)
+  act_sd = jax.eval_shape(embed_fn, outer_params, tok_micro[0])
+  act0 = jnp.zeros(act_sd.shape, act_sd.dtype)
+  ring0 = jnp.zeros((ring,) + act0.shape, act0.dtype)
+  g_stage0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+  g_outer0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                          outer_params)
+
+  def body(t, carry):
+    fwd_recv, bwd_recv, ring_buf, g_stage, g_outer, loss_acc = carry
+
+    # ---- forward slot ----
+    m_f = t - s
+    f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
+    mf_c = jnp.clip(m_f, 0, n_micro - 1)
+    tok_f = lax.dynamic_index_in_dim(tok_micro, mf_c, 0, keepdims=False)
+    inj = lax.cond(s == 0,
+                   lambda tok: embed_fn(outer_params, tok).astype(act0.dtype),
+                   lambda tok: act0, tok_f)
+    inp = jnp.where(s == 0, inj, fwd_recv)
+    slot_f = mf_c % ring
+    cur = lax.dynamic_index_in_dim(ring_buf, slot_f, 0, keepdims=False)
+    ring_buf = lax.dynamic_update_index_in_dim(
+        ring_buf, jnp.where(f_valid, inp, cur), slot_f, 0)
+    y = stage_fn(params, inp)
+
+    # ---- backward slot ----
+    m_b = t - (2 * S - 1) + s
+    b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
+    mb_c = jnp.clip(m_b, 0, n_micro - 1)
+    saved = lax.dynamic_index_in_dim(ring_buf, mb_c % ring, 0,
+                                     keepdims=False)
+    y_b, vjp_fn = jax.vjp(stage_fn, params, saved)
+    tgt = lax.dynamic_index_in_dim(tgt_micro, mb_c, 0, keepdims=False)
+
+    def _head(operand):
+      yb, tg = operand
+      lval, head_vjp = jax.vjp(
+          lambda op, yy: head_loss_fn(op, yy, tg), outer_params, yb)
+      g_o, g_y = head_vjp(inv_micro.astype(lval.dtype))
+      return (lval.astype(jnp.float32), g_o, g_y.astype(yb.dtype))
+
+    def _no_head(operand):
+      yb, tg = operand
+      return (jnp.zeros((), jnp.float32),
+              jax.tree.map(jnp.zeros_like, outer_params),
+              jnp.zeros_like(yb))
+
+    lval, g_outer_h, g_seed = lax.cond(s == S - 1, _head, _no_head,
+                                       (y_b, tgt))
+    g_in = jnp.where(s == S - 1, g_seed, bwd_recv)
+    g_par, g_x = vjp_fn(g_in)
+
+    tok_b = lax.dynamic_index_in_dim(tok_micro, mb_c, 0, keepdims=False)
+
+    def _embed_bwd(operand):
+      gx, tok = operand
+      _, embed_vjp = jax.vjp(lambda op: embed_fn(op, tok), outer_params)
+      return embed_vjp(gx)[0]
+
+    def _no_embed_bwd(operand):
+      return jax.tree.map(jnp.zeros_like, outer_params)
+
+    g_outer_e = lax.cond(s == 0, _embed_bwd, _no_embed_bwd, (g_x, tok_b))
+
+    g_stage = jax.tree.map(
+        lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)).astype(
+            jnp.float32),
+        g_stage, g_par)
+    g_outer = jax.tree.map(
+        lambda a, gh, ge: a + jnp.where(
+            b_valid, (gh.astype(jnp.float32) + ge.astype(jnp.float32)),
+            0.0),
+        g_outer, g_outer_h, g_outer_e)
+    loss_acc = loss_acc + jnp.where(b_valid, lval, 0.0)
+
+    fwd_recv = lax.ppermute(y, axis_name, fwd_perm)
+    bwd_recv = lax.ppermute(g_x, axis_name, bwd_perm)
+    return fwd_recv, bwd_recv, ring_buf, g_stage, g_outer, loss_acc
+
+  _, _, _, g_stage, g_outer, loss_acc = lax.fori_loop(
+      0, total_steps, body,
+      (act0, act0, ring0, g_stage0, g_outer0, jnp.zeros((), jnp.float32)))
+
+  loss = lax.psum(loss_acc, axis_name) * inv_micro
+  # outer grads live on stages 0 and S-1 only; psum joins them (and, for a
+  # tied table, sums its embed- and head-side contributions)
+  g_outer = jax.tree.map(lambda g: lax.psum(g, axis_name), g_outer)
+  if other_axes:
+    loss = lax.pmean(loss, other_axes)
+    g_stage = jax.tree.map(lambda g: lax.pmean(g, other_axes), g_stage)
+    g_outer = jax.tree.map(lambda g: lax.pmean(g, other_axes), g_outer)
+  g_stage = jax.tree.map(lambda g, p: g.astype(p.dtype)[None], g_stage,
+                         params)
+  g_outer = jax.tree.map(lambda g, p: g.astype(p.dtype), g_outer,
+                         outer_params)
+  return loss, g_outer, g_stage
+
+
+def pipeline_lm_train_step(embed_fn: Callable, stage_fn: Callable,
+                           head_loss_fn: Callable, outer_params,
+                           stage_params, tokens, targets, mesh,
+                           num_microbatches: int,
+                           axis_name: str = mesh_lib.AXIS_PIPELINE):
+  """Full-model 1F1B training step: embed → pipelined stages → head/loss.
+
+  Args:
+    embed_fn: ``(outer_params, tokens_micro) -> activation`` — runs on the
+      first stage only.
+    stage_fn: ``(stage_params_one, activation) -> activation`` — the
+      pipelined body (e.g. a chunk of Transformer blocks).
+    head_loss_fn: ``(outer_params, activation, targets_micro) -> scalar``
+      mean loss over the microbatch — runs on the last stage only. May
+      share params with ``embed_fn`` (tied embeddings): each param's grad
+      is the sum of both contributions.
+    outer_params: everything outside the pipelined stages (embedding
+      table, final norm, head) — replicated along the pipeline axis.
+    stage_params: pytree stacked on a leading stage axis.
+    tokens/targets: [batch, ...] int inputs and targets.
+
+  Returns ``(loss, outer_grads, stage_grads)``.
+  """
   from jax import shard_map
 
-  x_micro = _split_microbatches(x, num_microbatches, mesh)
-  t_micro = _split_microbatches(targets, num_microbatches, mesh)
+  tok_micro = _split_microbatches(tokens, num_microbatches, mesh)
+  tgt_micro = _split_microbatches(targets, num_microbatches, mesh)
 
-  param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+  stage_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+  outer_specs = jax.tree.map(lambda _: P(), outer_params)
   batch_axes = mesh_lib.data_axes(mesh)
   x_spec = P(None, batch_axes or None)
   other_axes = tuple(a for a in mesh.axis_names if a != axis_name)
-  fn = functools.partial(_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn,
+  fn = functools.partial(_1f1b_lm_local, embed_fn=embed_fn,
+                         stage_fn=stage_fn, head_loss_fn=head_loss_fn,
                          axis_name=axis_name, other_axes=other_axes)
-  loss, grads = shard_map(
-      fn, mesh=mesh, in_specs=(param_specs, x_spec, x_spec),
-      out_specs=(P(), param_specs), check_vma=False)(
-          stage_params, x_micro, t_micro)
-  return loss, grads
+  return shard_map(
+      fn, mesh=mesh,
+      in_specs=(outer_specs, stage_specs, x_spec, x_spec),
+      out_specs=(P(), outer_specs, stage_specs), check_vma=False)(
+          outer_params, stage_params, tok_micro, tgt_micro)
