@@ -829,7 +829,10 @@ def make_pipeline_train_step(cfg: TransformerConfig, mesh,
 
   assert cfg.moe_experts == 0, "pipeline stages must be homogeneous"
   n_stages = mesh.shape[mesh_lib.AXIS_PIPELINE]
-  block = Block(cfg, None)
+  # honor cfg.remat like the dense path does: the per-microbatch stage vjp
+  # otherwise stores every intra-block intermediate for all
+  # layers-per-stage blocks — the regime where remat matters most
+  block = (nn.remat(Block) if cfg.remat else Block)(cfg, None)
   embed_mod = TiedEmbed(cfg, None)
   ln_f = _make_layer_norm(cfg, None, "ln_f")
 

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 
 
@@ -524,3 +525,20 @@ class TestTransformerPipeline:
     flat_a, _ = jax.flatten_util.ravel_pytree(params)
     flat_b, _ = jax.flatten_util.ravel_pytree(rebuilt)
     np.testing.assert_array_equal(np.asarray(flat_a), np.asarray(flat_b))
+
+  def test_remat_stages_match(self):
+    """cfg.remat=True must checkpoint stage blocks without changing math."""
+    import dataclasses
+    from tensorflowonspark_tpu.parallel import mesh as M
+    tfm, cfg, params, tokens, ref_loss = self._setup()
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    mesh = M.build_mesh(M.MeshSpec(pipeline=4), devices=jax.devices()[:4])
+    step = tfm.make_pipeline_train_step(cfg_r, mesh, num_microbatches=4)
+    loss, grads = jax.jit(step)(params, tokens)
+    l_ref, g_ref = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss), float(l_ref),
+                               atol=1e-5, rtol=1e-5)
+    flat_p, _ = jax.flatten_util.ravel_pytree(grads)
+    flat_r, _ = jax.flatten_util.ravel_pytree(g_ref)
+    np.testing.assert_allclose(np.asarray(flat_p), np.asarray(flat_r),
+                               atol=2e-4, rtol=2e-4)
