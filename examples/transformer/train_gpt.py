@@ -29,6 +29,10 @@ if __name__ == "__main__":
   parser.add_argument("--fsdp", type=int, default=1)
   parser.add_argument("--sp", type=int, default=1)
   parser.add_argument("--tp", type=int, default=1)
+  parser.add_argument("--pp", type=int, default=1,
+                      help="pipeline stages: >1 trains through the 1F1B "
+                           "schedule (layers split into contiguous stages)")
+  parser.add_argument("--microbatches", type=int, default=4)
   parser.add_argument("--layers", type=int, default=4)
   parser.add_argument("--d_model", type=int, default=256)
   parser.add_argument("--heads", type=int, default=8)
@@ -41,12 +45,57 @@ if __name__ == "__main__":
                            "[B,chunk,V] instead of [B,S,V])")
   args = parser.parse_args()
 
+  import time
+
   import numpy as np
   import jax
   import jax.numpy as jnp
   from tensorflowonspark_tpu.models import transformer as tfm
   from tensorflowonspark_tpu.parallel import mesh as M
   from tensorflowonspark_tpu.parallel import sharding as SH
+
+  def run_loop(step, state, tokens):
+    for i in range(args.steps):
+      t0 = time.time()
+      state, loss = step(state, tokens)
+      print("step %d loss %.4f (%.0f ms)"
+            % (i, float(loss), 1000 * (time.time() - t0)))
+    print("done; tokens/step = %d" % (args.batch * args.seq_len))
+
+  rng = np.random.RandomState(0)
+  data = rng.randint(0, args.vocab, (args.batch, args.seq_len))
+
+  if args.pp > 1:
+    # 1F1B pipeline path: DP x PP mesh, blocks split into contiguous
+    # stages, constant activation memory in the microbatch count
+    if args.fsdp > 1 or args.sp > 1 or args.tp > 1 or args.blocked_loss:
+      parser.error("--pp composes with --dp only "
+                   "(--fsdp/--sp/--tp/--blocked_loss are the SPMD path)")
+    if args.dp == -1:
+      args.dp = max(1, len(jax.devices()) // args.pp)
+    micro_b = args.batch // args.microbatches
+    if args.batch % args.microbatches or micro_b % args.dp:
+      parser.error(
+          "batch %d must split into %d microbatches divisible by dp=%d "
+          "(e.g. --batch %d)" % (args.batch, args.microbatches, args.dp,
+                                 args.microbatches * args.dp))
+    mesh = M.build_mesh(M.MeshSpec(data=args.dp, pipeline=args.pp))
+    print("mesh:", dict(mesh.shape))
+    cfg = tfm.TransformerConfig(
+        vocab_size=args.vocab, num_layers=args.layers,
+        num_heads=args.heads, d_model=args.d_model,
+        d_ff=args.d_model * 4, max_seq_len=args.seq_len)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg,
+                             seq_len=args.seq_len)
+    pipe = tfm.make_pipeline_train_step(cfg, mesh, args.microbatches)
+
+    @jax.jit
+    def pp_step(state, tokens):
+      loss, grads = pipe(state.params, tokens)
+      return state.apply_gradients(grads=grads), loss
+
+    run_loop(pp_step, state, jnp.asarray(data, jnp.int32))
+    sys.exit(0)
 
   mesh = M.build_mesh(M.MeshSpec(data=args.dp, fsdp=args.fsdp,
                                  sequence=args.sp, tensor=args.tp))
@@ -73,16 +122,6 @@ if __name__ == "__main__":
   step = SH.make_train_step(loss_fn, mesh, sharding,
                             batch_extra_axes=(M.AXIS_SEQUENCE,))
 
-  rng = np.random.RandomState(0)
-  data = rng.randint(0, args.vocab, (args.batch, args.seq_len))
   tokens = SH.shard_batch(jnp.asarray(data, jnp.int32), mesh,
                           extra_axes=(M.AXIS_SEQUENCE,))
-
-  import time
-  for i in range(args.steps):
-    t0 = time.time()
-    state, loss = step(state, tokens)
-    loss = float(loss)
-    print("step %d loss %.4f (%.0f ms)" % (i, loss,
-                                           1000 * (time.time() - t0)))
-  print("done; tokens/step = %d" % (args.batch * args.seq_len))
+  run_loop(step, state, tokens)
