@@ -312,6 +312,9 @@ def _sweep():
       ("b16_s1024_base", {}),
       ("b16_s1024_fuseqkv", {"fuse_qkv": True}),
       ("b16_s1024_flaxln", {"layer_norm_impl": "flax"}),
+      ("b16_s1024_lnmm", {"ln_matmul_impl": "fused"}),
+      ("b16_s1024_lnmm_fuseqkv", {"ln_matmul_impl": "fused",
+                                  "fuse_qkv": True}),
       ("b8_s2048", {"batch": 8, "seq": 2048}),
       ("b8_s2048_fuseqkv", {"batch": 8, "seq": 2048, "fuse_qkv": True}),
   ]:

@@ -54,6 +54,13 @@ class TransformerConfig:
   # "fused" forces the kernel everywhere (interpret mode off-TPU — how CPU
   # CI exercises the production code path); "flax" opts out
   layer_norm_impl: str = "auto"
+  # "fused": the ln2 -> MLP up-projection pair runs as ONE Pallas kernel
+  # (ops.ln_matmul) — the normalized activation never round-trips HBM
+  # (interpret mode off-TPU). Applies in mesh-free contexts (single-chip
+  # training, pipeline stage bodies); with a mesh the pair stays unfused.
+  # Param tree is IDENTICAL either way (ln2/scale, mlp/up/kernel), so
+  # checkpoints are interchangeable across settings. "off" opts out.
+  ln_matmul_impl: str = "off"
   # Mixture-of-experts: when moe_experts > 0, every `moe_every`-th layer
   # (moe_every >= 1) replaces its dense MLP with an expert-routed FFN
   # (parallel.expert_parallel; experts shard over the `expert` mesh axis)
@@ -90,6 +97,9 @@ class TransformerConfig:
     if self.embed_lookup not in ("gather", "one_hot"):
       raise ValueError("embed_lookup must be 'gather' or 'one_hot', got %r"
                        % (self.embed_lookup,))
+    if self.ln_matmul_impl not in ("off", "fused"):
+      raise ValueError("ln_matmul_impl must be 'off' or 'fused', got %r"
+                       % (self.ln_matmul_impl,))
 
   @property
   def head_dim(self) -> int:
@@ -311,15 +321,40 @@ class Attention(nn.Module):
     return self._out_proj(out)
 
 
+class _UpKernel(nn.Module):
+  """Declares the MLP up-projection kernel at the same param path
+  (``mlp/up/kernel``) nn.Dense would, for the fused-LN path that feeds it
+  to ops.ln_matmul instead of a Dense call."""
+  d_model: int
+  d_ff: int
+
+  @nn.compact
+  def __call__(self):
+    return self.param(
+        "kernel",
+        nn.with_logical_partitioning(nn.initializers.lecun_normal(),
+                                     ("embed", "mlp")),
+        (self.d_model, self.d_ff), jnp.float32)
+
+
 class MLPBlock(nn.Module):
   cfg: TransformerConfig
 
   @nn.compact
-  def __call__(self, x):
+  def __call__(self, x, ln_scale=None):
+    """With ``ln_scale`` (the preceding LayerNorm's weight), the norm and
+    the up-projection run as one Pallas kernel over the RAW ``x``; without
+    it, ``x`` is expected already normalized (the regular path)."""
     cfg = self.cfg
-    h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
-                 kernel_init=nn.with_logical_partitioning(
-                     nn.initializers.lecun_normal(), ("embed", "mlp")))(x)
+    if ln_scale is not None:
+      from tensorflowonspark_tpu.ops import ln_matmul as _lnmm
+      kernel = _UpKernel(cfg.d_model, cfg.d_ff, name="up")()
+      h = _lnmm.ln_matmul(x, ln_scale, kernel.astype(cfg.dtype),
+                          interpret=jax.default_backend() != "tpu")
+    else:
+      h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
+                   kernel_init=nn.with_logical_partitioning(
+                       nn.initializers.lecun_normal(), ("embed", "mlp")))(x)
     h = nn.gelu(h)
     return nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False,
                     name="down",
@@ -397,6 +432,18 @@ def _constrain(x, spec, mesh):
                                     mesh=mesh)
 
 
+class _LNScale(nn.Module):
+  """Declares a LayerNorm scale at the same param path ("<name>/scale")
+  the norm modules would, for the fused ln+matmul path that consumes the
+  raw activations plus this weight in one kernel."""
+  features: int
+
+  @nn.compact
+  def __call__(self):
+    return self.param("scale", nn.initializers.ones, (self.features,),
+                      jnp.float32)
+
+
 class Block(nn.Module):
   cfg: TransformerConfig
   mesh: Optional[Any] = None
@@ -408,11 +455,18 @@ class Block(nn.Module):
     y = _make_layer_norm(cfg, self.mesh, "ln1")(x)
     x = x + Attention(cfg, self.mesh, name="attn")(y, positions,
                                                    decode=decode)
-    y = _make_layer_norm(cfg, self.mesh, "ln2")(x)
-    if self.use_moe:
-      x = x + MoEBlock(cfg, self.mesh, name="moe")(y)
+    if (cfg.ln_matmul_impl == "fused" and self.mesh is None
+        and not self.use_moe and not decode):
+      # ln2 + up-projection as ONE kernel over the raw residual stream;
+      # same param paths as the unfused branch (ln2/scale, mlp/up/kernel)
+      scale = _LNScale(cfg.d_model, name="ln2")()
+      x = x + MLPBlock(cfg, name="mlp")(x, ln_scale=scale)
     else:
-      x = x + MLPBlock(cfg, name="mlp")(y)
+      y = _make_layer_norm(cfg, self.mesh, "ln2")(x)
+      if self.use_moe:
+        x = x + MoEBlock(cfg, self.mesh, name="moe")(y)
+      else:
+        x = x + MLPBlock(cfg, name="mlp")(y)
     if decode:
       return x
     return _constrain(x, ("batch", "sequence", "embed"), self.mesh)

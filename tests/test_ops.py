@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 
 from tensorflowonspark_tpu.ops import flash_attention, layer_norm
@@ -171,3 +172,80 @@ class TestFlashAttention:
     out = flash_attention(q, k, v, blk_q=32, blk_k=32, interpret=True)
     ref = ra.full_attention(q, k, v, causal=True)
     assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+
+class TestLNMatmul:
+  """Fused LayerNorm + matmul (ops.ln_matmul): LN(x) @ W in one kernel."""
+
+  def _ref(self, x, w, W, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = ((xf - mu) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32))
+    return (y.astype(x.dtype) @ W).astype(x.dtype)
+
+  def test_forward_matches_reference(self):
+    from tensorflowonspark_tpu.ops.ln_matmul import ln_matmul
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 32, 128), jnp.float32)
+    w = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    W = jnp.asarray(rng.randn(128, 256) * 0.1, jnp.float32)
+    out = ln_matmul(x, w, W, blk_rows=32, blk_cols=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(self._ref(x, w, W)),
+                               atol=1e-4, rtol=1e-4)
+
+  def test_gradients_match_reference(self):
+    from tensorflowonspark_tpu.ops.ln_matmul import ln_matmul
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(48, 96), jnp.float32)
+    w = jnp.asarray(rng.rand(96) + 0.5, jnp.float32)
+    W = jnp.asarray(rng.randn(96, 80) * 0.1, jnp.float32)
+    gk = jax.grad(lambda *a: jnp.sum(
+        ln_matmul(*a, interpret=True) ** 2), argnums=(0, 1, 2))(x, w, W)
+    gr = jax.grad(lambda *a: jnp.sum(
+        self._ref(*a) ** 2), argnums=(0, 1, 2))(x, w, W)
+    for a, b in zip(gk, gr):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 atol=2e-3, rtol=2e-3)
+
+  def test_bfloat16(self):
+    from tensorflowonspark_tpu.ops.ln_matmul import ln_matmul
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 16, 128), jnp.bfloat16)
+    w = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    W = jnp.asarray(rng.randn(128, 256) * 0.1, jnp.bfloat16)
+    out = ln_matmul(x, w, W, interpret=True)
+    assert out.dtype == jnp.bfloat16 and out.shape == (2, 16, 256)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(self._ref(x, w, W),
+                                                np.float32), atol=0.1)
+
+  def test_model_fused_matches_unfused(self):
+    """ln_matmul_impl='fused' changes neither the param tree nor the
+    math of the Transformer (ln2+up as one kernel)."""
+    import dataclasses
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                                d_model=64, d_ff=128, max_seq_len=16,
+                                dtype=jnp.float32, remat=False)
+    cfg_f = dataclasses.replace(cfg, ln_matmul_impl="fused")
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+    state_f = tfm.create_state(jax.random.PRNGKey(0), cfg_f, seq_len=16)
+    assert (jax.tree.structure(state.params)
+            == jax.tree.structure(state_f.params))
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+
+    def loss(c, p):
+      return tfm.causal_lm_loss(
+          tfm.Transformer(c, None).apply({"params": p}, tokens), tokens)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(cfg, p))(state.params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(cfg_f, p))(state.params)
+    np.testing.assert_allclose(float(l0), float(l1), atol=1e-5, rtol=1e-5)
+    f0, _ = jax.flatten_util.ravel_pytree(g0)
+    f1, _ = jax.flatten_util.ravel_pytree(g1)
+    np.testing.assert_allclose(np.asarray(f0), np.asarray(f1),
+                               atol=2e-4, rtol=2e-4)

@@ -246,6 +246,59 @@ def check_layer_norm(results, shapes):
       results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
 
+def check_ln_matmul(results, shapes):
+  import jax
+  import jax.numpy as jnp
+  import importlib
+  lnmm = importlib.import_module('tensorflowonspark_tpu.ops.ln_matmul')
+
+  for (rows, d, n), dtype_name in [(s, dt) for s in shapes
+                                   for dt in ("bf16", "f32")]:
+    dtype = dict(bf16=jnp.bfloat16, f32=jnp.float32)[dtype_name]
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (rows, d), dtype)
+    gamma = (jnp.ones((d,), jnp.float32) * 1.1)
+    W = (jax.random.normal(jax.random.PRNGKey(3), (d, n), dtype) * 0.05
+         ).astype(dtype)
+    tol = 1e-1 if dtype_name == "bf16" else 1e-3
+
+    fused = jax.jit(lambda x, g, w: lnmm.ln_matmul(x, g, w))
+    ref = jax.jit(lambda x, g, w: (
+        ((x.astype(jnp.float32) -
+          jnp.mean(x.astype(jnp.float32), -1, keepdims=True)) *
+         jax.lax.rsqrt(jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+                       + 1e-6) * g).astype(x.dtype) @ w))
+    name = "ln_matmul[%s %dx%dx%d]" % (dtype_name, rows, d, n)
+    try:
+      err = float(jnp.max(jnp.abs(fused(x, gamma, W).astype(jnp.float32) -
+                                  ref(x, gamma, W).astype(jnp.float32))))
+      t_f = _timeit(fused, x, gamma, W)
+      t_r = _timeit(ref, x, gamma, W)
+      results.append(dict(kernel=name, ok=err < tol, max_err=err,
+                          fused_ms=round(t_f * 1e3, 3),
+                          xla_ms=round(t_r * 1e3, 3),
+                          speedup=round(t_r / t_f, 2)))
+    except Exception as e:  # noqa: BLE001
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+    name = "ln_matmul_grad[%s %dx%dx%d]" % (dtype_name, rows, d, n)
+    try:
+      gf = jax.jit(jax.grad(
+          lambda x, g, w: jnp.sum(lnmm.ln_matmul(x, g, w)
+                                  .astype(jnp.float32)),
+          argnums=(0, 1, 2)))
+      gr = jax.jit(jax.grad(
+          lambda x, g, w: jnp.sum(ref.__wrapped__(x, g, w)
+                                  .astype(jnp.float32)),
+          argnums=(0, 1, 2)))
+      err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                      b_.astype(jnp.float32))))
+                for a, b_ in zip(gf(x, gamma, W), gr(x, gamma, W)))
+      results.append(dict(kernel=name, ok=err < max(tol, 2e-1), max_err=err))
+    except Exception as e:  # noqa: BLE001
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+
 def main(argv=None):
   ap = argparse.ArgumentParser()
   ap.add_argument("--quick", action="store_true")
@@ -263,6 +316,7 @@ def main(argv=None):
   if args.quick:
     flash_shapes = [(1, 512, 4, 64, True)]
     ln_shapes = [(4096, 1024)]
+    lnmm_shapes = [(4096, 768, 3072)]
   else:
     flash_shapes = [
         (1, 512, 4, 64, True),
@@ -272,11 +326,16 @@ def main(argv=None):
         (4, 4096, 8, 128, True),
     ]
     ln_shapes = [(4096, 1024), (8192, 768), (16384, 4096)]
+    # the bench shape (b16 s1024 GPT-2-small: 16384 rows, 768 -> 3072)
+    # plus a bigger-model shape
+    lnmm_shapes = [(4096, 768, 3072), (16384, 768, 3072),
+                   (8192, 2048, 8192)]
 
   for dt in (("bf16",) if args.quick else ("bf16", "f32")):
     check_flash(results, flash_shapes, dt)
   check_flash_block(results)
   check_layer_norm(results, ln_shapes)
+  check_ln_matmul(results, lnmm_shapes)
 
   n_ok = sum(1 for r in results if r.get("ok"))
   for r in results:
