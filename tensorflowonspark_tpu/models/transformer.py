@@ -192,6 +192,14 @@ def _make_layer_norm(cfg: TransformerConfig, mesh, name: str):
   return nn.LayerNorm(dtype=jnp.float32, use_bias=False, name=name)
 
 
+def _ln_matmul_call(x, ln_scale, w2):
+  """The fused LN+matmul kernel with the shared off-TPU interpret policy
+  (one definition for the attention and MLP call sites)."""
+  from tensorflowonspark_tpu.ops import ln_matmul as _lnmm
+  return _lnmm.ln_matmul(x, ln_scale, w2,
+                         interpret=jax.default_backend() != "tpu")
+
+
 def _expand_kv(kv, num_heads):
   """Broadcast grouped KV heads to the full query head count: KV head j
   serves query heads [j·g, (j+1)·g) for group size g = num_heads/kv_heads
@@ -202,12 +210,33 @@ def _expand_kv(kv, num_heads):
   return jnp.repeat(kv, num_heads // hk, axis=2)
 
 
+class _QKVKernel(nn.Module):
+  """Declares the fused-QKV kernel at the same param path
+  (``attn/qkv/kernel``) nn.DenseGeneral would, for the fused-LN path."""
+  d_model: int
+  n_heads_total: int
+  head_dim: int
+  heads_logical: Optional[str]
+
+  @nn.compact
+  def __call__(self):
+    return self.param(
+        "kernel",
+        nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(),
+            ("embed", self.heads_logical, "kv")),
+        (self.d_model, self.n_heads_total, self.head_dim), jnp.float32)
+
+
 class Attention(nn.Module):
   cfg: TransformerConfig
   mesh: Optional[Any] = None
 
   @nn.compact
-  def __call__(self, x, positions, decode: bool = False):
+  def __call__(self, x, positions, decode: bool = False, ln_scale=None):
+    """With ``ln_scale`` (requires ``fuse_qkv``), ``x`` is the RAW
+    residual stream and ln1 + the QKV projection run as one Pallas kernel
+    (ops.ln_matmul); otherwise ``x`` arrives normalized."""
     cfg = self.cfg
     dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
         feats, axis=-1, dtype=cfg.dtype, use_bias=False, name=name,
@@ -225,12 +254,21 @@ class Attention(nn.Module):
     if cfg.fuse_qkv:
       # one MXU matmul for all three projections, sliced on the heads axis
       h, hk = cfg.num_heads, cfg.kv_heads
-      qkv = dense((h + 2 * hk, cfg.head_dim),
-                  ("embed", heads_axis(h + 2 * hk), "kv"), "qkv")(x)
+      if ln_scale is not None:
+        kernel = _QKVKernel(cfg.d_model, h + 2 * hk, cfg.head_dim,
+                            heads_axis(h + 2 * hk), name="qkv")()
+        flat = _ln_matmul_call(
+            x, ln_scale, kernel.reshape(cfg.d_model, -1).astype(cfg.dtype))
+        qkv = flat.reshape(x.shape[:-1] + (h + 2 * hk, cfg.head_dim))
+      else:
+        qkv = dense((h + 2 * hk, cfg.head_dim),
+                    ("embed", heads_axis(h + 2 * hk), "kv"), "qkv")(x)
       q = qkv[..., :h, :]
       k = qkv[..., h:h + hk, :]
       v = qkv[..., h + hk:, :]
     else:
+      if ln_scale is not None:
+        raise ValueError("ln-fused attention requires fuse_qkv")
       q = dense((cfg.num_heads, cfg.head_dim),
                 ("embed", heads_axis(cfg.num_heads), "kv"), "q")(x)
       # GQA: K/V carry only kv_heads heads (= num_heads unless configured)
@@ -347,10 +385,8 @@ class MLPBlock(nn.Module):
     it, ``x`` is expected already normalized (the regular path)."""
     cfg = self.cfg
     if ln_scale is not None:
-      from tensorflowonspark_tpu.ops import ln_matmul as _lnmm
       kernel = _UpKernel(cfg.d_model, cfg.d_ff, name="up")()
-      h = _lnmm.ln_matmul(x, ln_scale, kernel.astype(cfg.dtype),
-                          interpret=jax.default_backend() != "tpu")
+      h = _ln_matmul_call(x, ln_scale, kernel.astype(cfg.dtype))
     else:
       h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
                    kernel_init=nn.with_logical_partitioning(
@@ -452,11 +488,19 @@ class Block(nn.Module):
   @nn.compact
   def __call__(self, x, positions, decode: bool = False):
     cfg = self.cfg
-    y = _make_layer_norm(cfg, self.mesh, "ln1")(x)
-    x = x + Attention(cfg, self.mesh, name="attn")(y, positions,
-                                                   decode=decode)
-    if (cfg.ln_matmul_impl == "fused" and self.mesh is None
-        and not self.use_moe and not decode):
+    fuse_ln = (cfg.ln_matmul_impl == "fused" and self.mesh is None
+               and not decode)
+    if fuse_ln and cfg.fuse_qkv:
+      # ln1 + the fused QKV projection as ONE kernel over the raw
+      # residual stream (param paths unchanged: ln1/scale, attn/qkv)
+      scale1 = _LNScale(cfg.d_model, name="ln1")()
+      x = x + Attention(cfg, self.mesh, name="attn")(x, positions,
+                                                     ln_scale=scale1)
+    else:
+      y = _make_layer_norm(cfg, self.mesh, "ln1")(x)
+      x = x + Attention(cfg, self.mesh, name="attn")(y, positions,
+                                                     decode=decode)
+    if fuse_ln and not self.use_moe:
       # ln2 + up-projection as ONE kernel over the raw residual stream;
       # same param paths as the unfused branch (ln2/scale, mlp/up/kernel)
       scale = _LNScale(cfg.d_model, name="ln2")()

@@ -249,3 +249,39 @@ class TestLNMatmul:
     f1, _ = jax.flatten_util.ravel_pytree(g1)
     np.testing.assert_allclose(np.asarray(f0), np.asarray(f1),
                                atol=2e-4, rtol=2e-4)
+
+  def test_model_fused_qkv_ln_matches_unfused(self):
+    """ln_matmul_impl='fused' + fuse_qkv: ln1+QKV and ln2+up both run
+    fused; params, loss and grads match the unfused graph, and the decode
+    path (which takes the unfused branch) serves fused-trained params."""
+    import dataclasses
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                                d_model=64, d_ff=128, max_seq_len=16,
+                                dtype=jnp.float32, remat=False,
+                                fuse_qkv=True)
+    cfg_f = dataclasses.replace(cfg, ln_matmul_impl="fused")
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+    state_f = tfm.create_state(jax.random.PRNGKey(0), cfg_f, seq_len=16)
+    assert (jax.tree.structure(state.params)
+            == jax.tree.structure(state_f.params))
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+
+    def loss(c, p):
+      return tfm.causal_lm_loss(
+          tfm.Transformer(c, None).apply({"params": p}, tokens), tokens)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(cfg, p))(state.params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(cfg_f, p))(state.params)
+    np.testing.assert_allclose(float(l0), float(l1), atol=1e-5, rtol=1e-5)
+    f0, _ = jax.flatten_util.ravel_pytree(g0)
+    f1, _ = jax.flatten_util.ravel_pytree(g1)
+    np.testing.assert_allclose(np.asarray(f0), np.asarray(f1),
+                               atol=2e-4, rtol=2e-4)
+
+    # a fused-config model must still generate (decode branch is unfused)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = tfm.greedy_generate(state_f.params, cfg_f, prompt, num_steps=4)
+    assert out.shape == (1, 8)
