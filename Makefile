@@ -4,7 +4,10 @@
 
 PY ?= python
 
-.PHONY: test lint native bench dryrun clean
+.PHONY: test lint native bench dryrun validate clean
+
+# the end-of-round ritual: lint gate + full suite + multichip dryrun
+validate: test dryrun
 
 # stdlib-only lint gate (this image has no ruff/pycodestyle/mypy and no
 # network); scope parity with the reference's tox pycodestyle/pylint envs
