@@ -93,7 +93,7 @@ class TPUCluster(object):
     assert self.input_mode == InputMode.ENGINE, \
         "train() requires InputMode.ENGINE/SPARK"
     epochs = max(1, num_epochs)
-    parts = self._replicate(data_partitions, epochs)
+    parts = self._replicate(self._wrap_lazy(data_partitions), epochs)
     fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
                                 feed_timeout=feed_timeout, qname=qname)
     self.engine.foreach_partition(parts, fn).wait()
@@ -207,6 +207,7 @@ class TPUCluster(object):
         "inference() requires InputMode.ENGINE/SPARK"
     fn = node_mod.make_inference_fn(self.cluster_info, self.cluster_meta,
                                     feed_timeout=feed_timeout, qname=qname)
+    data_partitions = self._wrap_lazy(data_partitions)
     if collect:
       return self.engine.map_partitions(data_partitions, fn)
     return self.engine.map_partitions_lazy(data_partitions, fn,
@@ -296,6 +297,24 @@ class TPUCluster(object):
       if n.get("tb_url"):
         return n["tb_url"]
     return None
+
+  @staticmethod
+  def _wrap_lazy(parts):
+    """Bare-callable partitions (lazy handles, e.g. from
+    ``load_tfrecords(lazy=True)``) become single-item partitions the
+    feeders resolve executor-side (node._materialize_partition).
+    Engine-native handles and row partitions pass through untouched."""
+    import collections.abc
+    if hasattr(parts, "mapPartitions") or hasattr(parts, "rdd") \
+        or hasattr(parts, "foreachRDD"):
+      return parts
+    if isinstance(parts, collections.abc.Iterator):
+      # a one-shot stream of partitions (the collect=False windowed path)
+      # must stay a stream — the driver pulls one window at a time
+      return ([p] if callable(p) else p for p in parts)
+    # any re-iterable collection wraps eagerly (epoch replication
+    # re-iterates it)
+    return [[p] if callable(p) else p for p in parts]
 
   @staticmethod
   def _replicate(parts: Sequence, epochs: int):

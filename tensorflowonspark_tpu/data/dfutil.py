@@ -151,16 +151,7 @@ def save_as_tfrecords(partitions: Sequence[Iterable], schema: Schema,
   return sorted(engine.map_partitions(indexed, _task))
 
 
-def load_tfrecords(path: str, schema: Optional[Schema] = None,
-                   binary_features: Optional[Set[str]] = None,
-                   num_partitions: Optional[int] = None
-                   ) -> Tuple[List[List[Tuple]], Schema]:
-  """Load TFRecord file(s) into (partitions, schema).
-
-  ``path`` may be a file, a directory of part files, or a glob. The schema
-  is inferred from the first record when not given (parity:
-  reference loadTFRecords + infer_schema, dfutil.py:44-81).
-  """
+def _list_tfrecord_files(path: str) -> List[str]:
   from tensorflowonspark_tpu.data import fs
   if fs.is_remote(path):
     base = path.rstrip("/")
@@ -176,9 +167,61 @@ def load_tfrecords(path: str, schema: Optional[Schema] = None,
     files = sorted(glob.glob(path))
   if not files:
     raise FileNotFoundError("no TFRecord files at %r" % path)
+  return files
+
+
+def _lazy_file_reader(files: List[str], schema: Schema):
+  """A zero-arg callable streaming decoded rows of ``files`` — the lazy
+  partition-handle format save_as_tfrecords and the cluster feeders
+  (node._materialize_partition) resolve ON the executor."""
+  def _read():
+    return (from_example(record, schema)
+            for f in files for record in tfrecord.TFRecordReader(f))
+  return _read
+
+
+def load_tfrecords(path: str, schema: Optional[Schema] = None,
+                   binary_features: Optional[Set[str]] = None,
+                   num_partitions: Optional[int] = None,
+                   lazy: bool = False):
+  """Load TFRecord file(s) into (partitions, schema).
+
+  ``path`` may be a file, a directory of part files, or a glob. The schema
+  is inferred from the first record when not given (parity:
+  reference loadTFRecords + infer_schema, dfutil.py:44-81).
+
+  With ``lazy=True`` the driver reads at most ONE record (for schema
+  inference): each returned partition is a zero-arg callable producing the
+  rows of one part file, resolved executor-side by ``cluster.train`` /
+  ``cluster.inference`` feeders and by ``save_as_tfrecords(engine=...)``
+  — the executor-side parse path of the reference's loadTFRecords, whose
+  records were decoded by Spark tasks, never the driver.
+  """
+  files = _list_tfrecord_files(path)
+
+  inferred = schema
+  if lazy:
+    if inferred is None:
+      # scan files until the first record (a leading part file may be
+      # empty); only that one record is ever decoded on the driver
+      for f in files:
+        for record in tfrecord.TFRecordReader(f):
+          inferred = infer_schema(record, binary_features)
+          logger.info("inferred schema: %s", inferred)
+          break
+        if inferred is not None:
+          break
+      if inferred is None:
+        raise ValueError(
+            "no records in %r to infer a schema from; pass schema=" % path)
+    k = max(1, min(num_partitions, len(files))) if num_partitions \
+        else len(files)
+    groups = [files[i::k] for i in range(k)]
+    partitions = [_lazy_file_reader(g, inferred) for g in groups if g]
+    _loaded_paths[_path_key(path)] = inferred
+    return partitions, inferred
 
   partitions: List[List[Tuple]] = []
-  inferred = schema
   for f in files:
     rows = []
     for record in tfrecord.TFRecordReader(f):

@@ -591,6 +591,31 @@ def _check_errors(hub, where: str) -> None:
                        % (where, "\n".join(str(e) for e in errs)))
 
 
+_NO_ITEM = object()
+
+
+def _materialize_partition(iterator):
+  """Resolve a lazy partition handle on the executor.
+
+  A partition consisting of exactly ONE zero-arg callable (e.g. from
+  ``data.dfutil.load_tfrecords(lazy=True)``) is a handle: call it HERE so
+  rows are produced executor-side and never ship through the driver (the
+  feed-plane counterpart of save_as_tfrecords' callable partitions;
+  parity: reference loadTFRecords parsing records on executors,
+  dfutil.py:44-81). Anything else passes through untouched.
+  """
+  import itertools
+  first = next(iterator, _NO_ITEM)
+  if first is _NO_ITEM:
+    return iter(())
+  if callable(first):
+    second = next(iterator, _NO_ITEM)
+    if second is _NO_ITEM:
+      return iter(first())
+    return itertools.chain([first, second], iterator)
+  return itertools.chain([first], iterator)
+
+
 def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
                   chunk_size=256):
   """Feeder task: push one data partition into the local node's input queue.
@@ -609,11 +634,13 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
     queue = input_channel(hub, qname)
     if state == "terminating":
       # user called DataFeed.terminate(): consume and discard the partition
-      # so the engine job completes (parity :492-496)
+      # so the engine job completes (parity :492-496). The RAW iterator is
+      # drained — a lazy handle is discarded uncalled, never decoded
       logger.info("node terminating; skipping partition feed")
       for _ in iterator:
         pass
       return [0]
+    iterator = _materialize_partition(iterator)
     rows = 0
     chunk = []
     for item in iterator:
@@ -654,6 +681,7 @@ def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
 
   def _inference(iterator):
     from tensorflowonspark_tpu.control.marker import EndPartition
+    iterator = _materialize_partition(iterator)
     executor_id = hostinfo.read_executor_id(os.getcwd())
     hub = _get_hub(cluster_info, executor_id, authkey)
     queue = input_channel(hub, qname)

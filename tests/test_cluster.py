@@ -520,3 +520,49 @@ def test_validation_errors(engine):
                     input_mode=InputMode.FILES)
   with pytest.raises(ValueError, match="executors"):
     tos_cluster.run(engine, lambda a, c: None, num_executors=5)
+
+
+def test_inference_over_lazy_tfrecord_partitions(engine, tmp_path):
+  """load_tfrecords(lazy=True) handles feed straight into the cluster:
+  the feeder resolves each callable ON the executor
+  (node._materialize_partition), so TFRecord decode never happens on the
+  driver — the reference's executor-side loadTFRecords parse
+  (dfutil.py:44-81) composed with InputMode.SPARK feeding."""
+  import os as _os
+  from tensorflowonspark_tpu.data import dfutil
+  from tensorflowonspark_tpu.data.schema import parse_schema
+
+  sch = parse_schema("struct<v:long>")
+  src = [[(f * 10 + i,) for i in range(5)] for f in range(4)]
+  dfutil.save_as_tfrecords(src, sch, str(tmp_path / "d"))
+  marker = str(tmp_path / "decoded_pid")
+
+  parts, _ = dfutil.load_tfrecords(str(tmp_path / "d"), schema=sch,
+                                   lazy=True)
+
+  def spying(i, p):
+    # wrap each handle so the test can observe WHERE it ran
+    def _run():
+      with open("%s.%d" % (marker, i), "w") as fh:
+        fh.write(str(_os.getpid()))
+      return (row[0] for row in p())
+    return _run
+
+  parts = [spying(i, p) for i, p in enumerate(parts)]
+
+  def main_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+      batch = feed.next_batch(16)
+      if batch:
+        feed.batch_results([x * 2 for x in batch])
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.ENGINE,
+                      reservation_timeout=30)
+  results = c.inference(parts, feed_timeout=60)
+  c.shutdown(timeout=120)
+  assert sorted(results) == sorted(r[0] * 2 for p in src for r in p)
+  import glob as _glob
+  pids = {open(m).read() for m in _glob.glob(marker + ".*")}
+  assert pids and str(_os.getpid()) not in pids, \
+      "lazy partitions were materialized on the driver"
