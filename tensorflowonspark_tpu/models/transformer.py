@@ -200,14 +200,10 @@ def _ln_matmul_call(x, ln_scale, w2):
                          interpret=jax.default_backend() != "tpu")
 
 
-def _expand_kv(kv, num_heads):
-  """Broadcast grouped KV heads to the full query head count: KV head j
-  serves query heads [j·g, (j+1)·g) for group size g = num_heads/kv_heads
-  (query head i reads KV head i // g)."""
-  hk = kv.shape[2]
-  if hk == num_heads:
-    return kv
-  return jnp.repeat(kv, num_heads // hk, axis=2)
+# grouped-KV head broadcast: ONE definition, shared with the ring
+# (parallel.ring_attention.expand_heads) so the grouping convention
+# (blocked: KV head j serves query heads [j*g, (j+1)*g)) cannot drift
+_expand_kv = ra.expand_heads
 
 
 class _QKVKernel(nn.Module):
@@ -282,19 +278,22 @@ class Attention(nn.Module):
 
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-    # the training path attends at full head count: broadcast each KV head
-    # to its query group (XLA fuses the repeat; the kernels stay MHA-shaped)
-    k = _expand_kv(k, cfg.num_heads)
-    v = _expand_kv(v, cfg.num_heads)
 
     interp = jax.default_backend() != "tpu"   # forced-flash CI runs
     if cfg.use_ring_attention and self.mesh is not None:
+      # the ring takes GROUPED K/V as-is: unexpanded blocks rotate on the
+      # ICI (num_heads/kv_heads less traffic) and expand per step locally
       seq_shards = self.mesh.shape.get(mesh_lib.AXIS_SEQUENCE, 1)
       local_seq = q.shape[1] // max(1, seq_shards)
       out = ra.ring_attention(q, k, v, self.mesh, causal=True,
                               use_flash=_flash_eligible(cfg, local_seq),
                               interpret=interp)
     else:
+      # single-shard paths attend at full head count: broadcast each KV
+      # head to its query group (XLA fuses the repeat; the kernels stay
+      # MHA-shaped)
+      k = _expand_kv(k, cfg.num_heads)
+      v = _expand_kv(v, cfg.num_heads)
       if _flash_eligible(cfg, q.shape[1]):
         from tensorflowonspark_tpu.ops import flash_attention
         out = flash_attention(q, k, v, causal=True, interpret=interp)

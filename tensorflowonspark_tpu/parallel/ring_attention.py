@@ -28,6 +28,29 @@ from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 NEG_INF = -1e30
 
 
+def expand_heads(kv, num_heads: int):
+  """Broadcast grouped-query KV heads up to the query head count (KV head
+  j serves query heads [j*g, (j+1)*g) — blocked layout). Under GQA the
+  ring permutes the UNEXPANDED blocks — a num_heads/kv_heads cut in ICI
+  traffic — and each step expands locally right before the block math.
+  (On the flash path the expanded block transits HBM per step because a
+  repeat can't fuse into the kernel's custom call; a grouped-aware KV
+  BlockSpec would avoid that but needs cross-head grid accumulation in
+  the fused backward — ROADMAP. The dense path's einsum fuses the
+  repeat.) The ONE head-broadcast helper — models/transformer.py uses it
+  too, so the grouping convention cannot drift."""
+  hk = kv.shape[2]
+  if hk == num_heads:
+    return kv
+  if num_heads % hk:
+    raise ValueError("kv heads (%d) must divide query heads (%d)"
+                     % (hk, num_heads))
+  return jnp.repeat(kv, num_heads // hk, axis=2)
+
+
+_expand_heads = expand_heads
+
+
 def _block_attn(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
   """One online-softmax accumulation step against a single KV block.
 
@@ -73,8 +96,9 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
     k_blk, v_blk, m, l, o = carry
     src = (my - step) % n                 # whose block we hold this step
     kv_offset = src * s_local
-    m, l, o = _block_attn(q, k_blk, v_blk, m, l, o, q_offset, kv_offset,
-                          causal, scale)
+    m, l, o = _block_attn(q, _expand_heads(k_blk, h),
+                          _expand_heads(v_blk, h), m, l, o, q_offset,
+                          kv_offset, causal, scale)
     # rotate kv to the next neighbor (ICI ring); last rotation is unused but
     # keeps the loop shape static for XLA
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -115,7 +139,8 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
     k_blk, v_blk, o, lse = carry
     src = (my - step) % n
     o_j, lse_j = flash_attention_block(
-        q, k_blk, v_blk, my * s_local, src * s_local, causal=causal,
+        q, _expand_heads(k_blk, h), _expand_heads(v_blk, h),
+        my * s_local, src * s_local, causal=causal,
         blk_q=blk_q, blk_k=blk_k, interpret=interpret,
         blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k, bwd=bwd)
     o, lse = merge_partials(o, lse, o_j.astype(jnp.float32), lse_j)
@@ -138,6 +163,11 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
 
   Args:
     q, k, v: [batch, seq, heads, head_dim], seq sharded over ``axis_name``.
+      K/V may carry FEWER heads than Q (grouped-query attention): the ring
+      then permutes the small grouped blocks — ICI traffic drops by
+      num_heads/kv_heads — and every step expands them locally before the
+      block math. (If a tensor axis shards heads and cannot divide the
+      grouped count, K/V are expanded up front instead.)
     mesh: the device mesh.
     causal: apply a global causal mask.
     batch_axes: mesh axes dim 0 is sharded over (defaults to data+fsdp).
@@ -156,6 +186,12 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
 
   batch_axes = batch_axes if batch_axes is not None else \
       mesh_lib.data_axes(mesh)
+  t = mesh_lib.axis_size(mesh, mesh_lib.AXIS_TENSOR)
+  if k.shape[2] != q.shape[2] and k.shape[2] % max(1, t) != 0:
+    # heads are tensor-sharded and the grouped count can't divide: expand
+    # up front (the pre-GQA behavior) rather than break the head spec
+    k = _expand_heads(k, q.shape[2])
+    v = _expand_heads(v, q.shape[2])
   spec = P(batch_axes or None, axis_name, mesh_lib.AXIS_TENSOR
            if mesh_lib.AXIS_TENSOR in mesh.axis_names else None, None)
   if use_flash:
