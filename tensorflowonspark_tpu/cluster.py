@@ -17,6 +17,7 @@ abstraction (Spark or the built-in LocalEngine) and re-targeted at JAX/TPU:
   days, :136-144) and raises if any node failed (:179-183).
 """
 
+import collections.abc
 import logging
 import os
 import random
@@ -93,9 +94,36 @@ class TPUCluster(object):
     assert self.input_mode == InputMode.ENGINE, \
         "train() requires InputMode.ENGINE/SPARK"
     epochs = max(1, num_epochs)
-    parts = self._replicate(self._wrap_lazy(data_partitions), epochs)
+    parts = self._wrap_lazy(data_partitions)
     fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
                                 feed_timeout=feed_timeout, qname=qname)
+    if isinstance(parts, collections.abc.Iterator):
+      # one-shot partition streams cannot be replayed (and _replicate's
+      # fallback would drain the generator eagerly on the driver, feeding
+      # epoch 1 and silently starving epochs 2..N), so route them through
+      # the engine's lazy path. On LocalEngine the driver holds one window
+      # of partitions in flight, never the whole dataset; SparkEngine's
+      # _as_rdd still drains the stream into a driver-side list of
+      # partition HANDLES before parallelize — O(dataset) only if the
+      # stream carries raw rows instead of callables (use lazy handles or
+      # train_dstream for big data on Spark)
+      if epochs > 1:
+        raise ValueError(
+            "train(num_epochs=%d) got a one-shot partition iterator; "
+            "re-iterable input (a list, an RDD, or lazy handles) is "
+            "required to replay epochs" % epochs)
+      stream = self.engine.map_partitions_lazy(parts, fn,
+                                               timeout=feed_timeout)
+      if isinstance(stream, collections.abc.Iterator):
+        for _ in stream:   # windowed: one window in flight on the driver
+          pass
+      else:
+        # RDD-like lazy result (SparkEngine hands back an uncollected
+        # RDD): trigger the feed with a row-free action — count() runs
+        # the tasks distributed and returns only a number
+        stream.count()
+      return
+    parts = self._replicate(parts, epochs)
     self.engine.foreach_partition(parts, fn).wait()
 
   def train_stream(self, batch_stream, feed_timeout: float = 600,
@@ -304,7 +332,6 @@ class TPUCluster(object):
     ``load_tfrecords(lazy=True)``) become single-item partitions the
     feeders resolve executor-side (node._materialize_partition).
     Engine-native handles and row partitions pass through untouched."""
-    import collections.abc
     if hasattr(parts, "mapPartitions") or hasattr(parts, "rdd") \
         or hasattr(parts, "foreachRDD"):
       return parts

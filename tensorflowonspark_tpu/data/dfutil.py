@@ -115,6 +115,12 @@ def save_as_tfrecords(partitions: Sequence[Iterable], schema: Schema,
   from tensorflowonspark_tpu.data import fs
   fs.makedirs(output_dir, exist_ok=True)
   remote = fs.is_remote(output_dir)
+  # Handle recipe for O(1) driver memory with an engine:
+  #   parts, schema = load_tfrecords(path, lazy=True)      # or your own
+  #   parts = [lambda f=f: read_rows(f) for f in files]    # callables
+  #   save_as_tfrecords(parts, schema, out, engine=engine)
+  # Callables resolve ON the executor; generators cannot (cloudpickle
+  # rejects them) and are materialized driver-side with a warning.
 
   def _part_path(index: int) -> str:
     name = "part-%05d.tfrecord" % index
@@ -144,10 +150,17 @@ def save_as_tfrecords(partitions: Sequence[Iterable], schema: Schema,
   # O(#partitions) handles on the driver, never O(rows). One-shot
   # iterators/generators can't cross the process boundary (cloudpickle
   # rejects generators) — those alone are materialized here.
-  def _shippable(p):
-    return p if callable(p) or isinstance(p, (list, tuple)) else list(p)
+  def _shippable(i, p):
+    if callable(p) or isinstance(p, (list, tuple)):
+      return p
+    logger.warning(
+        "save_as_tfrecords: partition %d is a one-shot iterator; "
+        "materializing it on the DRIVER (O(partition) driver memory). "
+        "Ship a zero-arg callable (e.g. load_tfrecords(lazy=True) "
+        "handles) to produce rows executor-side instead.", i)
+    return list(p)
 
-  indexed = [[(i, _shippable(p))] for i, p in enumerate(partitions)]
+  indexed = [[(i, _shippable(i, p))] for i, p in enumerate(partitions)]
   return sorted(engine.map_partitions(indexed, _task))
 
 

@@ -77,7 +77,7 @@ def make_optimizer(learning_rate: float = 3e-4,
 
   sched = make_schedule(learning_rate, schedule, warmup_steps, decay_steps,
                         end_value)
-  if decay_mask == "auto":
+  if isinstance(decay_mask, str) and decay_mask == "auto":
     decay_mask = default_decay_mask if weight_decay else None
   parts = []
   if clip_norm and clip_norm > 0:

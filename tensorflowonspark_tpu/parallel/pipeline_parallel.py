@@ -180,7 +180,10 @@ def _1f1b_lm_local(outer_params, stage_params, tok_micro, tgt_micro,
   - a FORWARD of microbatch ``m_f = t - s`` (masked outside
     ``[0, n_micro)``), storing its input in a ring buffer of ``2S`` slots.
     Stage 0's forward slot first embeds the entering microbatch's tokens
-    (``lax.cond`` keeps the embed off other stages);
+    (``lax.cond`` keeps the embed off other stages — under shard_map the
+    predicate is a per-device scalar, not a batched one, so it compiles to
+    a real HLO ``conditional``, not a select; asserted by
+    ``test_parallel.py::TestPipeline1F1B::test_cond_is_real_branch``);
   - a BACKWARD of microbatch ``m_b = t - (2S - 1) + s``: the stage input
     is read back from the ring, the stage forward is rematerialized under
     ``jax.vjp``, and the incoming cotangent is the next stage's grad from

@@ -207,13 +207,92 @@ def signature_output_names(export_dir: str) -> Optional[List[str]]:
   return None
 
 
-def _transform_worker_slot() -> int:
+def _host_local_slot(workers_per_host: int):
+  """Claim a free host-local worker slot from a flock'd slot file.
+
+  Spark offers no guarantee that tasks co-located on one host carry
+  non-congruent partition ids — ids 0 and ``workers_per_host`` landing on
+  the same host would both map to slot 0 under a plain modulus. A per-host
+  slot file (``fcntl.flock`` over a tmp path, keyed by uid) hands each
+  claiming process a distinct free slot instead, which is disjoint
+  whenever at most ``workers_per_host`` executor processes claim per host
+  — the sizing the ``chips_per_node`` contract implies. Returns None when
+  the slot file is unusable or exhausted; callers fall back to the
+  partition-id heuristic.
+
+  The file holds a ``{slot: claiming pid}`` map, not a bare counter:
+  claims by dead processes are reclaimed, so a replacement executor after
+  a task failure takes the freed slot instead of colliding with a live
+  one. When every slot is held by a live process (oversubscription) the
+  claim returns None. The open refuses symlinks and the lock wait is
+  bounded — a wedged (or hostile) holder on the shared tmp path degrades
+  placement to the heuristic, never hangs the task.
+  """
+  import fcntl
+  import json
+  import tempfile
+  import time
+  path = os.path.join(tempfile.gettempdir(),
+                      "tos_transform_slots.%d" % os.getuid())
+  try:
+    fd = os.open(path,
+                 os.O_RDWR | os.O_CREAT | getattr(os, "O_NOFOLLOW", 0),
+                 0o600)
+  except OSError:
+    return None
+  try:
+    for _ in range(50):
+      try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        break
+      except OSError:
+        time.sleep(0.1)
+    else:
+      return None
+    try:
+      raw = os.read(fd, 1 << 16).strip()
+      try:
+        claims = {int(s): int(p) for s, p in json.loads(raw).items()} \
+            if raw else {}
+      except (ValueError, AttributeError):
+        claims = {}
+
+      def _alive(pid):
+        try:
+          os.kill(pid, 0)
+          return True
+        except OSError:
+          return False
+
+      claims = {s: p for s, p in claims.items()
+                if 0 <= s < workers_per_host and _alive(p)}
+      free = [s for s in range(workers_per_host) if s not in claims]
+      if not free:
+        return None
+      claims[free[0]] = os.getpid()
+      os.lseek(fd, 0, os.SEEK_SET)
+      os.ftruncate(fd, 0)
+      os.write(fd, json.dumps({str(s): p
+                               for s, p in claims.items()}).encode())
+      return free[0]
+    finally:
+      fcntl.flock(fd, fcntl.LOCK_UN)
+  except OSError:
+    return None
+  finally:
+    os.close(fd)
+
+
+def _transform_worker_slot(workers_per_host: int = 0) -> int:
   """This task's host-local worker index for chip placement.
 
-  LocalEngine executors export ``TOS_EXECUTOR_SLOT``; Spark tasks derive a
-  deterministic slot from their partition id (the reference's deterministic
-  placement-by-worker-index, gpu_info.py:80-91 — partition ids spread
-  round-robin over a host's worker slots). Anything else gets slot 0.
+  LocalEngine executors export ``TOS_EXECUTOR_SLOT``. Spark tasks claim
+  the next slot from a host-local atomic counter when ``workers_per_host``
+  is known (guaranteed-disjoint, see ``_host_local_slot``), falling back
+  to a deterministic slot from their partition id (the reference's
+  placement-by-worker-index, gpu_info.py:80-91 — a heuristic: congruent
+  partition ids co-located on one host would double-claim). Anything else
+  gets slot 0.
   """
   slot = os.environ.get("TOS_EXECUTOR_SLOT")
   if slot is not None:
@@ -222,6 +301,10 @@ def _transform_worker_slot() -> int:
     from pyspark import TaskContext
     ctx = TaskContext.get()
     if ctx is not None:
+      if workers_per_host > 0:
+        claimed = _host_local_slot(workers_per_host)
+        if claimed is not None:
+          return claimed
       return ctx.partitionId()
   except ImportError:
     pass
@@ -243,7 +326,7 @@ def _allocate_transform_chips(chips_per_node: int) -> None:
   if topo is None:
     return
   workers_per_host = max(1, topo.chips_per_host // chips_per_node)
-  slot = _transform_worker_slot() % workers_per_host
+  slot = _transform_worker_slot(workers_per_host) % workers_per_host
   tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
       chips_per_node, slot, workers_per_host, generation=topo.generation))
   os.environ["TOS_CHIP_ENV_APPLIED"] = "1"

@@ -265,17 +265,25 @@ class TestLazySave:
     loaded, _ = dfutil.load_tfrecords(str(tmp_path / "out"), schema=sch)
     assert sorted(r[0] for p in loaded for r in p) == [0, 1, 2, 10, 11, 12]
 
-  def test_generator_partitions_with_engine(self, tmp_path):
+  def test_generator_partitions_with_engine(self, tmp_path, caplog):
     """One-shot iterators are valid partitions too: cloudpickle cannot
-    ship a generator, so they alone are materialized before shipping."""
+    ship a generator, so they alone are materialized before shipping —
+    loudly, since that is exactly the O(driver-memory) behavior the
+    handle path exists to avoid (round-3 verdict item 8)."""
+    import logging
     from tensorflowonspark_tpu.engine import LocalEngine
     sch = schema.parse_schema("struct<v:long>")
     engine = LocalEngine(num_executors=2)
     try:
       parts = [iter([(0,), (1,)]), (r for r in [(2,), (3,)])]
-      files = dfutil.save_as_tfrecords(parts, sch, str(tmp_path / "out"),
-                                       engine=engine)
+      with caplog.at_level(logging.WARNING,
+                           logger="tensorflowonspark_tpu.data.dfutil"):
+        files = dfutil.save_as_tfrecords(parts, sch, str(tmp_path / "out"),
+                                         engine=engine)
       assert len(files) == 2
+      warns = [r for r in caplog.records
+               if "materializing it on the DRIVER" in r.getMessage()]
+      assert len(warns) == 2, "one warning per materialized partition"
       loaded, _ = dfutil.load_tfrecords(str(tmp_path / "out"), schema=sch)
       assert sorted(r[0] for p in loaded for r in p) == [0, 1, 2, 3]
     finally:
@@ -384,3 +392,33 @@ class TestLazyLoad:
     gen = TPUCluster._wrap_lazy(iter([[1], [2]]))
     assert not isinstance(gen, list)
     assert list(gen) == [[1], [2]]
+
+  def test_train_iterator_rdd_lazy_uses_rowfree_action(self):
+    """SparkEngine's map_partitions_lazy hands back an uncollected RDD
+    (not an iterator): train()'s streaming branch must trigger it with a
+    row-free action (count), never try to iterate it on the driver."""
+    from tensorflowonspark_tpu.cluster import InputMode, TPUCluster
+
+    class _RDD:
+      counted = 0
+
+      def count(self):
+        _RDD.counted += 1
+        return 3
+
+    class _Eng:
+      def __init__(self):
+        self.lazy_calls = []
+
+      def map_partitions_lazy(self, parts, fn, timeout=None):
+        self.lazy_calls.append((parts, fn))
+        return _RDD()
+
+    c = TPUCluster.__new__(TPUCluster)
+    c.engine = _Eng()
+    c.input_mode = InputMode.ENGINE
+    c.cluster_info = []
+    c.cluster_meta = {"authkey": b"k"}
+    c.train(iter([[(1,)], [(2,)]]))
+    assert _RDD.counted == 1
+    assert len(c.engine.lazy_calls) == 1

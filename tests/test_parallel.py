@@ -434,6 +434,21 @@ class TestPipeline1F1B:
                                np.asarray(jax.grad(seq_loss)(W)),
                                atol=1e-4, rtol=1e-4)
 
+  def test_cond_is_real_branch(self, devices):
+    """The stage-0 embed and last-stage head+loss are guarded by lax.cond
+    on the pipeline axis index. Under vmap such conds lower to select
+    (both branches run everywhere — a silent perf regression); under
+    shard_map the predicate is a per-device scalar and must survive as a
+    real HLO ``conditional`` (round-3 advice)."""
+    PP, stage_fn, loss_fn, W, x, t, _ = self._setup()
+    mesh = M.build_mesh(M.MeshSpec(pipeline=4), devices=devices[:4])
+    f = jax.jit(lambda W, x, t: PP.pipeline_train_step(
+        stage_fn, loss_fn, W, x, t, mesh, num_microbatches=4))
+    hlo = f.lower(W, x, t).compile().as_text()
+    assert "conditional(" in hlo, \
+        "embed/head lax.cond was lowered to select: edge-stage work " \
+        "now runs on every stage"
+
   def test_bf16_params_and_loss(self, devices):
     """bf16 end-to-end: the loss-vjp cotangent matches the loss dtype and
     grads accumulate in f32 before casting back to the param dtype."""

@@ -376,3 +376,54 @@ class TestTransformChipAllocation:
       pyspark_stub.TaskContext._local.ctx = None
     # no task context at all -> slot 0
     assert pl._transform_worker_slot() == 0
+
+  def test_spark_counter_slot_disjoint(self, monkeypatch, tmp_path):
+    """With workers_per_host known, co-located Spark tasks claim disjoint
+    slots from a host-local flock counter — even when their partition ids
+    are congruent mod workers_per_host, the case where the plain
+    partition-id modulus double-claims a slot (round-3 advice)."""
+    import sys as _sys
+    import tempfile
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import pyspark_stub
+    from tensorflowonspark_tpu import pipeline as pl
+
+    monkeypatch.delenv("TOS_EXECUTOR_SLOT", raising=False)
+    monkeypatch.setitem(_sys.modules, "pyspark", pyspark_stub)
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    # every claimant reports partition id 0: the modulus heuristic would
+    # put them all on slot 0; the slot file spreads them
+    pyspark_stub.TaskContext._local.ctx = pyspark_stub.TaskContext(0, 0)
+    try:
+      slots = [pl._transform_worker_slot(2) for _ in range(2)]
+      assert slots == [0, 1]
+      # both slots held by live pids -> exhausted, heuristic fallback
+      assert pl._transform_worker_slot(2) == 0
+    finally:
+      pyspark_stub.TaskContext._local.ctx = None
+    # workers_per_host unknown -> partition-id heuristic preserved
+    pyspark_stub.TaskContext._local.ctx = pyspark_stub.TaskContext(3, 0)
+    try:
+      assert pl._transform_worker_slot() == 3
+    finally:
+      pyspark_stub.TaskContext._local.ctx = None
+
+  def test_counter_slot_reclaims_dead_claims(self, monkeypatch, tmp_path):
+    """A slot whose claiming process died is reclaimed: the replacement
+    executor takes the freed slot instead of colliding with a live one
+    (the failure mode a bare monotonic counter has on task retry)."""
+    import json
+    import subprocess
+    import tempfile
+    from tensorflowonspark_tpu import pipeline as pl
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    dead = proc.pid
+    path = tmp_path / ("tos_transform_slots.%d" % os.getuid())
+    path.write_text(json.dumps({"0": dead, "1": os.getpid()}))
+    # slot 0's holder is dead -> reclaimed; slot 1 stays with the live pid
+    assert pl._host_local_slot(2) == 0
+    claims = json.loads(path.read_text())
+    assert claims["0"] == os.getpid() and claims["1"] == os.getpid()

@@ -1,0 +1,199 @@
+"""Round-long TPU availability watcher + first-success capture pipeline.
+
+Three rounds of BENCH_r0N.json read 0.0 because the axon device claim
+service happened to be down at the moments the bench was tried by hand.
+This watcher converts capture from an *attempt* into a *standing process*
+(round-3 verdict, item 1): it probes ``jax.devices()`` in a throwaway
+subprocess every ~10 minutes all round, logs every probe with a timestamp
+to ``BENCH_WATCH.log``, and the first time the chip answers it runs the
+full measurement stack in order:
+
+  1. ``python bench.py``                      -> bench_artifacts/bench.json
+  2. ``TOS_BENCH_SWEEP=1 python bench.py``    -> bench_artifacts/sweep.json
+  3. ``tools/tpu_validate.py --json ...``     -> bench_artifacts/kernels.json
+  4. ``tools/profile_step.py``                -> bench_artifacts/profile.txt
+  5. ``tools/feed_bench.py`` (if present)     -> bench_artifacts/feed.json
+
+and appends a capture summary to ``BENCH_NOTES.md``. If the bench step
+yields a nonzero throughput the watcher exits 0 (capture done); otherwise
+it keeps watching — a flaky claim service that answers a probe and then
+drops the chip mid-run must not burn the round's only capture.
+
+If the service never answers, the probe log IS the deliverable: per-probe
+timestamps proving the environment, not the framework, withheld the
+number (the round-3 loop kept its log in /tmp and lost it; this one
+lives in the repo).
+
+Usage:  python tools/bench_watch.py [--interval 600] [--probe-timeout 150]
+        python tools/bench_watch.py --once     # single probe + capture try
+"""
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "BENCH_WATCH.log")
+ART = os.path.join(REPO, "bench_artifacts")
+NOTES = os.path.join(REPO, "BENCH_NOTES.md")
+
+PROBE_CODE = ("import jax; ds = jax.devices(); "
+              "print(ds[0].platform, getattr(ds[0], 'device_kind', '?'), "
+              "len(ds))")
+
+
+def _now():
+  return datetime.datetime.now().isoformat(timespec="seconds")
+
+
+def _log(msg):
+  line = "%s %s" % (_now(), msg)
+  print(line, flush=True)
+  with open(LOG, "a") as f:
+    f.write(line + "\n")
+
+
+def probe(timeout_s):
+  """One subprocess probe. Returns (ok, detail)."""
+  try:
+    res = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                         timeout=timeout_s, capture_output=True, text=True,
+                         cwd=REPO)
+  except subprocess.TimeoutExpired:
+    return False, "timeout after %ds" % timeout_s
+  if res.returncode != 0:
+    return False, "rc=%d: %s" % (res.returncode,
+                                 res.stderr.strip()[-200:].replace("\n", " | "))
+  return True, res.stdout.strip()
+
+
+def _run_step(name, cmd, timeout_s, out_path, env_extra=None):
+  """Run one capture step; tee stdout to out_path; return (rc, stdout_tail)."""
+  env = dict(os.environ)
+  if env_extra:
+    env.update(env_extra)
+  _log("capture step %s: %s (timeout %ds)" % (name, " ".join(cmd), timeout_s))
+  try:
+    res = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                         text=True, cwd=REPO, env=env)
+    rc, out, err = res.returncode, res.stdout, res.stderr
+  except subprocess.TimeoutExpired as e:
+    rc = -9
+    out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+    err = "TIMEOUT after %ds" % timeout_s
+  with open(out_path, "w") as f:
+    f.write(out)
+  with open(out_path + ".stderr", "w") as f:
+    f.write(err if isinstance(err, str) else err.decode())
+  _log("capture step %s done rc=%d -> %s" % (name, rc,
+                                             os.path.relpath(out_path, REPO)))
+  return rc, out.strip().splitlines()[-1] if out.strip() else ""
+
+
+def capture():
+  """Run the measurement stack. Returns the bench value (0.0 on failure)."""
+  os.makedirs(ART, exist_ok=True)
+  results = {}
+
+  # chip just answered a probe: a short preflight is enough, and the main
+  # budget goes to measuring
+  rc, tail = _run_step(
+      "bench", [sys.executable, "bench.py"], 1100,
+      os.path.join(ART, "bench.json"),
+      env_extra={"TOS_BENCH_PREFLIGHT_BUDGET": "300"})
+  value = 0.0
+  try:
+    parsed = json.loads(tail)
+    value = float(parsed.get("value", 0.0))
+    results["bench"] = parsed
+  except (ValueError, AttributeError):
+    results["bench"] = {"rc": rc, "raw": tail[:300]}
+  _log("bench value=%.1f rc=%d" % (value, rc))
+
+  if value <= 0.0:
+    # chip answered the probe but dropped mid-bench — don't burn the rest
+    # of the stack on a dead claim; keep watching instead
+    _append_notes(results, complete=False)
+    return value
+
+  rc, tail = _run_step(
+      "sweep", [sys.executable, "bench.py"], 3000,
+      os.path.join(ART, "sweep.json"),
+      env_extra={"TOS_BENCH_SWEEP": "1", "TOS_BENCH_TIMEOUT": "2700",
+                 "TOS_BENCH_PREFLIGHT_BUDGET": "300"})
+  try:
+    results["sweep"] = json.loads(tail)
+  except ValueError:
+    results["sweep"] = {"rc": rc, "raw": tail[:300]}
+
+  rc, tail = _run_step(
+      "kernels", [sys.executable, "tools/tpu_validate.py",
+                  "--json", os.path.join(ART, "kernels.json")], 3000,
+      os.path.join(ART, "kernels.stdout"))
+  results["kernels_rc"] = rc
+
+  rc, tail = _run_step(
+      "profile", [sys.executable, "tools/profile_step.py"], 1200,
+      os.path.join(ART, "profile.txt"))
+  results["profile_rc"] = rc
+
+  feed_bench = os.path.join(REPO, "tools", "feed_bench.py")
+  if os.path.exists(feed_bench):
+    rc, tail = _run_step(
+        "feed", [sys.executable, feed_bench], 1200,
+        os.path.join(ART, "feed.json"))
+    try:
+      results["feed"] = json.loads(tail)
+    except ValueError:
+      results["feed"] = {"rc": rc, "raw": tail[:300]}
+
+  _append_notes(results, complete=True)
+  return value
+
+
+def _append_notes(results, complete):
+  with open(NOTES, "a") as f:
+    f.write("\n## Watcher capture %s (%s)\n\n" %
+            (_now(), "complete" if complete else
+             "bench-only; chip dropped mid-run"))
+    f.write("Artifacts under `bench_artifacts/`; probe history in "
+            "`BENCH_WATCH.log`.\n\n```json\n")
+    f.write(json.dumps(results, indent=1)[:8000])
+    f.write("\n```\n")
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--interval", type=int, default=600,
+                  help="seconds between probes")
+  ap.add_argument("--probe-timeout", type=int, default=150,
+                  help="per-probe jax.devices() timeout (claim takes ~110s "
+                       "when the service is healthy)")
+  ap.add_argument("--once", action="store_true")
+  args = ap.parse_args()
+
+  import time
+  n = 0
+  _log("watcher start pid=%d interval=%ds probe_timeout=%ds"
+       % (os.getpid(), args.interval, args.probe_timeout))
+  while True:
+    n += 1
+    ok, detail = probe(args.probe_timeout)
+    _log("probe %d: %s — %s" % (n, "OK" if ok else "down", detail))
+    value = 0.0
+    if ok:
+      value = capture()
+      if value > 0.0:
+        _log("capture complete (value=%.1f); watcher exiting" % value)
+        return 0
+      _log("capture incomplete; continuing to watch")
+    if args.once:
+      return 0 if value > 0.0 else 1
+    time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+  sys.exit(main())
