@@ -1,0 +1,34 @@
+#!/bin/bash
+# Install the framework + JAX TPU runtime on a fresh TPU VM.
+# TPU-native analog of the reference's install_spark.sh (JDK + Spark
+# download): here the "runtime" is jax[tpu] against libtpu, and the
+# framework installs from this repo.
+#
+# Usage: ./install_tpu_vm.sh [repo-dir]
+# Env:   PYTHON (default python3), TOS_EXTRAS (pip extras, default none)
+set -euo pipefail
+
+REPO_DIR="${1:-$(cd "$(dirname "$0")/.." && pwd)}"
+PYTHON="${PYTHON:-python3}"
+
+echo "== installing JAX TPU runtime =="
+"$PYTHON" -m pip install -U pip
+"$PYTHON" -m pip install -U "jax[tpu]" \
+  -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+echo "== installing framework from ${REPO_DIR} =="
+"$PYTHON" -m pip install -e "${REPO_DIR}${TOS_EXTRAS:+[$TOS_EXTRAS]}"
+
+echo "== building the native codecs (optional, pure-Python fallback exists) =="
+if command -v g++ >/dev/null 2>&1 && [ -d "${REPO_DIR}/native" ]; then
+  make -C "${REPO_DIR}" native || \
+    echo "native build failed; the pure-Python codec paths will be used"
+fi
+
+echo "== smoke test =="
+"$PYTHON" - <<'EOF'
+import jax
+print("devices:", jax.devices())
+import tensorflowonspark_tpu
+print("framework import ok")
+EOF

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Create a TPU pod slice (multi-host) and install the framework on every
+# host. TPU-native analog of the reference's spark-ec2 provisioning
+# (scripts/spark_ec2.py): cloud resources in, ready-to-train cluster out.
+#
+# Usage: ./provision_tpu_pod.sh
+# Env:   TPU_NAME (default tos-pod), ZONE (default us-central2-b),
+#        ACCELERATOR (default v4-32), RUNTIME_VERSION (default
+#        tpu-ubuntu2204-base), REPO_GIT (default: rsync this checkout)
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:-tos-pod}"
+ZONE="${ZONE:-us-central2-b}"
+ACCELERATOR="${ACCELERATOR:-v4-32}"
+RUNTIME_VERSION="${RUNTIME_VERSION:-tpu-ubuntu2204-base}"
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+
+echo "== creating TPU pod slice ${TPU_NAME} (${ACCELERATOR}) =="
+gcloud compute tpus tpu-vm create "${TPU_NAME}" \
+  --zone="${ZONE}" \
+  --accelerator-type="${ACCELERATOR}" \
+  --version="${RUNTIME_VERSION}"
+
+echo "== shipping the framework to every host =="
+if [ -n "${REPO_GIT:-}" ]; then
+  gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone="${ZONE}" --worker=all \
+    --command="git clone --depth 1 ${REPO_GIT} tensorflowonspark_tpu || (cd tensorflowonspark_tpu && git pull)"
+else
+  gcloud compute tpus tpu-vm scp --recurse "${REPO_DIR}" \
+    "${TPU_NAME}:~/tensorflowonspark_tpu" --zone="${ZONE}" --worker=all
+fi
+
+echo "== installing on every host =="
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone="${ZONE}" --worker=all \
+  --command="bash ~/tensorflowonspark_tpu/scripts/install_tpu_vm.sh ~/tensorflowonspark_tpu"
+
+cat <<EOF
+== pod ready ==
+Run a multi-host job (one process per host; JAX wires the ICI mesh):
+  gcloud compute tpus tpu-vm ssh ${TPU_NAME} --zone=${ZONE} --worker=all \\
+    --command="cd ~/tensorflowonspark_tpu && python examples/mnist/mnist_engine.py ..."
+Point executors at a remote driver's control plane with
+  TOS_TPU_SERVER_HOST=<driver-ip> TOS_TPU_SERVER_PORT=<port>
+(see scripts/README.md for the full env checklist).
+EOF

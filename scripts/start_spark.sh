@@ -1,0 +1,39 @@
+#!/bin/bash
+# Start a Spark standalone cluster on this TPU VM, sized so each Spark
+# worker slot owns one TPU-chip group. Parity with the reference's
+# scripts/start_spark.sh (master + worker bring-up), with the worker
+# count derived from the TPU topology instead of hand-set.
+#
+# Usage: ./start_spark.sh
+# Env:   SPARK_HOME (required), CHIPS_PER_NODE (default 1),
+#        SPARK_WORKER_MEM (default 4G)
+set -euo pipefail
+
+: "${SPARK_HOME:?set SPARK_HOME to a Spark installation}"
+CHIPS_PER_NODE="${CHIPS_PER_NODE:-1}"
+SPARK_WORKER_MEM="${SPARK_WORKER_MEM:-4G}"
+
+# chips on this host -> number of worker slots
+CHIPS=$(python3 - <<'EOF'
+from tensorflowonspark_tpu.utils import tpu_info
+topo = tpu_info.get_topology()
+print(topo.chips_per_host if topo else 0)
+EOF
+)
+if [ "${CHIPS}" = "0" ]; then
+  echo "no TPU topology visible; defaulting to 1 worker slot" >&2
+  CHIPS=1
+fi
+WORKERS=$(( CHIPS / CHIPS_PER_NODE ))
+[ "${WORKERS}" -ge 1 ] || WORKERS=1
+
+export MASTER="spark://$(hostname):7077"
+export SPARK_WORKER_INSTANCES="${WORKERS}"
+
+echo "== starting master (${MASTER}) + ${WORKERS} worker slot(s) =="
+"${SPARK_HOME}/sbin/start-master.sh"
+"${SPARK_HOME}/sbin/start-worker.sh" -c 1 -m "${SPARK_WORKER_MEM}" "${MASTER}"
+
+echo "export MASTER=${MASTER}"
+echo "export SPARK_WORKER_INSTANCES=${WORKERS}"
+echo "submit with: scripts/submit_train.sh <app.py> [args...]"

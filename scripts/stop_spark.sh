@@ -1,0 +1,7 @@
+#!/bin/bash
+# Stop the Spark standalone cluster started by start_spark.sh
+# (parity: reference scripts/stop_spark.sh).
+set -euo pipefail
+: "${SPARK_HOME:?set SPARK_HOME to a Spark installation}"
+"${SPARK_HOME}/sbin/stop-worker.sh"
+"${SPARK_HOME}/sbin/stop-master.sh"

@@ -56,10 +56,11 @@ class TransformerConfig:
   layer_norm_impl: str = "auto"
   # "fused": the ln2 -> MLP up-projection pair runs as ONE Pallas kernel
   # (ops.ln_matmul) — the normalized activation never round-trips HBM
-  # (interpret mode off-TPU). Applies in mesh-free contexts (single-chip
-  # training, pipeline stage bodies); with a mesh the pair stays unfused.
-  # Param tree is IDENTICAL either way (ln2/scale, mlp/up/kernel), so
-  # checkpoints are interchangeable across settings. "off" opts out.
+  # (interpret mode off-TPU). Applies everywhere except decode: mesh-free
+  # contexts run the plain kernel, sharded models map it per-shard
+  # through shard_map (ops.ln_matmul_sharded). Param tree is IDENTICAL
+  # either way (ln2/scale, mlp/up/kernel), so checkpoints are
+  # interchangeable across settings. "off" opts out.
   ln_matmul_impl: str = "off"
   # Mixture-of-experts: when moe_experts > 0, every `moe_every`-th layer
   # (moe_every >= 1) replaces its dense MLP with an expert-routed FFN
@@ -192,12 +193,17 @@ def _make_layer_norm(cfg: TransformerConfig, mesh, name: str):
   return nn.LayerNorm(dtype=jnp.float32, use_bias=False, name=name)
 
 
-def _ln_matmul_call(x, ln_scale, w2):
+def _ln_matmul_call(x, ln_scale, w2, mesh=None):
   """The fused LN+matmul kernel with the shared off-TPU interpret policy
-  (one definition for the attention and MLP call sites)."""
-  from tensorflowonspark_tpu.ops import ln_matmul as _lnmm
-  return _lnmm.ln_matmul(x, ln_scale, w2,
-                         interpret=jax.default_backend() != "tpu")
+  (one definition for the attention and MLP call sites). With a mesh the
+  kernel maps per-shard through shard_map (ops.ln_matmul_sharded), so the
+  multi-chip training path gets the fusion too."""
+  from tensorflowonspark_tpu.ops import ln_matmul as _ln_mm
+  from tensorflowonspark_tpu.ops import ln_matmul_sharded as _ln_mm_sh
+  interp = jax.default_backend() != "tpu"
+  if mesh is not None:
+    return _ln_mm_sh(x, ln_scale, w2, mesh, interpret=interp)
+  return _ln_mm(x, ln_scale, w2, interpret=interp)
 
 
 # grouped-KV head broadcast: ONE definition, shared with the ring
@@ -254,7 +260,8 @@ class Attention(nn.Module):
         kernel = _QKVKernel(cfg.d_model, h + 2 * hk, cfg.head_dim,
                             heads_axis(h + 2 * hk), name="qkv")()
         flat = _ln_matmul_call(
-            x, ln_scale, kernel.reshape(cfg.d_model, -1).astype(cfg.dtype))
+            x, ln_scale, kernel.reshape(cfg.d_model, -1).astype(cfg.dtype),
+            mesh=self.mesh)
         qkv = flat.reshape(x.shape[:-1] + (h + 2 * hk, cfg.head_dim))
       else:
         qkv = dense((h + 2 * hk, cfg.head_dim),
@@ -282,23 +289,24 @@ class Attention(nn.Module):
     interp = jax.default_backend() != "tpu"   # forced-flash CI runs
     if cfg.use_ring_attention and self.mesh is not None:
       # the ring takes GROUPED K/V as-is: unexpanded blocks rotate on the
-      # ICI (num_heads/kv_heads less traffic) and expand per step locally
+      # ICI (num_heads/kv_heads less traffic); the flash kernels consume
+      # them unexpanded and the dense block math fuses the expand
       seq_shards = self.mesh.shape.get(mesh_lib.AXIS_SEQUENCE, 1)
       local_seq = q.shape[1] // max(1, seq_shards)
       out = ra.ring_attention(q, k, v, self.mesh, causal=True,
                               use_flash=_flash_eligible(cfg, local_seq),
                               interpret=interp)
     else:
-      # single-shard paths attend at full head count: broadcast each KV
-      # head to its query group (XLA fuses the repeat; the kernels stay
-      # MHA-shaped)
-      k = _expand_kv(k, cfg.num_heads)
-      v = _expand_kv(v, cfg.num_heads)
       if _flash_eligible(cfg, q.shape[1]):
+        # the flash kernels consume grouped KV natively (grouped-aware
+        # BlockSpec; cross-head dK/dV accumulation in the backward grid)
         from tensorflowonspark_tpu.ops import flash_attention
         out = flash_attention(q, k, v, causal=True, interpret=interp)
       else:
-        out = ra.full_attention(q, k, v, causal=True)
+        # the dense reference attends at full head count: broadcast each
+        # KV head to its query group (XLA fuses the repeat)
+        out = ra.full_attention(q, _expand_kv(k, cfg.num_heads),
+                                _expand_kv(v, cfg.num_heads), causal=True)
 
     return self._out_proj(out)
 
@@ -376,6 +384,7 @@ class _UpKernel(nn.Module):
 
 class MLPBlock(nn.Module):
   cfg: TransformerConfig
+  mesh: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x, ln_scale=None):
@@ -385,7 +394,8 @@ class MLPBlock(nn.Module):
     cfg = self.cfg
     if ln_scale is not None:
       kernel = _UpKernel(cfg.d_model, cfg.d_ff, name="up")()
-      h = _ln_matmul_call(x, ln_scale, kernel.astype(cfg.dtype))
+      h = _ln_matmul_call(x, ln_scale, kernel.astype(cfg.dtype),
+                          mesh=self.mesh)
     else:
       h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
                    kernel_init=nn.with_logical_partitioning(
@@ -487,8 +497,7 @@ class Block(nn.Module):
   @nn.compact
   def __call__(self, x, positions, decode: bool = False):
     cfg = self.cfg
-    fuse_ln = (cfg.ln_matmul_impl == "fused" and self.mesh is None
-               and not decode)
+    fuse_ln = cfg.ln_matmul_impl == "fused" and not decode
     if fuse_ln and cfg.fuse_qkv:
       # ln1 + the fused QKV projection as ONE kernel over the raw
       # residual stream (param paths unchanged: ln1/scale, attn/qkv)
@@ -503,7 +512,7 @@ class Block(nn.Module):
       # ln2 + up-projection as ONE kernel over the raw residual stream;
       # same param paths as the unfused branch (ln2/scale, mlp/up/kernel)
       scale = _LNScale(cfg.d_model, name="ln2")()
-      x = x + MLPBlock(cfg, name="mlp")(x, ln_scale=scale)
+      x = x + MLPBlock(cfg, self.mesh, name="mlp")(x, ln_scale=scale)
     else:
       y = _make_layer_norm(cfg, self.mesh, "ln2")(x)
       if self.use_moe:

@@ -22,6 +22,14 @@ accepts cotangents for both outputs (the lse cotangent folds into Δ).
 
 ``interpret=True`` runs the same kernels on CPU for tests. Layout:
 [batch, seq, heads, head_dim].
+
+Grouped-query attention is native: K/V may carry ``heads / g`` heads (KV
+head j serves query heads [j·g, (j+1)·g) — the blocked convention shared
+with ``parallel.ring_attention.expand_heads``). The forward and dQ
+kernels just remap their KV BlockSpec row (query head → its KV head), so
+the grouped block is read straight from HBM with no g× expansion; dK/dV
+accumulate across the g query heads inside the grid (see the ``_gqa``
+kernels) instead of summing an expanded cotangent.
 """
 
 import functools
@@ -234,6 +242,123 @@ def _attn_bwd_fused_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
   dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _attn_bwd_dkv_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
+                             lse_ref, delta_ref, dk_ref, dv_ref, *,
+                             blk_q: int, blk_k: int, q_len: int,
+                             causal: bool, scale: float):
+  """Grouped-KV dK/dV: grid (b·kv_heads, n_kblocks, group).
+
+  The group axis is INNERMOST, so each (blk_k, D) dK/dV block stays
+  VMEM-resident while its g query heads sweep past, accumulating into it
+  in f32 (assigned at qh == 0, read-modify-write after) — cross-head
+  accumulation in the grid instead of expanding K/V g× through HBM and
+  summing an expanded cotangent outside. Per-(q, k) block math is
+  identical to :func:`_attn_bwd_dkv_kernel`; the q/do/lse/delta
+  BlockSpecs select the current query head's row.
+  """
+  ki = pl.program_id(1)
+  qh = pl.program_id(2)
+  q_base = qb_ref[0]
+  k_base = kb_ref[0]
+  k = k_ref[0].astype(jnp.float32)                  # [blk_k, D]
+  v = v_ref[0].astype(jnp.float32)
+  n_qblocks = q_len // blk_q
+
+  def body(qi, carry):
+    dk, dv = carry
+    q = q_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32) * scale
+    do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
+    delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    p, ds = _pair_p_ds(s, lse, delta, do, v)
+    return dk + ds.T @ q, dv + p.T @ do
+
+  dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
+  dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
+  lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
+  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
+
+  @pl.when(qh == 0)
+  def _assign():  # noqa: ANN202 - pallas region
+    dk_ref[0] = dk
+    dv_ref[0] = dv
+
+  @pl.when(qh != 0)
+  def _accumulate():  # noqa: ANN202 - pallas region
+    dk_ref[0] = dk_ref[0] + dk
+    dv_ref[0] = dv_ref[0] + dv
+
+
+def _attn_bwd_fused_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
+                               lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *,
+                               blk_q: int, blk_k: int, q_len: int,
+                               causal: bool, scale: float):
+  """Grouped-KV single-pass backward: grid (b·kv_heads, group, n_kblocks).
+
+  dQ of the current query head accumulates across the innermost k-block
+  axis (zeroed at ki == 0) exactly like the MHA fused kernel. dK/dV are
+  FULL [s_kv, D] f32 blocks resident across the whole (group, k-block)
+  sweep; each step read-modify-writes only its blk_k-row slice, assigning
+  at qh == 0 and accumulating after. VMEM ≈ (2·s_kv + s_q)·D·4B for the
+  residents — :func:`_gqa_fused_fits` guards it and callers fall back to
+  the split plan when it exceeds the budget.
+  """
+  qh = pl.program_id(1)
+  ki = pl.program_id(2)
+  q_base = qb_ref[0]
+  k_base = kb_ref[0]
+  k = k_ref[0].astype(jnp.float32)                  # [blk_k, D]
+  v = v_ref[0].astype(jnp.float32)
+  n_qblocks = q_len // blk_q
+
+  @pl.when(ki == 0)
+  def _zero_dq():  # noqa: ANN202 - pallas region
+    dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+  def body(qi, carry):
+    dk, dv = carry
+    q = q_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32) * scale
+    do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
+    delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    p, ds = _pair_p_ds(s, lse, delta, do, v)
+    dv_new = dv + p.T @ do
+    dk_new = dk + ds.T @ q                          # q pre-scaled: absorbs it
+    prev = dq_ref[0, pl.ds(qi * blk_q, blk_q), :]
+    dq_ref[0, pl.ds(qi * blk_q, blk_q), :] = prev + (ds @ k) * scale
+    return dk_new, dv_new
+
+  dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
+  dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
+  lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
+  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
+
+  sl = pl.ds(ki * blk_k, blk_k)
+
+  @pl.when(qh == 0)
+  def _assign():  # noqa: ANN202 - pallas region
+    dk_ref[0, sl, :] = dk
+    dv_ref[0, sl, :] = dv
+
+  @pl.when(qh != 0)
+  def _accumulate():  # noqa: ANN202 - pallas region
+    dk_ref[0, sl, :] = dk_ref[0, sl, :] + dk
+    dv_ref[0, sl, :] = dv_ref[0, sl, :] + dv
+
+
+# VMEM budget for the grouped fused backward's resident blocks (dK+dV full
+# f32 + dQ f32 + q/do); past this the split plan wins anyway because the
+# residents crowd out double-buffering for the streamed blocks
+GQA_FUSED_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _gqa_fused_fits(s_q: int, s_kv: int, d: int, itemsize: int) -> bool:
+  resident = (2 * s_kv + s_q) * d * 4 + 2 * s_q * d * itemsize
+  return resident <= GQA_FUSED_VMEM_BUDGET
+
+
 # --- shared impl -----------------------------------------------------------
 
 
@@ -277,11 +402,41 @@ def _base_arrays(q_base, kv_base):
   return qb, kb
 
 
+def _group(q, k):
+  """(kv_heads, group) from q/k head counts, validating divisibility."""
+  h, hk = q.shape[2], k.shape[2]
+  if h % hk:
+    raise ValueError("kv heads (%d) must divide query heads (%d)"
+                     % (hk, h))
+  return hk, h // hk
+
+
+def _kv_row_map(h, hk, g):
+  """KV BlockSpec row for folded-query-row ``i``: query head i%h reads
+  its group's KV head — the grouped-aware index map that lets the kernels
+  consume unexpanded K/V (g == 1 degenerates to row i)."""
+  return lambda i, j, *_: ((i // h) * hk + (i % h) // g, 0, 0)
+
+
+def _q_row_map(h, hk, grp, qh_axis):
+  """Query-row BlockSpec map for the grouped (b·hk)-rooted grids: grid
+  dim 0 is the folded KV row, grid dim ``qh_axis`` the head-in-group
+  position; the map selects that query head's folded row. ONE definition
+  for both grouped backward plans so the blocked grouping convention
+  (KV head j serves query heads [j·g, (j+1)·g)) cannot drift between
+  them."""
+  def _map(*idx):
+    i, qh = idx[0], idx[qh_axis]
+    return ((i // hk) * h + (i % hk) * grp + qh, 0, 0)
+  return _map
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
                                              "interpret"))
 def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret):
   b, s_q, h, d = q.shape
   s_kv = k.shape[1]
+  hk, g = _group(q, k)
   blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
   scale = 1.0 / (d ** 0.5)
   qf, kf, vf = _fold(q), _fold(k), _fold(v)
@@ -296,8 +451,8 @@ def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret):
           grid=(b * h, s_q // blk_q),
           in_specs=[
               pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
-              pl.BlockSpec((1, s_kv, d), lambda i, j, *_: (i, 0, 0)),
-              pl.BlockSpec((1, s_kv, d), lambda i, j, *_: (i, 0, 0)),
+              pl.BlockSpec((1, s_kv, d), _kv_row_map(h, hk, g)),
+              pl.BlockSpec((1, s_kv, d), _kv_row_map(h, hk, g)),
           ],
           out_specs=[
               pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
@@ -349,9 +504,11 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
               blk_k, interpret, bwd="fused"):
   b, s_q, h, d = q.shape
   s_kv = k.shape[1]
+  hk, grp = _group(q, k)
   blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
   scale = 1.0 / (d ** 0.5)
-  qf, kf, vf, of, gf = (_fold(x) for x in (q, k, v, out, g))
+  qf, of, gf = (_fold(x) for x in (q, out, g))
+  kf, vf = _fold(k), _fold(v)
   qb, kb = _base_arrays(q_base, kv_base)
 
   # Δ_i = Σ_d dO·O  (+ the lse cotangent folds in with opposite sign:
@@ -366,6 +523,54 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
 
   full3 = lambda i, j, *_: (i, 0, 0)      # noqa: E731
   row3 = lambda i, j, *_: (i, j, 0)       # noqa: E731
+  kvfull = _kv_row_map(h, hk, grp)        # query row i -> its KV head's row
+
+  if bwd == "fused" and grp > 1 and not _gqa_fused_fits(
+      s_q, s_kv, d, q.dtype.itemsize):
+    bwd = "split"   # resident dK/dV would not fit VMEM; split plan wins
+    if (blk_q, blk_k) == DEFAULT_BWD_BLOCKS["fused"]:
+      # defaults were in play: re-resolve to the split plan's tuning
+      # (keep explicit caller overrides untouched)
+      blk_q, blk_k = DEFAULT_BWD_BLOCKS["split"]
+      blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
+
+  if bwd == "fused" and grp > 1:
+    qrow = _q_row_map(h, hk, grp, qh_axis=1)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_attn_bwd_fused_gqa_kernel, blk_q=blk_q,
+                          blk_k=blk_k, q_len=s_q, causal=causal,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * hk, grp, s_kv // blk_k),
+            in_specs=[
+                pl.BlockSpec((1, s_q, d), qrow),
+                pl.BlockSpec((1, blk_k, d),
+                             lambda i, qh, ki, *_: (i, ki, 0)),
+                pl.BlockSpec((1, blk_k, d),
+                             lambda i, qh, ki, *_: (i, ki, 0)),
+                pl.BlockSpec((1, s_q, d), qrow),
+                pl.BlockSpec((1, s_q, LANES), qrow),
+                pl.BlockSpec((1, s_q, LANES), qrow),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, s_q, d), qrow),    # dQ: resident across ki
+                pl.BlockSpec((1, s_kv, d),
+                             lambda i, qh, ki, *_: (i, 0, 0)),
+                pl.BlockSpec((1, s_kv, d),
+                             lambda i, qh, ki, *_: (i, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hk, s_kv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hk, s_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, qf, kf, vf, gf, lse_f, delta)
+    return (_unfold(dq, b, h).astype(q.dtype),
+            _unfold(dk, b, hk).astype(k.dtype),
+            _unfold(dv, b, hk).astype(v.dtype))
 
   if bwd == "fused":
     dq, dk, dv = pl.pallas_call(
@@ -406,8 +611,8 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
           grid=(b * h, s_q // blk_q),
           in_specs=[
               pl.BlockSpec((1, blk_q, d), row3),
-              pl.BlockSpec((1, s_kv, d), full3),
-              pl.BlockSpec((1, s_kv, d), full3),
+              pl.BlockSpec((1, s_kv, d), kvfull),
+              pl.BlockSpec((1, s_kv, d), kvfull),
               pl.BlockSpec((1, blk_q, d), row3),
               pl.BlockSpec((1, blk_q, LANES), row3),
               pl.BlockSpec((1, blk_q, LANES), row3),
@@ -417,6 +622,42 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
       out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
       interpret=interpret,
   )(qb, kb, qf, kf, vf, gf, lse_f, delta)
+
+  if grp > 1:
+    qrow = _q_row_map(h, hk, grp, qh_axis=2)
+    dk, dv = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_gqa_kernel, blk_q=blk_q,
+                          blk_k=blk_k, q_len=s_q, causal=causal,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * hk, s_kv // blk_k, grp),
+            in_specs=[
+                pl.BlockSpec((1, s_q, d), qrow),
+                pl.BlockSpec((1, blk_k, d),
+                             lambda i, ki, qh, *_: (i, ki, 0)),
+                pl.BlockSpec((1, blk_k, d),
+                             lambda i, ki, qh, *_: (i, ki, 0)),
+                pl.BlockSpec((1, s_q, d), qrow),
+                pl.BlockSpec((1, s_q, LANES), qrow),
+                pl.BlockSpec((1, s_q, LANES), qrow),
+            ],
+            out_specs=[
+                # resident across the innermost group sweep
+                pl.BlockSpec((1, blk_k, d),
+                             lambda i, ki, qh, *_: (i, ki, 0)),
+                pl.BlockSpec((1, blk_k, d),
+                             lambda i, ki, qh, *_: (i, ki, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hk, s_kv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hk, s_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, qf, kf, vf, gf, lse_f, delta)
+    return (_unfold(dq, b, h), _unfold(dk, b, hk).astype(k.dtype),
+            _unfold(dv, b, hk).astype(v.dtype))
 
   dk, dv = pl.pallas_call(
       functools.partial(_attn_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
@@ -454,11 +695,13 @@ def flash_attention(q, k, v, causal: bool = True, blk_q: int = 256,
                     blk_k: int = 512, interpret: bool = False,
                     bwd: str = None, blk_bwd_q: int = None,
                     blk_bwd_k: int = None):
-  """Fused (self-)attention with fused backward. q/k/v: [batch, seq,
-  heads, head_dim]; seq must divide by the (clamped) block sizes.
-  ``bwd``: 'fused' (single-pass dQ/dK/dV) or 'split' (two kernels);
-  defaults to :func:`default_bwd_mode`. The backward uses its own block
-  sizes (``DEFAULT_BWD_BLOCKS`` per mode unless overridden)."""
+  """Fused (self-)attention with fused backward. q: [batch, seq, heads,
+  head_dim]; k/v: same, or with heads/g KV heads (grouped-query
+  attention — consumed unexpanded, see module docstring); seq must
+  divide by the (clamped) block sizes. ``bwd``: 'fused' (single-pass
+  dQ/dK/dV) or 'split' (two kernels); defaults to
+  :func:`default_bwd_mode`. The backward uses its own block sizes
+  (``DEFAULT_BWD_BLOCKS`` per mode unless overridden)."""
   bwd, blk_bwd_q, blk_bwd_k = _resolve_bwd(bwd, blk_bwd_q, blk_bwd_k)
   return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret, bwd,
                     blk_bwd_q, blk_bwd_k)
@@ -497,7 +740,8 @@ def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
   """Partial attention of local queries against ONE KV block.
 
   q: [B, Sq, H, D] at absolute positions ``q_base + arange(Sq)``;
-  k/v: [B, Sk, H, D] at ``kv_base + arange(Sk)`` (bases may be traced —
+  k/v: [B, Sk, H, D] — or [B, Sk, H/g, D] grouped (GQA), consumed
+  unexpanded — at ``kv_base + arange(Sk)`` (bases may be traced —
   inside shard_map they depend on ``lax.axis_index``). Returns
   (normalized partial output, logsumexp) — merge partials across blocks
   with :func:`merge_partials`. Differentiable in q/k/v (including through

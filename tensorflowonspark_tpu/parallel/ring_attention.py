@@ -32,12 +32,11 @@ def expand_heads(kv, num_heads: int):
   """Broadcast grouped-query KV heads up to the query head count (KV head
   j serves query heads [j*g, (j+1)*g) — blocked layout). Under GQA the
   ring permutes the UNEXPANDED blocks — a num_heads/kv_heads cut in ICI
-  traffic — and each step expands locally right before the block math.
-  (On the flash path the expanded block transits HBM per step because a
-  repeat can't fuse into the kernel's custom call; a grouped-aware KV
-  BlockSpec would avoid that but needs cross-head grid accumulation in
-  the fused backward — ROADMAP. The dense path's einsum fuses the
-  repeat.) The ONE head-broadcast helper — models/transformer.py uses it
+  traffic. The flash path consumes them unexpanded too (the kernels'
+  grouped-aware KV BlockSpec + cross-head dK/dV grid accumulation,
+  ops.flash_attention module docstring — the round-3 ROADMAP deferral,
+  closed); only the dense block math expands, and its einsum fuses the
+  repeat. The ONE head-broadcast helper — models/transformer.py uses it
   too, so the grouping convention cannot drift."""
   hk = kv.shape[2]
   if hk == num_heads:
@@ -138,8 +137,12 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
   def body(step, carry):
     k_blk, v_blk, o, lse = carry
     src = (my - step) % n
+    # grouped KV feeds the kernel UNEXPANDED: the flash kernels carry a
+    # grouped-aware KV BlockSpec (query head -> its KV head row) with
+    # cross-head dK/dV accumulation in the backward grid, so the expanded
+    # block never exists — not in HBM, not per step
     o_j, lse_j = flash_attention_block(
-        q, _expand_heads(k_blk, h), _expand_heads(v_blk, h),
+        q, k_blk, v_blk,
         my * s_local, src * s_local, causal=causal,
         blk_q=blk_q, blk_k=blk_k, interpret=interpret,
         blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k, bwd=bwd)

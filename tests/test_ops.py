@@ -174,6 +174,77 @@ class TestFlashAttention:
     assert jnp.max(jnp.abs(out - ref)) < 2e-5
 
 
+class TestFlashAttentionGQA:
+  """Grouped-query attention consumed natively by the flash kernels:
+  grouped KV read straight through the remapped BlockSpec (no g× HBM
+  expansion) and dK/dV accumulated across the query-head group inside
+  the backward grid (round-3 verdict item 5 / ROADMAP deferral)."""
+
+  def _data(self, B=2, S=128, H=8, HK=2, D=16, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, HK, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, HK, D), jnp.float32)
+    t = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    return q, k, v, t
+
+  @pytest.mark.parametrize("causal", [True, False])
+  def test_forward_matches_expanded(self, causal):
+    q, k, v, _ = self._data()
+    H = q.shape[2]
+    ref = ra.full_attention(q, ra.expand_heads(k, H), ra.expand_heads(v, H),
+                            causal=causal)
+    out = flash_attention(q, k, v, causal=causal, blk_q=32, blk_k=32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+  @pytest.mark.parametrize("bwd", ["split", "fused"])
+  def test_grads_match_expanded(self, bwd):
+    """dK/dV arrive GROUPED (summed over each KV head's query group),
+    matching AD through an explicit expand of the dense reference."""
+    q, k, v, t = self._data(seed=1)
+    H = q.shape[2]
+
+    def loss_flash(q, k, v):
+      return jnp.sum(t * flash_attention(q, k, v, causal=True, blk_q=32,
+                                         blk_k=32, interpret=True, bwd=bwd))
+
+    def loss_ref(q, k, v):
+      return jnp.sum(t * ra.full_attention(
+          q, ra.expand_heads(k, H), ra.expand_heads(v, H), causal=True))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert gf[1].shape == k.shape and gf[2].shape == v.shape
+    for a, b in zip(gf, gr):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 atol=2e-5, rtol=2e-5)
+
+  def test_mqa_single_kv_head(self):
+    """MQA (one KV head for all queries) is the extreme group."""
+    q, k, v, t = self._data(HK=1, seed=2)
+    H = q.shape[2]
+    ref = ra.full_attention(q, ra.expand_heads(k, H), ra.expand_heads(v, H),
+                            causal=True)
+    out = flash_attention(q, k, v, causal=True, blk_q=32, blk_k=32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+  def test_indivisible_heads_raise(self):
+    q, k, v, _ = self._data(H=8, HK=3)
+    with pytest.raises(ValueError, match="divide"):
+      flash_attention(q, k, v, interpret=True)
+
+  def test_fused_vmem_guard(self):
+    """The grouped fused backward falls back to the split plan when its
+    resident dK/dV + dQ blocks exceed the VMEM budget."""
+    from tensorflowonspark_tpu.ops.flash_attention import _gqa_fused_fits
+    assert _gqa_fused_fits(1024, 1024, 64, 2)       # bench GQA shape
+    assert not _gqa_fused_fits(8192, 8192, 128, 2)  # long-context: split
+
+
 class TestLNMatmul:
   """Fused LayerNorm + matmul (ops.ln_matmul): LN(x) @ W in one kernel."""
 
@@ -220,6 +291,69 @@ class TestLNMatmul:
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(self._ref(x, w, W),
                                                 np.float32), atol=0.1)
+
+  def test_sharded_matches_dense(self):
+    """Per-shard kernel over a data×sequence×tensor mesh == unsharded:
+    rows split over data/sequence, W's columns over tensor (the MLP-up /
+    QKV layouts), H contracted fully on-device — no collectives."""
+    from tensorflowonspark_tpu.ops.ln_matmul import ln_matmul_sharded
+    from tensorflowonspark_tpu.parallel import mesh as M
+
+    if len(jax.devices()) < 8:
+      pytest.skip("needs 8 virtual devices")
+    mesh = M.build_mesh(M.MeshSpec(data=2, sequence=2, tensor=2),
+                        devices=jax.devices()[:8])
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(4, 16, 64), jnp.float32)
+    w = jnp.asarray(rng.rand(64) + 0.5, jnp.float32)
+    W = jnp.asarray(rng.randn(64, 96) * 0.1, jnp.float32)
+    out = jax.jit(lambda x, w, W: ln_matmul_sharded(
+        x, w, W, mesh, interpret=True))(x, w, W)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(self._ref(x, w, W)),
+                               atol=1e-4, rtol=1e-4)
+
+  def test_sharded_gradients_match_dense(self):
+    """dW / dw_ln must sum over the row shards (shard_map transpose
+    psums over data/sequence), matching plain AD of the dense pair."""
+    from tensorflowonspark_tpu.ops.ln_matmul import ln_matmul_sharded
+    from tensorflowonspark_tpu.parallel import mesh as M
+
+    if len(jax.devices()) < 8:
+      pytest.skip("needs 8 virtual devices")
+    mesh = M.build_mesh(M.MeshSpec(data=2, sequence=2, tensor=2),
+                        devices=jax.devices()[:8])
+    rng = np.random.RandomState(12)
+    x = jnp.asarray(rng.randn(2, 8, 32), jnp.float32)
+    w = jnp.asarray(rng.rand(32) + 0.5, jnp.float32)
+    W = jnp.asarray(rng.randn(32, 48) * 0.1, jnp.float32)
+    gs = jax.jit(jax.grad(lambda *a: jnp.sum(ln_matmul_sharded(
+        *a, mesh, interpret=True) ** 2), argnums=(0, 1, 2)))(x, w, W)
+    gr = jax.grad(lambda *a: jnp.sum(
+        self._ref(*a) ** 2), argnums=(0, 1, 2))(x, w, W)
+    for a, b in zip(gs, gr):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 atol=2e-3, rtol=2e-3)
+
+  def test_sharded_indivisible_columns_replicate(self):
+    """A column count the tensor axis cannot divide keeps W replicated
+    instead of failing the shard_map split."""
+    from tensorflowonspark_tpu.ops.ln_matmul import ln_matmul_sharded
+    from tensorflowonspark_tpu.parallel import mesh as M
+
+    if len(jax.devices()) < 4:
+      pytest.skip("needs 4 virtual devices")
+    mesh = M.build_mesh(M.MeshSpec(data=2, tensor=2),
+                        devices=jax.devices()[:4])
+    rng = np.random.RandomState(13)
+    x = jnp.asarray(rng.randn(4, 8, 32), jnp.float32)
+    w = jnp.asarray(rng.rand(32) + 0.5, jnp.float32)
+    W = jnp.asarray(rng.randn(32, 33) * 0.1, jnp.float32)   # 33 % 2 != 0
+    out = jax.jit(lambda x, w, W: ln_matmul_sharded(
+        x, w, W, mesh, interpret=True))(x, w, W)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(self._ref(x, w, W)),
+                               atol=1e-4, rtol=1e-4)
 
   def test_model_fused_matches_unfused(self):
     """ln_matmul_impl='fused' changes neither the param tree nor the

@@ -323,6 +323,35 @@ class TestRingAttention:
     for shp in shapes:
       assert shp[2] == HK, "ring permuted expanded heads: %r" % (shp,)
 
+  def test_gqa_ring_flash_grads_match_expanded(self, devices):
+    """GQA ring on the FLASH path: grouped KV flows unexpanded into the
+    kernels (grouped-aware BlockSpec + cross-head dK/dV accumulation) and
+    grads still equal AD through the expanded dense reference — the
+    round-3 ROADMAP deferral, closed."""
+    mesh = M.build_mesh(M.MeshSpec(sequence=4), devices=devices[:4])
+    rng = np.random.RandomState(9)
+    B, S, H, HK, D = 1, 32, 4, 2, 8
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, HK, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, HK, D), jnp.float32)
+    w = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+
+    def loss_ring(q, k, v):
+      return jnp.sum(RA.ring_attention(q, k, v, mesh, causal=True,
+                                       use_flash=True, blk_q=8, blk_k=8,
+                                       interpret=True) * w)
+
+    def loss_dense(q, k, v):
+      ke = jnp.repeat(k, H // HK, axis=2)
+      ve = jnp.repeat(v, H // HK, axis=2)
+      return jnp.sum(RA.full_attention(q, ke, ve, causal=True) * w)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 atol=1e-4, rtol=1e-4)
+
   def test_ring_flash_gradients_match_dense(self, devices):
     """Training through ring-flash: grads equal dense full attention."""
     mesh = M.build_mesh(M.MeshSpec(sequence=4), devices=devices[:4])
@@ -728,6 +757,69 @@ class TestShardedTrainStep:
       losses[impl] = traj
     np.testing.assert_allclose(losses["fused"], losses["flax"],
                                atol=1e-5, rtol=1e-5)
+
+  def test_ln_matmul_fused_matches_unfused_sharded(self, devices):
+    """ln_matmul_impl="fused" on a dp×sp×tp mesh (per-shard kernel via
+    ops.ln_matmul_sharded) trains on the same trajectory as the unfused
+    model — round-3 verdict item 4: before this, the fusion applied only
+    in mesh-free contexts, so multi-chip training got nothing from it.
+    fuse_qkv=True covers BOTH fused call sites (ln1→QKV, ln2→up)."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+
+    import dataclasses
+    mesh = M.build_mesh(M.MeshSpec(data=2, sequence=2, tensor=2),
+                        devices=devices)
+    seq = 32
+    cfg = tfm.TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                                d_model=64, d_ff=128, max_seq_len=seq,
+                                remat=False, dtype=jnp.float32,
+                                use_ring_attention=True, fuse_qkv=True,
+                                layer_norm_impl="flax")
+    cfg_f = dataclasses.replace(cfg, ln_matmul_impl="fused")
+    state, sharding = tfm.create_sharded_state(jax.random.PRNGKey(0), cfg,
+                                               mesh, learning_rate=1e-2,
+                                               seq_len=seq)
+    state_f, sharding_f = tfm.create_sharded_state(jax.random.PRNGKey(0),
+                                                   cfg_f, mesh,
+                                                   learning_rate=1e-2,
+                                                   seq_len=seq)
+    # same param paths either way (checkpoints interchangeable)
+    assert (jax.tree.structure(state.params)
+            == jax.tree.structure(state_f.params))
+
+    base = np.tile(np.arange(seq) % 16, (4, 1)).astype("int32")
+    tokens = SH.shard_batch(jnp.asarray(base), mesh,
+                            extra_axes=(M.AXIS_SEQUENCE,))
+
+    # shared params: DenseGeneral and the raw 3-D kernel module draw
+    # different values from the same RNG path, so per-impl inits diverge
+    # numerically; the property under test is identical loss AND grads
+    # at identical params
+    def loss(c, p):
+      return tfm.causal_lm_loss(
+          tfm.Transformer(c, mesh).apply({"params": p}, tokens), tokens)
+
+    l0, g0 = jax.jit(jax.value_and_grad(
+        lambda p: loss(cfg, p)))(state.params)
+    l1, g1 = jax.jit(jax.value_and_grad(
+        lambda p: loss(cfg_f, p)))(state.params)
+    np.testing.assert_allclose(float(l0), float(l1), atol=1e-5, rtol=1e-5)
+    f0, _ = jax.flatten_util.ravel_pytree(g0)
+    f1, _ = jax.flatten_util.ravel_pytree(g1)
+    np.testing.assert_allclose(np.asarray(f0), np.asarray(f1),
+                               atol=2e-4, rtol=2e-4)
+
+    # and the fused config trains through the sharded step machinery
+    def loss_fn(params, toks, apply_fn=state_f.apply_fn):
+      return tfm.causal_lm_loss(apply_fn({"params": params}, toks), toks)
+
+    step = SH.make_train_step(loss_fn, mesh, sharding_f,
+                              batch_extra_axes=(M.AXIS_SEQUENCE,))
+    losses = []
+    for _ in range(4):
+      state_f, l = step(state_f, tokens)
+      losses.append(float(l))
+    assert losses[-1] < losses[0], losses
 
   def test_ring_flash_in_model_matches_dense(self, devices):
     """Sequence-parallel training with the flash kernels forced inside the

@@ -185,7 +185,12 @@ def main():
     _log("probe %d: %s — %s" % (n, "OK" if ok else "down", detail))
     value = 0.0
     if ok:
-      value = capture()
+      # a capture failure must never kill the standing watch (the whole
+      # point of this tool over round-3's one-shot attempts)
+      try:
+        value = capture()
+      except Exception as e:  # noqa: BLE001 - log and keep watching
+        _log("capture attempt raised %r; continuing to watch" % (e,))
       if value > 0.0:
         _log("capture complete (value=%.1f); watcher exiting" % value)
         return 0

@@ -1,0 +1,215 @@
+"""Feed-plane vs compute: can the host feed pipeline keep a chip fed?
+
+Round-3 verdict item 6: every feed-plane number so far (shm ring 2.5x,
+columnar codec 3.2x) was CPU-relative — never measured against a real
+training step to show the feed plane keeps the chip busy, which is the
+reference's actual bottleneck (SURVEY §3.2; BASELINE config 2 is the
+MNIST InputMode.SPARK analog).
+
+Method: one FEEDER subprocess (pure Python — it never imports jax, so it
+cannot claim the tunneled TPU) pushes MNIST-shaped row chunks through the
+REAL feed plane (the hub queue, and the native shm ring when available);
+the main process consumes them through :class:`DataFeed` exactly like an
+executor's training loop — ``next_batch`` → stack → ``device_put`` →
+jitted train step — and times steps/sec. The same loop with pre-staged
+device data gives the compute-bound rate; the gap is the feed overhead.
+
+Prints ONE JSON line:
+  {"metric": "feed_overhead_pct", "per_transport": {...},
+   "compute_steps_per_sec": ..., "batch": ..., "row_bytes": ...}
+
+Usage:  python tools/feed_bench.py [--steps 60] [--batch 128] [--smoke]
+The watcher (tools/bench_watch.py) runs this automatically on first chip
+contact.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+AUTHKEY = b"feedbench"
+
+
+def feeder_main(addr_str, total_rows, chunk):
+  """Subprocess entry: push rows through the hub/ring. NO jax imports."""
+  import numpy as np
+  from tensorflowonspark_tpu.control import feedhub
+
+  host, port = addr_str.rsplit(":", 1)
+  hub = feedhub.connect((host, int(port)), AUTHKEY)
+
+  # resolve the producer channel the way node.input_channel does: the
+  # advertised shm ring when reachable, else the hub queue
+  chan = hub.get_queue("input")
+  ring_name = hub.get("ring_name")
+  if ring_name:
+    from tensorflowonspark_tpu.control import shmring
+    try:
+      chan = shmring.RingQueueAdapter(shmring.open_cached(ring_name))
+    except Exception:  # noqa: BLE001 - ring unavailable: queue fallback
+      pass
+
+  rng = np.random.RandomState(0)
+  image = rng.rand(28 * 28).astype("float32")
+  sent = 0
+  while sent < total_rows:
+    n = min(chunk, total_rows - sent)
+    rows = [(image, int(i % 10)) for i in range(n)]
+    chan.put_many(rows)
+    sent += n
+  chan.put(None)   # end-of-feed marker
+
+
+def _model_step():
+  """A jitted MNIST-class train step (BASELINE config 2 analog)."""
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from flax import linen as nn
+  from flax.training import train_state
+
+  class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+      x = nn.Dense(512)(x)
+      x = nn.relu(x)
+      x = nn.Dense(512)(x)
+      x = nn.relu(x)
+      return nn.Dense(10)(x)
+
+  model = MLP()
+  params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+  state = train_state.TrainState.create(
+      apply_fn=model.apply, params=params, tx=optax.sgd(0.01))
+
+  @jax.jit
+  def step(state, x, y):
+    def loss_fn(p):
+      logits = state.apply_fn({"params": p}, x)
+      one_hot = jax.nn.one_hot(y, 10)
+      return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * one_hot, -1))
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    return state.apply_gradients(grads=grads), loss
+
+  return state, step
+
+
+def run_transport(transport, steps, batch, chunk):
+  """Feed `steps` batches through one transport; return steps/sec."""
+  import numpy as np
+  from tensorflowonspark_tpu.control import feedhub
+  from tensorflowonspark_tpu.datafeed import DataFeed
+
+  hub = feedhub.start(AUTHKEY, ["input", "output", "error", "control"],
+                      mode="remote")
+  ring = None
+  try:
+    if transport == "shm":
+      from tensorflowonspark_tpu.control import shmring
+      if not shmring.available():
+        return None, "native shm ring unavailable"
+      ring = shmring.ShmRing.create("/tos_feedbench_%d" % os.getpid(),
+                                    64 * 1024 * 1024)
+      hub.set("ring_name", ring.name)
+
+    total_rows = steps * batch
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--feeder",
+         "%s:%d" % hub.addr, str(total_rows), str(chunk)],
+        env={k: v for k, v in os.environ.items()
+             if k != "PALLAS_AXON_POOL_IPS"})
+    try:
+      import jax
+      state, step = _model_step()
+
+      feed = DataFeed(hub, train_mode=True)
+      # warmup: compile against the first batch
+      rows = feed.next_batch(batch)
+      x = jax.device_put(np.stack([r[0] for r in rows]))
+      y = jax.device_put(np.asarray([r[1] for r in rows], "int32"))
+      state, loss = step(state, x, y)
+      jax.block_until_ready(loss)
+
+      done = 1
+      t0 = time.perf_counter()
+      while done < steps and not feed.should_stop():
+        rows = feed.next_batch(batch)
+        if not rows:
+          continue
+        x = jax.device_put(np.stack([r[0] for r in rows]))
+        y = jax.device_put(np.asarray([r[1] for r in rows], "int32"))
+        state, loss = step(state, x, y)
+        jax.block_until_ready(loss)
+        done += 1
+      dt = time.perf_counter() - t0
+      return (done - 1) / dt, None
+    finally:
+      proc.terminate()
+      proc.wait(timeout=10)
+  finally:
+    if ring is not None:
+      ring.free()
+    hub.shutdown()
+
+
+def compute_only(steps, batch):
+  """The same loop with pre-staged device data: the compute-bound rate."""
+  import numpy as np
+  import jax
+
+  state, step = _model_step()
+  rng = np.random.RandomState(0)
+  x = jax.device_put(rng.rand(batch, 784).astype("float32"))
+  y = jax.device_put(np.arange(batch, dtype="int32") % 10)
+  state, loss = step(state, x, y)
+  jax.block_until_ready(loss)
+  t0 = time.perf_counter()
+  for _ in range(steps - 1):
+    state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+  return (steps - 1) / (time.perf_counter() - t0)
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--steps", type=int, default=60)
+  ap.add_argument("--batch", type=int, default=128)
+  ap.add_argument("--chunk", type=int, default=256)
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny run (CPU CI / plumbing check)")
+  args = ap.parse_args()
+  if args.smoke or os.environ.get("TOS_BENCH_SMOKE"):
+    args.steps, args.batch = 8, 32
+
+  compute_rate = compute_only(args.steps, args.batch)
+  per_transport = {}
+  for transport in ("queue", "shm"):
+    rate, err = run_transport(transport, args.steps, args.batch, args.chunk)
+    if rate is None:
+      per_transport[transport] = {"error": err}
+    else:
+      per_transport[transport] = {
+          "fed_steps_per_sec": round(rate, 2),
+          "feed_overhead_pct": round(100.0 * (1.0 - rate / compute_rate), 1),
+      }
+  print(json.dumps({
+      "metric": "feed_overhead_pct",
+      "compute_steps_per_sec": round(compute_rate, 2),
+      "per_transport": per_transport,
+      "batch": args.batch,
+      "row_bytes": 28 * 28 * 4 + 8,
+      "note": "overhead = 1 - fed_rate/compute_rate; same host loop both "
+              "sides, so the delta isolates DataFeed+device_put cost",
+  }))
+
+
+if __name__ == "__main__":
+  if len(sys.argv) > 1 and sys.argv[1] == "--feeder":
+    feeder_main(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+  else:
+    main()

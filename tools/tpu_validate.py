@@ -130,6 +130,80 @@ def check_flash(results, shapes, dtype_name):
         results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
 
+def check_flash_gqa(results, shapes):
+  """Grouped-query attention through the native grouped kernels: K/V
+  carry h/g heads and are consumed UNEXPANDED (grouped-aware KV BlockSpec
+  in fwd/dQ; cross-head dK/dV grid accumulation in both backward plans).
+  Reference = dense attention over explicitly expanded K/V; grouped dK/dV
+  are compared against AD through that expand (which sums each group)."""
+  import jax
+  import jax.numpy as jnp
+  import importlib
+  fa = importlib.import_module('tensorflowonspark_tpu.ops.flash_attention')
+
+  for (b, s, h, hk, d, causal) in shapes:
+    key = jax.random.PRNGKey(4)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, hk, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, hk, d), jnp.bfloat16)
+    g = jax.random.normal(kg, (b, s, h, d), jnp.bfloat16)
+    rep = lambda t: jnp.repeat(t, h // hk, axis=2)  # noqa: E731
+
+    flash = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v,
+                                                       causal=causal))
+    dense = jax.jit(lambda q, k, v: _dense_attn(q, rep(k), rep(v), causal))
+    name = "flash_gqa_fwd[bf16 b%d s%d h%d hk%d d%d %s]" % (
+        b, s, h, hk, d, "causal" if causal else "full")
+    try:
+      out_f = flash(q, k, v)
+      out_d = dense(q, k, v)
+      err = float(jnp.max(jnp.abs(out_f.astype(jnp.float32) -
+                                  out_d.astype(jnp.float32))))
+      t_f = _timeit(flash, q, k, v)
+      t_d = _timeit(dense, q, k, v)
+      results.append(dict(kernel=name, ok=err < 2e-2, max_err=err,
+                          flash_ms=round(t_f * 1e3, 3),
+                          dense_ms=round(t_d * 1e3, 3),
+                          speedup=round(t_d / t_f, 2)))
+    except Exception as e:  # noqa: BLE001 - record, keep going
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+      continue
+
+    base = name.replace("fwd", "bwd")
+    try:
+      loss_d = jax.jit(jax.grad(
+          lambda q, k, v: jnp.sum(
+              _dense_attn(q, rep(k), rep(v), causal)
+              .astype(jnp.float32) * g.astype(jnp.float32)),
+          argnums=(0, 1, 2)))
+      gd = loss_d(q, k, v)
+      t_d = _timeit(loss_d, q, k, v)
+    except Exception as e:  # noqa: BLE001
+      results.append(dict(kernel=base + "{dense-ref}", ok=False,
+                          error=repr(e)[:400]))
+      continue
+    for bwd_mode in ("fused", "split"):
+      name = "%s{%s}" % (base, bwd_mode)
+      try:
+        loss_f = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                fa.flash_attention(q, k, v, causal=causal, bwd=bwd_mode)
+                .astype(jnp.float32) * g.astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        gf = loss_f(q, k, v)
+        err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                        b_.astype(jnp.float32))))
+                  for a, b_ in zip(gf, gd))
+        t_f = _timeit(loss_f, q, k, v)
+        results.append(dict(kernel=name, ok=err < 1e-1, max_err=err,
+                            flash_ms=round(t_f * 1e3, 3),
+                            dense_ms=round(t_d * 1e3, 3),
+                            speedup=round(t_d / t_f, 2)))
+      except Exception as e:  # noqa: BLE001
+        results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+
 def check_flash_block(results):
   """flash_attention_block with TRACED position bases + merge_partials.
 
@@ -315,6 +389,7 @@ def main(argv=None):
   results = []
   if args.quick:
     flash_shapes = [(1, 512, 4, 64, True)]
+    gqa_shapes = [(2, 1024, 8, 2, 64, True)]
     ln_shapes = [(4096, 1024)]
     lnmm_shapes = [(4096, 768, 3072)]
   else:
@@ -325,6 +400,13 @@ def main(argv=None):
         (1, 2048, 8, 128, True),
         (4, 4096, 8, 128, True),
     ]
+    # (b, s, h, hk, d, causal): group-of-4, MQA, and a long-context shape
+    # past the fused plan's VMEM budget (exercises the split fallback)
+    gqa_shapes = [
+        (2, 1024, 8, 2, 64, True),
+        (2, 1024, 8, 1, 64, True),
+        (1, 4096, 8, 2, 128, True),
+    ]
     ln_shapes = [(4096, 1024), (8192, 768), (16384, 4096)]
     # the bench shape (b16 s1024 GPT-2-small: 16384 rows, 768 -> 3072)
     # plus a bigger-model shape
@@ -333,6 +415,7 @@ def main(argv=None):
 
   for dt in (("bf16",) if args.quick else ("bf16", "f32")):
     check_flash(results, flash_shapes, dt)
+  check_flash_gqa(results, gqa_shapes)
   check_flash_block(results)
   check_layer_norm(results, ln_shapes)
   check_ln_matmul(results, lnmm_shapes)
