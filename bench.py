@@ -315,8 +315,15 @@ def _sweep():
       ("b16_s1024_lnmm", {"ln_matmul_impl": "fused"}),
       ("b16_s1024_lnmm_fuseqkv", {"ln_matmul_impl": "fused",
                                   "fuse_qkv": True}),
+      ("b16_s1024_actmm", {"act_matmul_impl": "fused"}),
+      # everything fused: ln1+QKV, ln2+up, gelu+down each one kernel
+      ("b16_s1024_allfused", {"ln_matmul_impl": "fused", "fuse_qkv": True,
+                              "act_matmul_impl": "fused"}),
       ("b8_s2048", {"batch": 8, "seq": 2048}),
       ("b8_s2048_fuseqkv", {"batch": 8, "seq": 2048, "fuse_qkv": True}),
+      ("b8_s2048_allfused", {"batch": 8, "seq": 2048,
+                             "ln_matmul_impl": "fused", "fuse_qkv": True,
+                             "act_matmul_impl": "fused"}),
   ]:
     try:
       r = _bench_transformer(**kw)

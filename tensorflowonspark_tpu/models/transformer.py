@@ -62,6 +62,13 @@ class TransformerConfig:
   # either way (ln2/scale, mlp/up/kernel), so checkpoints are
   # interchangeable across settings. "off" opts out.
   ln_matmul_impl: str = "off"
+  # "fused": the MLP's gelu -> down-projection pair runs as ONE Pallas
+  # kernel (ops.gelu_matmul) — the [rows, d_ff] activated tensor (the
+  # widest in the block) never round-trips HBM (interpret off-TPU).
+  # Sharded models contract the tensor-sharded d_ff per shard and psum,
+  # the same collective the unfused down-proj needs. Param tree is
+  # IDENTICAL either way (mlp/down/kernel). "off" opts out.
+  act_matmul_impl: str = "off"
   # Mixture-of-experts: when moe_experts > 0, every `moe_every`-th layer
   # (moe_every >= 1) replaces its dense MLP with an expert-routed FFN
   # (parallel.expert_parallel; experts shard over the `expert` mesh axis)
@@ -101,6 +108,9 @@ class TransformerConfig:
     if self.ln_matmul_impl not in ("off", "fused"):
       raise ValueError("ln_matmul_impl must be 'off' or 'fused', got %r"
                        % (self.ln_matmul_impl,))
+    if self.act_matmul_impl not in ("off", "fused"):
+      raise ValueError("act_matmul_impl must be 'off' or 'fused', got %r"
+                       % (self.act_matmul_impl,))
 
   @property
   def head_dim(self) -> int:
@@ -382,15 +392,46 @@ class _UpKernel(nn.Module):
         (self.d_model, self.d_ff), jnp.float32)
 
 
+class _DownKernel(nn.Module):
+  """Declares the MLP down-projection kernel at the same param path
+  (``mlp/down/kernel``) nn.Dense would, for the fused gelu+matmul path
+  that feeds it to ops.gelu_matmul instead of a Dense call."""
+  d_ff: int
+  d_model: int
+
+  @nn.compact
+  def __call__(self):
+    return self.param(
+        "kernel",
+        nn.with_logical_partitioning(nn.initializers.lecun_normal(),
+                                     ("mlp", "embed")),
+        (self.d_ff, self.d_model), jnp.float32)
+
+
+def _gelu_matmul_call(x, w, mesh=None):
+  """The fused GELU+matmul kernel with the shared off-TPU interpret
+  policy; per-shard through shard_map under a mesh (with the tensor-axis
+  psum the unfused down-proj needs anyway)."""
+  from tensorflowonspark_tpu.ops import gelu_matmul, gelu_matmul_sharded
+  interp = jax.default_backend() != "tpu"
+  if mesh is not None:
+    return gelu_matmul_sharded(x, w, mesh, interpret=interp)
+  return gelu_matmul(x, w, interpret=interp)
+
+
 class MLPBlock(nn.Module):
   cfg: TransformerConfig
   mesh: Optional[Any] = None
+  act_fused: bool = False
 
   @nn.compact
   def __call__(self, x, ln_scale=None):
     """With ``ln_scale`` (the preceding LayerNorm's weight), the norm and
     the up-projection run as one Pallas kernel over the RAW ``x``; without
-    it, ``x`` is expected already normalized (the regular path)."""
+    it, ``x`` is expected already normalized (the regular path). With
+    ``act_fused``, gelu + the down-projection run as one Pallas kernel
+    over the pre-activation (ops.gelu_matmul) — combined with the LN
+    fusion the whole MLP is two kernels with nothing unfused between."""
     cfg = self.cfg
     if ln_scale is not None:
       kernel = _UpKernel(cfg.d_model, cfg.d_ff, name="up")()
@@ -400,6 +441,9 @@ class MLPBlock(nn.Module):
       h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
                    kernel_init=nn.with_logical_partitioning(
                        nn.initializers.lecun_normal(), ("embed", "mlp")))(x)
+    if self.act_fused:
+      down = _DownKernel(cfg.d_ff, cfg.d_model, name="down")()
+      return _gelu_matmul_call(h, down.astype(cfg.dtype), mesh=self.mesh)
     h = nn.gelu(h)
     return nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False,
                     name="down",
@@ -508,17 +552,19 @@ class Block(nn.Module):
       y = _make_layer_norm(cfg, self.mesh, "ln1")(x)
       x = x + Attention(cfg, self.mesh, name="attn")(y, positions,
                                                      decode=decode)
+    act_fused = cfg.act_matmul_impl == "fused" and not decode
     if fuse_ln and not self.use_moe:
       # ln2 + up-projection as ONE kernel over the raw residual stream;
       # same param paths as the unfused branch (ln2/scale, mlp/up/kernel)
       scale = _LNScale(cfg.d_model, name="ln2")()
-      x = x + MLPBlock(cfg, self.mesh, name="mlp")(x, ln_scale=scale)
+      x = x + MLPBlock(cfg, self.mesh, act_fused,
+                       name="mlp")(x, ln_scale=scale)
     else:
       y = _make_layer_norm(cfg, self.mesh, "ln2")(x)
       if self.use_moe:
         x = x + MoEBlock(cfg, self.mesh, name="moe")(y)
       else:
-        x = x + MLPBlock(cfg, name="mlp")(y)
+        x = x + MLPBlock(cfg, self.mesh, act_fused, name="mlp")(y)
     if decode:
       return x
     return _constrain(x, ("batch", "sequence", "embed"), self.mesh)

@@ -14,6 +14,9 @@ from tensorflowonspark_tpu.ops.flash_attention import (  # noqa: F401
 from tensorflowonspark_tpu.ops.layer_norm import (  # noqa: F401
     layer_norm, layer_norm_sharded,
 )
+from tensorflowonspark_tpu.ops.act_matmul import (  # noqa: F401
+    gelu_matmul, gelu_matmul_sharded,
+)
 from tensorflowonspark_tpu.ops.ln_matmul import (  # noqa: F401
     ln_matmul, ln_matmul_sharded,
 )

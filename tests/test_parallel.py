@@ -775,7 +775,8 @@ class TestShardedTrainStep:
                                 remat=False, dtype=jnp.float32,
                                 use_ring_attention=True, fuse_qkv=True,
                                 layer_norm_impl="flax")
-    cfg_f = dataclasses.replace(cfg, ln_matmul_impl="fused")
+    cfg_f = dataclasses.replace(cfg, ln_matmul_impl="fused",
+                                act_matmul_impl="fused")
     state, sharding = tfm.create_sharded_state(jax.random.PRNGKey(0), cfg,
                                                mesh, learning_rate=1e-2,
                                                seq_len=seq)

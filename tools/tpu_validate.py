@@ -373,6 +373,53 @@ def check_ln_matmul(results, shapes):
       results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
 
+def check_gelu_matmul(results, shapes):
+  import jax
+  import jax.numpy as jnp
+  import importlib
+  am = importlib.import_module('tensorflowonspark_tpu.ops.act_matmul')
+
+  for (rows, f, n), dtype_name in [(s, dt) for s in shapes
+                                   for dt in ("bf16", "f32")]:
+    dtype = dict(bf16=jnp.bfloat16, f32=jnp.float32)[dtype_name]
+    x = jax.random.normal(jax.random.PRNGKey(5), (rows, f), dtype)
+    W = (jax.random.normal(jax.random.PRNGKey(6), (f, n), dtype) * 0.05
+         ).astype(dtype)
+    tol = 1e-1 if dtype_name == "bf16" else 1e-3
+
+    fused = jax.jit(lambda x, w: am.gelu_matmul(x, w))
+    ref = jax.jit(lambda x, w: (
+        jax.nn.gelu(x.astype(jnp.float32), approximate=True)
+        .astype(x.dtype) @ w))
+    name = "gelu_matmul[%s %dx%dx%d]" % (dtype_name, rows, f, n)
+    try:
+      err = float(jnp.max(jnp.abs(fused(x, W).astype(jnp.float32) -
+                                  ref(x, W).astype(jnp.float32))))
+      t_f = _timeit(fused, x, W)
+      t_r = _timeit(ref, x, W)
+      results.append(dict(kernel=name, ok=err < tol, max_err=err,
+                          fused_ms=round(t_f * 1e3, 3),
+                          xla_ms=round(t_r * 1e3, 3),
+                          speedup=round(t_r / t_f, 2)))
+    except Exception as e:  # noqa: BLE001 - record, keep going
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+    name = "gelu_matmul_grad[%s %dx%dx%d]" % (dtype_name, rows, f, n)
+    try:
+      gf = jax.jit(jax.grad(
+          lambda x, w: jnp.sum(am.gelu_matmul(x, w).astype(jnp.float32)),
+          argnums=(0, 1)))
+      gr = jax.jit(jax.grad(
+          lambda x, w: jnp.sum(ref.__wrapped__(x, w).astype(jnp.float32)),
+          argnums=(0, 1)))
+      err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                      b_.astype(jnp.float32))))
+                for a, b_ in zip(gf(x, W), gr(x, W)))
+      results.append(dict(kernel=name, ok=err < max(tol, 2e-1), max_err=err))
+    except Exception as e:  # noqa: BLE001
+      results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
+
+
 def main(argv=None):
   ap = argparse.ArgumentParser()
   ap.add_argument("--quick", action="store_true")
@@ -392,6 +439,7 @@ def main(argv=None):
     gqa_shapes = [(2, 1024, 8, 2, 64, True)]
     ln_shapes = [(4096, 1024)]
     lnmm_shapes = [(4096, 768, 3072)]
+    actmm_shapes = [(4096, 3072, 768)]
   else:
     flash_shapes = [
         (1, 512, 4, 64, True),
@@ -412,6 +460,9 @@ def main(argv=None):
     # plus a bigger-model shape
     lnmm_shapes = [(4096, 768, 3072), (16384, 768, 3072),
                    (8192, 2048, 8192)]
+    # gelu->down-proj: the transposed pair of the lnmm up-proj shapes
+    actmm_shapes = [(4096, 3072, 768), (16384, 3072, 768),
+                    (8192, 8192, 2048)]
 
   for dt in (("bf16",) if args.quick else ("bf16", "f32")):
     check_flash(results, flash_shapes, dt)
@@ -419,6 +470,7 @@ def main(argv=None):
   check_flash_block(results)
   check_layer_norm(results, ln_shapes)
   check_ln_matmul(results, lnmm_shapes)
+  check_gelu_matmul(results, actmm_shapes)
 
   n_ok = sum(1 for r in results if r.get("ok"))
   for r in results:
