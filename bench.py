@@ -324,6 +324,18 @@ def _sweep():
       ("b8_s2048_allfused", {"batch": 8, "seq": 2048,
                              "ln_matmul_impl": "fused", "fuse_qkv": True,
                              "act_matmul_impl": "fused"}),
+      # selective remat: save MXU outputs, recompute elementwise only —
+      # batch 24/32 OOM without remat and full remat costs ~21%; "dots"
+      # aims at the bigger batch for a fraction of the recompute
+      ("b24_s1024_rematdots", {"batch": 24, "remat": True,
+                               "remat_policy": "dots"}),
+      ("b32_s1024_rematdots", {"batch": 32, "remat": True,
+                               "remat_policy": "dots"}),
+      ("b32_s1024_rematdots_allfused", {"batch": 32, "remat": True,
+                                        "remat_policy": "dots",
+                                        "ln_matmul_impl": "fused",
+                                        "fuse_qkv": True,
+                                        "act_matmul_impl": "fused"}),
   ]:
     try:
       r = _bench_transformer(**kw)

@@ -183,3 +183,35 @@ class DataFeed(object):
     if isinstance(batch, dict):
       return {k: np.asarray(v, dtype=dtype) for k, v in batch.items()}
     return np.asarray(batch, dtype=dtype)
+
+
+def prefetch_to_device(batches, size: int = 2, device=None):
+  """Overlap host→device staging with device compute.
+
+  Wraps an iterator of host batches (numpy arrays / pytrees of them) and
+  yields device-resident batches, keeping up to ``size`` transfers in
+  flight: ``jax.device_put`` is asynchronous, so batch N+1's PCIe/ICI
+  transfer runs while the caller's jitted step for batch N executes —
+  the standard TPU input-pipeline trick, packaged for DataFeed loops::
+
+      def host_batches():
+          while not feed.should_stop():
+              b = feed.next_batch_arrays(B)
+              if len(b):           # [] after the end-of-feed marker
+                  yield b
+      for x in prefetch_to_device(host_batches(), size=2):
+          state, loss = step(state, x)
+
+  With ``size=1`` this degrades to plain ``device_put`` per batch. The
+  buffer holds ``size`` batches in device memory — keep it small.
+  """
+  import collections as _collections
+  import jax as _jax
+
+  queue = _collections.deque()
+  for batch in batches:
+    queue.append(_jax.device_put(batch, device))
+    if len(queue) >= max(1, size):
+      yield queue.popleft()
+  while queue:
+    yield queue.popleft()

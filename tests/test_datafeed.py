@@ -160,3 +160,20 @@ class TestLiveness:
     # only one row left -> everyone (of 1) agrees to stop; partial dropped
     assert feed.next_batch_synced(2) == []
     assert feed.should_stop()
+
+  def test_prefetch_to_device_order_and_drain(self):
+    """prefetch_to_device yields every batch exactly once, in order, with
+    at most `size` device transfers in flight, and drains its buffer when
+    the source ends."""
+    from tensorflowonspark_tpu.datafeed import prefetch_to_device
+    batches = [np.full((2, 2), i, "float32") for i in range(5)]
+    it = prefetch_to_device(iter(batches), size=2)
+    first = next(it)
+    np.testing.assert_array_equal(np.asarray(first), batches[0])
+    out = [first] + list(it)
+    assert len(out) == 5
+    for got, want in zip(out, batches):
+      np.testing.assert_array_equal(np.asarray(got), want)
+    # size=1 degrades to plain device_put per batch
+    out1 = list(prefetch_to_device(iter(batches), size=1))
+    assert len(out1) == 5

@@ -81,6 +81,38 @@ class TestSegmentation:
 
 
 class TestTransformer:
+  def test_remat_policy_numerics_invariant(self):
+    """remat is a memory/compute trade, never a numerics one: loss and
+    grads agree across remat off / full recompute / dots-saveable
+    (selective) policies at identical params."""
+    import dataclasses
+    from tensorflowonspark_tpu.models import transformer as tfm
+    base = tfm.TransformerConfig(vocab_size=32, num_layers=2, num_heads=2,
+                                 d_model=32, d_ff=64, max_seq_len=16,
+                                 remat=False, dtype=jnp.float32)
+    state = tfm.create_state(jax.random.PRNGKey(0), base, seq_len=16)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 32, (2, 16)), jnp.int32)
+
+    def lossgrad(cfg):
+      def loss(p):
+        return tfm.causal_lm_loss(
+            tfm.Transformer(cfg, None).apply({"params": p}, tokens),
+            tokens)
+      return jax.value_and_grad(loss)(state.params)
+
+    l0, g0 = lossgrad(base)
+    for policy in ("none", "dots"):
+      cfg = dataclasses.replace(base, remat=True, remat_policy=policy)
+      l1, g1 = lossgrad(cfg)
+      np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+      f0, _ = jax.flatten_util.ravel_pytree(g0)
+      f1, _ = jax.flatten_util.ravel_pytree(g1)
+      np.testing.assert_allclose(np.asarray(f0), np.asarray(f1),
+                                 atol=1e-5, rtol=1e-5)
+    with pytest.raises(ValueError, match="remat_policy"):
+      tfm.TransformerConfig(remat_policy="everything")
+
   def test_greedy_generate_learns_cycle(self):
     """Train on a repeating token cycle; generation must continue it."""
     from tensorflowonspark_tpu.models import transformer as tfm

@@ -33,6 +33,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 AUTHKEY = b"feedbench"
+_RING_SEQ = [0]   # unique ring name per run: shmring.open_cached caches by
+                  # name, so reusing one name across transports would hand
+                  # the consumer the PREVIOUS (freed) ring
 
 
 def feeder_main(addr_str, total_rows, chunk):
@@ -100,21 +103,29 @@ def _model_step():
 
 
 def run_transport(transport, steps, batch, chunk):
-  """Feed `steps` batches through one transport; return steps/sec."""
+  """Feed `steps` batches through one transport; return steps/sec.
+
+  ``transport`` is "queue", "shm", or either with a "+prefetch" suffix —
+  prefetch wraps the staging in :func:`datafeed.prefetch_to_device`, so
+  the next batch's host→device transfer overlaps the current step.
+  """
   import numpy as np
   from tensorflowonspark_tpu.control import feedhub
-  from tensorflowonspark_tpu.datafeed import DataFeed
+  from tensorflowonspark_tpu.datafeed import DataFeed, prefetch_to_device
 
+  base, _, opt = transport.partition("+")
   hub = feedhub.start(AUTHKEY, ["input", "output", "error", "control"],
                       mode="remote")
   ring = None
   try:
-    if transport == "shm":
+    if base == "shm":
       from tensorflowonspark_tpu.control import shmring
       if not shmring.available():
         return None, "native shm ring unavailable"
-      ring = shmring.ShmRing.create("/tos_feedbench_%d" % os.getpid(),
-                                    64 * 1024 * 1024)
+      _RING_SEQ[0] += 1
+      ring = shmring.ShmRing.create(
+          "/tos_feedbench_%d_%d" % (os.getpid(), _RING_SEQ[0]),
+          64 * 1024 * 1024)
       hub.set("ring_name", ring.name)
 
     total_rows = steps * batch
@@ -126,26 +137,34 @@ def run_transport(transport, steps, batch, chunk):
     try:
       import jax
       state, step = _model_step()
-
       feed = DataFeed(hub, train_mode=True)
+
+      def host_batches():
+        while not feed.should_stop():
+          rows = feed.next_batch(batch)
+          if not rows:
+            continue
+          yield (np.stack([r[0] for r in rows]),
+                 np.asarray([r[1] for r in rows], "int32"))
+
+      if opt == "prefetch":
+        batches = prefetch_to_device(host_batches(), size=2)
+      else:
+        batches = (jax.device_put(b) for b in host_batches())
+
       # warmup: compile against the first batch
-      rows = feed.next_batch(batch)
-      x = jax.device_put(np.stack([r[0] for r in rows]))
-      y = jax.device_put(np.asarray([r[1] for r in rows], "int32"))
+      x, y = next(batches)
       state, loss = step(state, x, y)
       jax.block_until_ready(loss)
 
       done = 1
       t0 = time.perf_counter()
-      while done < steps and not feed.should_stop():
-        rows = feed.next_batch(batch)
-        if not rows:
-          continue
-        x = jax.device_put(np.stack([r[0] for r in rows]))
-        y = jax.device_put(np.asarray([r[1] for r in rows], "int32"))
+      for x, y in batches:
         state, loss = step(state, x, y)
         jax.block_until_ready(loss)
         done += 1
+        if done >= steps:
+          break
       dt = time.perf_counter() - t0
       return (done - 1) / dt, None
     finally:
@@ -188,7 +207,7 @@ def main():
 
   compute_rate = compute_only(args.steps, args.batch)
   per_transport = {}
-  for transport in ("queue", "shm"):
+  for transport in ("queue", "shm", "shm+prefetch"):
     rate, err = run_transport(transport, args.steps, args.batch, args.chunk)
     if rate is None:
       per_transport[transport] = {"error": err}
