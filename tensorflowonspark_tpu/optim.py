@@ -12,6 +12,7 @@ optax transforms, so anything accepting an optax ``GradientTransformation``
 from typing import Optional
 
 SCHEDULES = ("constant", "cosine", "linear")
+OPTIMIZERS = ("adamw", "lion", "adafactor", "sgd")
 
 
 def make_schedule(learning_rate: float, schedule: str = "constant",
@@ -54,6 +55,41 @@ def default_decay_mask(params):
                                 params)
 
 
+def _lr_scaled_weight_decay(sched, weight_decay: float, mask):
+  """Decoupled (AdamW-style) weight decay: ``updates -= lr_t · wd · p``.
+
+  For cores whose optax implementation lacks an lr-scaled decay term:
+  ``optax.adafactor`` applies its ``weight_decay_rate`` RAW per step
+  (un-scaled by the schedule — 0.01 there means shrinking params 1% every
+  step, warmup included), and ``optax.sgd`` has no decay at all. This
+  transform gives both the same ``lr * weight_decay`` semantics adamw and
+  lion use, honoring the decay mask.
+  """
+  import jax
+  import jax.numpy as jnp
+  import optax
+
+  def init_fn(params):
+    del params
+    return optax.ScaleByScheduleState(count=jnp.zeros([], jnp.int32))
+
+  def update_fn(updates, state, params=None):
+    if params is None:
+      raise ValueError("weight decay requires params")
+    lr = sched(state.count)
+    m = mask(params) if callable(mask) else mask
+    if m is None:
+      new = jax.tree.map(lambda u, p: u - lr * weight_decay * p,
+                         updates, params)
+    else:
+      new = jax.tree.map(
+          lambda u, p, mm: u - lr * weight_decay * p if mm else u,
+          updates, params, m)
+    return new, optax.ScaleByScheduleState(count=state.count + 1)
+
+  return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(learning_rate: float = 3e-4,
                    weight_decay: float = 0.01,
                    schedule: str = "constant",
@@ -63,18 +99,35 @@ def make_optimizer(learning_rate: float = 3e-4,
                    clip_norm: float = 0.0,
                    b1: float = 0.9, b2: float = 0.95,
                    decay_mask="auto",
+                   optimizer: str = "adamw",
+                   momentum: float = 0.9,
                    tx_extra: Optional[object] = None):
-  """AdamW with the standard training recipe.
+  """The standard training recipe around a chosen optimizer core.
+
+  ``optimizer`` selects the core update rule:
+
+  - ``"adamw"`` (default) — the standard LLM recipe.
+  - ``"lion"`` — sign-momentum update; half Adam's optimizer memory (one
+    moment, not two). Typical recipes use a ~3-10x smaller learning rate
+    and larger weight decay than AdamW.
+  - ``"adafactor"`` — factored second moments: O(rows+cols) optimizer
+    memory per matrix instead of O(rows·cols), the classic TPU
+    memory-saver for very large embeddings/models.
+  - ``"sgd"`` — Nesterov momentum SGD (``momentum``), the ResNet recipe.
 
   ``clip_norm`` > 0 prepends global-norm gradient clipping; ``tx_extra``
   (an optax transform) is chained last, e.g. ``optax.ema`` or a custom
   accumulator. ``decay_mask`` controls which params get weight decay:
   ``"auto"`` (default) decays only ndim>=2 params (kernels/embeddings,
   not norms/biases), ``None`` decays everything, or pass an explicit
-  optax-style mask (pytree of bools or callable).
+  optax-style mask (pytree of bools or callable). ``b1``/``b2`` apply to
+  adamw/lion; ``momentum`` to sgd.
   """
   import optax
 
+  if optimizer not in OPTIMIZERS:
+    raise ValueError("optimizer must be one of %s, got %r"
+                     % (OPTIMIZERS, optimizer))
   sched = make_schedule(learning_rate, schedule, warmup_steps, decay_steps,
                         end_value)
   if isinstance(decay_mask, str) and decay_mask == "auto":
@@ -82,8 +135,22 @@ def make_optimizer(learning_rate: float = 3e-4,
   parts = []
   if clip_norm and clip_norm > 0:
     parts.append(optax.clip_by_global_norm(clip_norm))
-  parts.append(optax.adamw(sched, b1=b1, b2=b2,
-                           weight_decay=weight_decay, mask=decay_mask))
+  if optimizer == "adamw":
+    core = optax.adamw(sched, b1=b1, b2=b2,
+                       weight_decay=weight_decay, mask=decay_mask)
+  elif optimizer == "lion":
+    core = optax.lion(sched, b1=b1, b2=b2,
+                      weight_decay=weight_decay, mask=decay_mask)
+  elif optimizer == "adafactor":
+    # decay added via _lr_scaled_weight_decay: optax.adafactor's own
+    # weight_decay_rate is applied raw per step, NOT scaled by the lr
+    # schedule — the shared weight_decay default would destroy training
+    core = optax.adafactor(learning_rate=sched)
+  else:   # sgd (optax.sgd has no decay term of its own)
+    core = optax.sgd(sched, momentum=momentum, nesterov=True)
+  parts.append(core)
+  if optimizer in ("adafactor", "sgd") and weight_decay:
+    parts.append(_lr_scaled_weight_decay(sched, weight_decay, decay_mask))
   if tx_extra is not None:
     parts.append(tx_extra)
   return optax.chain(*parts) if len(parts) > 1 else parts[0]
