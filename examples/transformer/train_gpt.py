@@ -43,6 +43,21 @@ if __name__ == "__main__":
   parser.add_argument("--blocked_loss", action="store_true",
                       help="fused projection+cross-entropy (peak memory "
                            "[B,chunk,V] instead of [B,S,V])")
+  parser.add_argument("--kv_heads", type=int, default=0,
+                      help="grouped-query attention: 0=MHA, 1=MQA; "
+                           "grouped KV rides the ring unexpanded and the "
+                           "flash kernels consume it natively")
+  parser.add_argument("--fused", action="store_true",
+                      help="run ln1+QKV, ln2+up and gelu+down each as "
+                           "ONE Pallas kernel (fuse_qkv + ln_matmul + "
+                           "act_matmul)")
+  parser.add_argument("--remat_policy", default="none",
+                      choices=("none", "dots"),
+                      help="'dots' saves MXU outputs at remat blocks and "
+                           "recomputes only elementwise work")
+  parser.add_argument("--optimizer", default="adamw",
+                      choices=("adamw", "lion", "adafactor", "sgd"))
+  parser.add_argument("--lr", type=float, default=3e-4)
   args = parser.parse_args()
 
   import time
@@ -81,12 +96,19 @@ if __name__ == "__main__":
                                  args.microbatches * args.dp))
     mesh = M.build_mesh(M.MeshSpec(data=args.dp, pipeline=args.pp))
     print("mesh:", dict(mesh.shape))
+    from tensorflowonspark_tpu import optim
+    fused = dict(fuse_qkv=True, ln_matmul_impl="fused",
+                 act_matmul_impl="fused") if args.fused else {}
     cfg = tfm.TransformerConfig(
         vocab_size=args.vocab, num_layers=args.layers,
         num_heads=args.heads, d_model=args.d_model,
-        d_ff=args.d_model * 4, max_seq_len=args.seq_len)
-    state = tfm.create_state(jax.random.PRNGKey(0), cfg,
-                             seq_len=args.seq_len)
+        d_ff=args.d_model * 4, max_seq_len=args.seq_len,
+        num_kv_heads=args.kv_heads, remat_policy=args.remat_policy,
+        **fused)
+    state = tfm.create_state(
+        jax.random.PRNGKey(0), cfg, seq_len=args.seq_len,
+        tx=optim.make_optimizer(learning_rate=args.lr, clip_norm=1.0,
+                                optimizer=args.optimizer))
     pipe = tfm.make_pipeline_train_step(cfg, mesh, args.microbatches)
 
     @jax.jit
@@ -101,13 +123,20 @@ if __name__ == "__main__":
                                  sequence=args.sp, tensor=args.tp))
   print("mesh:", dict(mesh.shape))
 
+  from tensorflowonspark_tpu import optim
+  fused = dict(fuse_qkv=True, ln_matmul_impl="fused",
+               act_matmul_impl="fused") if args.fused else {}
   cfg = tfm.TransformerConfig(
       vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
       d_model=args.d_model, d_ff=args.d_model * 4,
-      max_seq_len=args.seq_len,
-      use_ring_attention=mesh.shape[M.AXIS_SEQUENCE] > 1)
+      max_seq_len=args.seq_len, num_kv_heads=args.kv_heads,
+      remat_policy=args.remat_policy,
+      use_ring_attention=mesh.shape[M.AXIS_SEQUENCE] > 1, **fused)
+  tx = optim.make_optimizer(learning_rate=args.lr, clip_norm=1.0,
+                            optimizer=args.optimizer)
   state, sharding = tfm.create_sharded_state(jax.random.PRNGKey(0), cfg,
-                                             mesh, seq_len=args.seq_len)
+                                             mesh, seq_len=args.seq_len,
+                                             tx=tx)
 
   def loss_fn(params, tokens):
     if args.blocked_loss:
