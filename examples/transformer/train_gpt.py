@@ -68,6 +68,12 @@ if __name__ == "__main__":
   from tensorflowonspark_tpu.models import transformer as tfm
   from tensorflowonspark_tpu.parallel import mesh as M
   from tensorflowonspark_tpu.parallel import sharding as SH
+  from tensorflowonspark_tpu import optim
+
+  fused = dict(fuse_qkv=True, ln_matmul_impl="fused",
+               act_matmul_impl="fused") if args.fused else {}
+  tx = optim.make_optimizer(learning_rate=args.lr, clip_norm=1.0,
+                            optimizer=args.optimizer)
 
   def run_loop(step, state, tokens):
     for i in range(args.steps):
@@ -96,19 +102,14 @@ if __name__ == "__main__":
                                  args.microbatches * args.dp))
     mesh = M.build_mesh(M.MeshSpec(data=args.dp, pipeline=args.pp))
     print("mesh:", dict(mesh.shape))
-    from tensorflowonspark_tpu import optim
-    fused = dict(fuse_qkv=True, ln_matmul_impl="fused",
-                 act_matmul_impl="fused") if args.fused else {}
     cfg = tfm.TransformerConfig(
         vocab_size=args.vocab, num_layers=args.layers,
         num_heads=args.heads, d_model=args.d_model,
         d_ff=args.d_model * 4, max_seq_len=args.seq_len,
         num_kv_heads=args.kv_heads, remat_policy=args.remat_policy,
         **fused)
-    state = tfm.create_state(
-        jax.random.PRNGKey(0), cfg, seq_len=args.seq_len,
-        tx=optim.make_optimizer(learning_rate=args.lr, clip_norm=1.0,
-                                optimizer=args.optimizer))
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg,
+                             seq_len=args.seq_len, tx=tx)
     pipe = tfm.make_pipeline_train_step(cfg, mesh, args.microbatches)
 
     @jax.jit
@@ -123,17 +124,12 @@ if __name__ == "__main__":
                                  sequence=args.sp, tensor=args.tp))
   print("mesh:", dict(mesh.shape))
 
-  from tensorflowonspark_tpu import optim
-  fused = dict(fuse_qkv=True, ln_matmul_impl="fused",
-               act_matmul_impl="fused") if args.fused else {}
   cfg = tfm.TransformerConfig(
       vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
       d_model=args.d_model, d_ff=args.d_model * 4,
       max_seq_len=args.seq_len, num_kv_heads=args.kv_heads,
       remat_policy=args.remat_policy,
       use_ring_attention=mesh.shape[M.AXIS_SEQUENCE] > 1, **fused)
-  tx = optim.make_optimizer(learning_rate=args.lr, clip_norm=1.0,
-                            optimizer=args.optimizer)
   state, sharding = tfm.create_sharded_state(jax.random.PRNGKey(0), cfg,
                                              mesh, seq_len=args.seq_len,
                                              tx=tx)

@@ -253,6 +253,20 @@ class TestSmallSurfaces:
       get_engine("nope")
 
 
+class TestOpsScripts:
+  def test_shell_scripts_parse(self):
+    """Every ops recipe in scripts/ must at least pass bash -n (they
+    cannot run here — no gcloud/Spark — but they must not rot)."""
+    import glob
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    scripts = glob.glob(os.path.join(repo, "scripts", "*.sh"))
+    assert len(scripts) >= 5, scripts
+    for s in scripts:
+      res = subprocess.run(["bash", "-n", s], capture_output=True,
+                           text=True)
+      assert res.returncode == 0, "%s: %s" % (s, res.stderr)
+
+
 class TestFeedBench:
   def test_smoke_end_to_end(self):
     """The feed-plane benchmark (tools/feed_bench.py) runs its full
