@@ -58,6 +58,12 @@ if __name__ == "__main__":
   parser.add_argument("--optimizer", default="adamw",
                       choices=("adamw", "lion", "adafactor", "sgd"))
   parser.add_argument("--lr", type=float, default=3e-4)
+  parser.add_argument("--grad_accum", type=int, default=1,
+                      help="average gradients over k steps, update once "
+                           "(effective batch = k x batch)")
+  parser.add_argument("--z_loss", type=float, default=0.0,
+                      help="auxiliary logit stabilizer (PaLM/T5X recipe, "
+                           "e.g. 1e-4); SPMD path only")
   args = parser.parse_args()
 
   import time
@@ -73,7 +79,8 @@ if __name__ == "__main__":
   fused = dict(fuse_qkv=True, ln_matmul_impl="fused",
                act_matmul_impl="fused") if args.fused else {}
   tx = optim.make_optimizer(learning_rate=args.lr, clip_norm=1.0,
-                            optimizer=args.optimizer)
+                            optimizer=args.optimizer,
+                            grad_accum_steps=args.grad_accum)
 
   def run_loop(step, state, tokens):
     for i in range(args.steps):
@@ -89,9 +96,10 @@ if __name__ == "__main__":
   if args.pp > 1:
     # 1F1B pipeline path: DP x PP mesh, blocks split into contiguous
     # stages, constant activation memory in the microbatch count
-    if args.fsdp > 1 or args.sp > 1 or args.tp > 1 or args.blocked_loss:
-      parser.error("--pp composes with --dp only "
-                   "(--fsdp/--sp/--tp/--blocked_loss are the SPMD path)")
+    if args.fsdp > 1 or args.sp > 1 or args.tp > 1 or args.blocked_loss \
+        or args.z_loss:
+      parser.error("--pp composes with --dp only (--fsdp/--sp/--tp/"
+                   "--blocked_loss/--z_loss are the SPMD path)")
     if args.dp == -1:
       args.dp = max(1, len(jax.devices()) // args.pp)
     micro_b = args.batch // args.microbatches
@@ -140,9 +148,10 @@ if __name__ == "__main__":
       hidden = state.apply_fn({"params": params}, tokens,
                               return_hidden=True)
       return tfm.causal_lm_loss_blocked(
-          hidden, tfm.tied_embedding_table(params), tokens)
+          hidden, tfm.tied_embedding_table(params), tokens,
+          z_loss=args.z_loss)
     return tfm.causal_lm_loss(state.apply_fn({"params": params}, tokens),
-                              tokens)
+                              tokens, z_loss=args.z_loss)
 
   step = SH.make_train_step(loss_fn, mesh, sharding,
                             batch_extra_axes=(M.AXIS_SEQUENCE,))

@@ -841,13 +841,23 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
   return predict_fn
 
 
-def causal_lm_loss(logits, tokens):
-  """Next-token cross-entropy (shifted); ignores the final position."""
+def causal_lm_loss(logits, tokens, z_loss: float = 0.0):
+  """Next-token cross-entropy (shifted); ignores the final position.
+
+  ``z_loss`` > 0 adds the auxiliary ``z_loss · mean(logsumexp²)`` term
+  (PaLM/T5X recipe, typically 1e-4): it pulls the partition function
+  toward 1, stabilizing bf16 logit growth over long runs — cheap
+  insurance on TPU where the softmax runs in bf16-accumulated f32.
+  """
   import optax
   targets = tokens[:, 1:]
   logits = logits[:, :-1]
-  return optax.softmax_cross_entropy_with_integer_labels(
+  ce = optax.softmax_cross_entropy_with_integer_labels(
       logits, targets).mean()
+  if z_loss:
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    ce = ce + z_loss * jnp.mean(lse ** 2)
+  return ce
 
 
 def tied_embedding_table(params):
@@ -859,7 +869,8 @@ def tied_embedding_table(params):
   return table
 
 
-def causal_lm_loss_blocked(hidden, table, tokens, chunk: int = 256):
+def causal_lm_loss_blocked(hidden, table, tokens, chunk: int = 256,
+                           z_loss: float = 0.0):
   """Next-token cross-entropy fused with the tied output projection.
 
   The [batch, seq, vocab] logits are never materialized: sequence chunks
@@ -873,7 +884,9 @@ def causal_lm_loss_blocked(hidden, table, tokens, chunk: int = 256):
   ``hidden``: final-layer-norm output from
   ``model.apply(..., return_hidden=True)`` [B, S, D]; ``table``: tied
   embedding [V, D] (:func:`tied_embedding_table`). Matches
-  :func:`causal_lm_loss` on the same inputs to float tolerance.
+  :func:`causal_lm_loss` on the same inputs (including ``z_loss``) to
+  float tolerance — the per-chunk logsumexp the reduction already
+  computes feeds the z-term for free.
   """
   targets = tokens[:, 1:]
   x = hidden[:, :-1]
@@ -890,16 +903,22 @@ def causal_lm_loss_blocked(hidden, table, tokens, chunk: int = 256):
   tbl = table.astype(x.dtype)
 
   @jax.checkpoint
-  def body(tot, inp):
+  def body(carry, inp):
+    tot, z_tot = carry
     xc, tc, mc = inp
     logits = jnp.einsum("bcd,vd->bcv", xc, tbl,
                         preferred_element_type=jnp.float32)
     lse = jax.nn.logsumexp(logits, axis=-1)             # [B, C]
     ll = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
-    return tot + jnp.sum((lse - ll) * mc[None, :]), None
+    return (tot + jnp.sum((lse - ll) * mc[None, :]),
+            z_tot + jnp.sum(lse ** 2 * mc[None, :])), None
 
-  total, _ = jax.lax.scan(body, jnp.float32(0.0), (xs, ts, ms))
-  return total / (b * s)
+  (total, z_total), _ = jax.lax.scan(
+      body, (jnp.float32(0.0), jnp.float32(0.0)), (xs, ts, ms))
+  loss = total / (b * s)
+  if z_loss:
+    loss = loss + z_loss * z_total / (b * s)
+  return loss
 
 
 def _init_fns(rng, cfg: TransformerConfig, mesh, learning_rate, seq_len,

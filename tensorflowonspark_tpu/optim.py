@@ -101,6 +101,7 @@ def make_optimizer(learning_rate: float = 3e-4,
                    decay_mask="auto",
                    optimizer: str = "adamw",
                    momentum: float = 0.9,
+                   grad_accum_steps: int = 1,
                    tx_extra: Optional[object] = None):
   """The standard training recipe around a chosen optimizer core.
 
@@ -122,6 +123,12 @@ def make_optimizer(learning_rate: float = 3e-4,
   not norms/biases), ``None`` decays everything, or pass an explicit
   optax-style mask (pytree of bools or callable). ``b1``/``b2`` apply to
   adamw/lion; ``momentum`` to sgd.
+
+  ``grad_accum_steps`` > 1 wraps the whole chain in ``optax.MultiSteps``:
+  gradients average over k consecutive ``update`` calls and the model
+  moves once per k — train an effective batch k× the per-step batch at
+  the per-step batch's memory (the non-pipeline microbatching; schedules
+  advance once per EFFECTIVE step, as they should).
   """
   import optax
 
@@ -153,4 +160,7 @@ def make_optimizer(learning_rate: float = 3e-4,
     parts.append(_lr_scaled_weight_decay(sched, weight_decay, decay_mask))
   if tx_extra is not None:
     parts.append(tx_extra)
-  return optax.chain(*parts) if len(parts) > 1 else parts[0]
+  tx = optax.chain(*parts) if len(parts) > 1 else parts[0]
+  if grad_accum_steps > 1:
+    tx = optax.MultiSteps(tx, every_k_schedule=grad_accum_steps)
+  return tx

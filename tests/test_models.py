@@ -473,6 +473,29 @@ class TestTransformer:
         lambda a, b: float(jnp.max(jnp.abs(a - b))), g1, g2)))
     assert err < 1e-4, err
 
+  def test_z_loss_matches_between_full_and_blocked(self):
+    """The z-loss term (z·mean(logsumexp²), the PaLM/T5X logit
+    stabilizer) raises the loss and agrees between the full and the
+    blocked (fused-projection) implementations."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=97, num_layers=2, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=50,
+                                dtype=jnp.float32)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=50)
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, 97, (3, 50)), jnp.int32)
+    logits = state.apply_fn({"params": state.params}, tokens)
+    hidden = state.apply_fn({"params": state.params}, tokens,
+                            return_hidden=True)
+    table = tfm.tied_embedding_table(state.params)
+
+    base = float(tfm.causal_lm_loss(logits, tokens))
+    zf = float(tfm.causal_lm_loss(logits, tokens, z_loss=1e-2))
+    zb = float(tfm.causal_lm_loss_blocked(hidden, table, tokens,
+                                          chunk=16, z_loss=1e-2))
+    assert zf > base
+    assert abs(zf - zb) < 1e-4, (zf, zb)
+
   def test_blocked_loss_trains(self):
     """A model trained with the blocked loss learns the same cyclic task
     the full-loss test uses (end-to-end through jax.checkpoint+scan)."""

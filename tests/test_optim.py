@@ -147,6 +147,37 @@ class TestOptimizerFamilies:
     with pytest.raises(ValueError, match="optimizer"):
       optim.make_optimizer(optimizer="adam2")
 
+  def test_grad_accumulation_matches_mean_gradient(self):
+    """grad_accum_steps=k: k update calls move params once, exactly as a
+    single update on the MEAN of the k gradients (sgd makes the algebra
+    exact), and the schedule advances once per effective step."""
+    import optax
+    k = 4
+    tx = optim.make_optimizer(learning_rate=0.1, weight_decay=0.0,
+                              optimizer="sgd", momentum=0.0,
+                              grad_accum_steps=k)
+    ref = optim.make_optimizer(learning_rate=0.1, weight_decay=0.0,
+                               optimizer="sgd", momentum=0.0)
+    params = {"w": jnp.asarray([1.0, 2.0])}
+    grads = [{"w": jnp.asarray([float(i + 1), -float(i)])}
+             for i in range(k)]
+    mean = jax.tree.map(lambda *g: sum(g) / k, *grads)
+
+    state = tx.init(params)
+    p = params
+    mids = []
+    for g in grads:
+      up, state = tx.update(g, state, p)
+      p = optax.apply_updates(p, up)
+      mids.append(np.asarray(p["w"]).copy())
+    # no movement until the k-th microbatch
+    for m in mids[:-1]:
+      np.testing.assert_array_equal(m, np.asarray(params["w"]))
+    up_ref, _ = ref.update(mean, ref.init(params), params)
+    expect = optax.apply_updates(params, up_ref)
+    np.testing.assert_allclose(mids[-1], np.asarray(expect["w"]),
+                               rtol=1e-6)
+
   @pytest.mark.parametrize("name", ["adafactor", "sgd"])
   def test_decay_is_lr_scaled_and_masked(self, name):
     """adafactor/sgd get AdamW-semantics decoupled decay (lr·wd·p), NOT
