@@ -64,6 +64,31 @@ def read_tfrecord_examples(paths: Sequence[str], schema=None,
     yield from _once()
 
 
+def shuffled(rows: Iterable, buffer_size: int, seed: int = 0) -> Iterator:
+  """Streaming shuffle buffer (parity role: ``tf.data.Dataset.shuffle``,
+  which the reference's FILES-mode examples applied to their record
+  streams): holds ``buffer_size`` rows and yields a uniformly-sampled
+  one as each new row arrives, draining the buffer shuffled at end.
+  Deterministic per ``seed`` — combine with the worker's ``task_index``
+  for distinct per-shard orders.
+  """
+  import random
+  if buffer_size <= 1:
+    yield from rows
+    return
+  rnd = random.Random(seed)
+  buf = []
+  for row in rows:
+    if len(buf) < buffer_size:
+      buf.append(row)
+      continue
+    i = rnd.randrange(buffer_size)
+    yield buf[i]
+    buf[i] = row
+  rnd.shuffle(buf)
+  yield from buf
+
+
 def batched(rows: Iterable, batch_size: int, drop_remainder: bool = True,
             collate: Optional[Callable] = None) -> Iterator:
   """Group rows into batches; ``collate`` maps a list of rows to arrays
@@ -91,12 +116,16 @@ def device_prefetch(batches: Iterable, size: int = 2,
                     sharding=None) -> Iterator:
   """Double-buffered host→device transfer (parity role: tf.data prefetch).
 
-  Keeps ``size`` batches in flight on the accelerator: the device_put of
-  batch N+1 overlaps the compute consuming batch N, hiding host-to-HBM
-  transfer latency.
+  Keeps at most ``size`` batches device-resident: the async device_put
+  of batch N+1 overlaps the compute consuming batch N, hiding
+  host-to-HBM transfer latency. ``size`` clamps to >= 1, where it
+  degrades to plain per-batch device_put; with a blocking source the
+  first yield happens after ``size`` batches have staged, never more.
   """
   import collections
   import jax
+
+  size = max(1, size)
 
   def _put(batch):
     if sharding is not None:
@@ -104,16 +133,9 @@ def device_prefetch(batches: Iterable, size: int = 2,
     return jax.tree.map(jax.device_put, batch)
 
   queue = collections.deque()
-  it = iter(batches)
-  try:
-    for _ in range(size):
-      queue.append(_put(next(it)))
-  except StopIteration:
-    pass
+  for batch in batches:
+    queue.append(_put(batch))
+    if len(queue) >= size:
+      yield queue.popleft()
   while queue:
-    out = queue.popleft()
-    try:
-      queue.append(_put(next(it)))
-    except StopIteration:
-      pass
-    yield out
+    yield queue.popleft()

@@ -204,14 +204,9 @@ def prefetch_to_device(batches, size: int = 2, device=None):
 
   With ``size=1`` this degrades to plain ``device_put`` per batch. The
   buffer holds ``size`` batches in device memory — keep it small.
+  Delegates to ``data.readers.device_prefetch`` — the FILES-mode input
+  pipeline's prefetcher — so there is exactly ONE implementation of the
+  overlap trick (``device`` may also be a sharding for SPMD staging).
   """
-  import collections as _collections
-  import jax as _jax
-
-  queue = _collections.deque()
-  for batch in batches:
-    queue.append(_jax.device_put(batch, device))
-    if len(queue) >= max(1, size):
-      yield queue.popleft()
-  while queue:
-    yield queue.popleft()
+  from tensorflowonspark_tpu.data.readers import device_prefetch
+  return device_prefetch(batches, size=size, sharding=device)
