@@ -336,6 +336,13 @@ def _sweep():
                                         "ln_matmul_impl": "fused",
                                         "fuse_qkv": True,
                                         "act_matmul_impl": "fused"}),
+      # GQA at the bench shape: 12 query heads on 4 KV heads — the
+      # grouped kernels read 3x less KV from HBM; with allfused on top
+      ("b16_s1024_gqa4", {"num_kv_heads": 4}),
+      ("b16_s1024_gqa4_allfused", {"num_kv_heads": 4,
+                                   "ln_matmul_impl": "fused",
+                                   "fuse_qkv": True,
+                                   "act_matmul_impl": "fused"}),
   ]:
     try:
       r = _bench_transformer(**kw)
@@ -343,6 +350,9 @@ def _sweep():
                        "mfu": r["transformer_mfu"]}
     except Exception as e:  # noqa: BLE001 - keep sweeping
       results[name] = {"error": str(e)[:200]}
+    # a watchdog fire mid-sweep reports every config that finished
+    # instead of discarding the round's one capture
+    _PARTIAL["extra"] = {"sweep_partial": dict(results)}
     sys.stderr.write("sweep %s: %r\n" % (name, results[name]))
   print(json.dumps({"sweep": results}))
 

@@ -120,20 +120,36 @@ def capture():
     return value
 
   rc, tail = _run_step(
-      "sweep", [sys.executable, "bench.py"], 3000,
+      "sweep", [sys.executable, "bench.py"], 3900,
       os.path.join(ART, "sweep.json"),
-      env_extra={"TOS_BENCH_SWEEP": "1", "TOS_BENCH_TIMEOUT": "2700",
+      env_extra={"TOS_BENCH_SWEEP": "1", "TOS_BENCH_TIMEOUT": "3600",
                  "TOS_BENCH_PREFLIGHT_BUDGET": "300"})
   try:
     results["sweep"] = json.loads(tail)
   except ValueError:
     results["sweep"] = {"rc": rc, "raw": tail[:300]}
 
+  kernels_path = os.path.join(ART, "kernels.json")
+  if os.path.exists(kernels_path):
+    os.remove(kernels_path)   # only THIS run's matrix may be promoted
   rc, tail = _run_step(
       "kernels", [sys.executable, "tools/tpu_validate.py",
-                  "--json", os.path.join(ART, "kernels.json")], 3000,
+                  "--json", kernels_path], 3600,
       os.path.join(ART, "kernels.stdout"))
   results["kernels_rc"] = rc
+  try:
+    with open(kernels_path) as f:
+      json.load(f)   # reject truncated output from a mid-write kill
+    fresh = True
+  except (OSError, ValueError):
+    fresh = False
+  if fresh:
+    # promote to the canonical artifact: TPU_KERNELS.json still carried
+    # round-2 rows with none of the round-3/4 kernels; a fresh on-chip
+    # matrix (even with failures recorded per-row) supersedes it
+    import shutil
+    shutil.copyfile(kernels_path, os.path.join(REPO, "TPU_KERNELS.json"))
+    _log("TPU_KERNELS.json updated from on-chip validation matrix")
 
   rc, tail = _run_step(
       "profile", [sys.executable, "tools/profile_step.py"], 1200,
