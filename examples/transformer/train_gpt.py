@@ -61,6 +61,14 @@ if __name__ == "__main__":
   parser.add_argument("--grad_accum", type=int, default=1,
                       help="average gradients over k steps, update once "
                            "(effective batch = k x batch)")
+  parser.add_argument("--data", default=None,
+                      help="TFRecord path/glob of token rows (schema "
+                           "struct<tokens:array<long>>, e.g. written by "
+                           "data.dfutil.save_as_tfrecords); default: "
+                           "synthetic random tokens. Streams through "
+                           "readers.shard_files -> shuffled -> batched "
+                           "(the FILES-mode input pipeline), with one "
+                           "batch always staged ahead of the step")
   parser.add_argument("--z_loss", type=float, default=0.0,
                       help="auxiliary logit stabilizer (PaLM/T5X recipe, "
                            "e.g. 1e-4); SPMD path only")
@@ -82,16 +90,58 @@ if __name__ == "__main__":
                             optimizer=args.optimizer,
                             grad_accum_steps=args.grad_accum)
 
-  def run_loop(step, state, tokens):
+  def batch_stream():
+    """[batch, seq] int32 token batches: TFRecords through the FILES-mode
+    input pipeline when --data is given, else one synthetic batch."""
+    if args.data:
+      from tensorflowonspark_tpu.data import readers
+      from tensorflowonspark_tpu.data import schema as schema_mod
+      sch = schema_mod.parse_schema("struct<tokens:array<long>>")
+      files = readers.shard_files(args.data, 1, 0)
+      rows = readers.shuffled(
+          readers.read_tfrecord_examples(files, schema=sch, repeat=True),
+          buffer_size=max(64, 4 * args.batch))
+
+      def collate(batch):
+        arr = np.zeros((len(batch), args.seq_len), "int32")
+        for i, r in enumerate(batch):
+          t = np.asarray(r[0], "int64")[:args.seq_len]
+          arr[i, :len(t)] = t
+        hi = int(arr.max())
+        if hi >= args.vocab:
+          raise ValueError(
+              "--data contains token id %d >= --vocab %d; raise --vocab "
+              "to the tokenizer's size (JAX would silently clamp the "
+              "embedding lookup otherwise)" % (hi, args.vocab))
+        return arr
+
+      yield from readers.batched(rows, args.batch, collate=collate)
+    else:
+      rng = np.random.RandomState(0)
+      base = rng.randint(0, args.vocab, (args.batch, args.seq_len))
+      while True:
+        yield base
+
+  def run_loop(step, state, prep):
+    # one batch always staged ahead: prep (host read + async device_put /
+    # shard_batch) of batch N+1 overlaps step N and stays out of the
+    # timed region, so printed per-step ms measure compute, not H2D
+    import collections
+    stream = (prep(b) for b in batch_stream())
+    buf = collections.deque()
     for i in range(args.steps):
+      while len(buf) < 2:
+        try:
+          buf.append(next(stream))
+        except StopIteration:
+          break
+      if not buf:
+        break
       t0 = time.time()
-      state, loss = step(state, tokens)
+      state, loss = step(state, buf.popleft())
       print("step %d loss %.4f (%.0f ms)"
             % (i, float(loss), 1000 * (time.time() - t0)))
     print("done; tokens/step = %d" % (args.batch * args.seq_len))
-
-  rng = np.random.RandomState(0)
-  data = rng.randint(0, args.vocab, (args.batch, args.seq_len))
 
   if args.pp > 1:
     # 1F1B pipeline path: DP x PP mesh, blocks split into contiguous
@@ -125,7 +175,7 @@ if __name__ == "__main__":
       loss, grads = pipe(state.params, tokens)
       return state.apply_gradients(grads=grads), loss
 
-    run_loop(pp_step, state, jnp.asarray(data, jnp.int32))
+    run_loop(pp_step, state, lambda b: jnp.asarray(b, jnp.int32))
     sys.exit(0)
 
   mesh = M.build_mesh(M.MeshSpec(data=args.dp, fsdp=args.fsdp,
@@ -156,6 +206,6 @@ if __name__ == "__main__":
   step = SH.make_train_step(loss_fn, mesh, sharding,
                             batch_extra_axes=(M.AXIS_SEQUENCE,))
 
-  tokens = SH.shard_batch(jnp.asarray(data, jnp.int32), mesh,
-                          extra_axes=(M.AXIS_SEQUENCE,))
-  run_loop(step, state, tokens)
+  run_loop(step, state,
+           lambda b: SH.shard_batch(jnp.asarray(b, jnp.int32), mesh,
+                                    extra_axes=(M.AXIS_SEQUENCE,)))

@@ -294,6 +294,24 @@ class TestRingAttention:
       np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                  atol=1e-4, rtol=1e-4)
 
+  def test_gqa_indivisible_tensor_axis_expands_up_front(self, devices):
+    """When a tensor axis shards heads and cannot divide the grouped KV
+    count (hk=2 on tensor=4), the ring expands KV up front rather than
+    break the head spec — correctness preserved at pre-GQA traffic."""
+    mesh = M.build_mesh(M.MeshSpec(sequence=2, tensor=4),
+                        devices=devices[:8])
+    rng = np.random.RandomState(8)
+    B, S, H, HK, D = 1, 16, 4, 2, 8
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, HK, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, HK, D), jnp.float32)
+    ref = RA.full_attention(q, jnp.asarray(self._expand(k, H)),
+                            jnp.asarray(self._expand(v, H)), causal=True)
+    out = jax.jit(lambda q, k, v: RA.ring_attention(
+        q, k, v, mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
   def test_gqa_ring_permutes_grouped_blocks(self, devices):
     """Structural ICI-traffic check: every ppermute in the ring program
     carries HK (grouped) heads, never the expanded H."""

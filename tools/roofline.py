@@ -37,9 +37,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# bench model (bench.py TFM_*): GPT-2-small-class
-L, D, H, F = 12, 768, 12, 3072
-V, S, B = 32000, 1024, 16
+# the bench model's shape, imported so this analysis can never diverge
+# from what bench.py actually measures
+import bench as _bench  # noqa: E402 - after sys.path insert
+
+L, D, H, F = (_bench.TFM_LAYERS, _bench.TFM_DMODEL, _bench.TFM_HEADS,
+              _bench.TFM_DFF)
+V, S, B = _bench.TFM_VOCAB, _bench.TFM_SEQ, _bench.TFM_BATCH
 BF16, F32 = 2, 4
 
 # HBM bandwidth per chip generation (public figures, GB/s)
