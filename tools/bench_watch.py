@@ -156,6 +156,18 @@ def capture():
       os.path.join(ART, "profile.txt"))
   results["profile_rc"] = rc
 
+  # kernel tile auto-tuning, separate from the core matrix so a slow
+  # sweep can never crowd out the validation evidence ("kernels" above
+  # already ran the matrix — sweep only)
+  blocks_path = os.path.join(ART, "blocks.json")
+  if os.path.exists(blocks_path):
+    os.remove(blocks_path)   # never let a stale sweep pose as this run's
+  rc, tail = _run_step(
+      "blocks", [sys.executable, "tools/tpu_validate.py", "--sweep-only",
+                 "--json", blocks_path], 2400,
+      os.path.join(ART, "blocks.stdout"))
+  results["blocks_rc"] = rc
+
   feed_bench = os.path.join(REPO, "tools", "feed_bench.py")
   if os.path.exists(feed_bench):
     rc, tail = _run_step(
