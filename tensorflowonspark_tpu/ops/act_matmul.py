@@ -35,6 +35,23 @@ def _gelu_f32(x):
   return jax.nn.gelu(x, approximate=True)
 
 
+def effective_blocks(rows: int, f: int, n: int, blk_rows: int,
+                     blk_cols: int, w_itemsize: int = 2):
+  """The (row, col) block pair the kernel will ACTUALLY run.
+
+  Here the CONTRACTED dim F = d_ff is the LARGE one (unlike ln_matmul,
+  which contracts d_model), so both tiles carry byte-footprint caps or
+  big-F f32 shapes blow VMEM at the default block sizes (the failure
+  mode layer_norm._pick_block records): the x block keeps a f32
+  activation copy (itemsize=4 cap) and the [F, blk_n] W tile is held to
+  ~4 MiB with a 128-lane floor. Shared with tools/tpu_validate's block
+  sweep so its dedup/labels track these caps exactly.
+  """
+  blk_r = _pick_block(rows, blk_rows, f, itemsize=4)
+  cap = max(128, (4 << 20) // (f * w_itemsize))
+  return blk_r, _pick_col_block(n, min(blk_cols, cap))
+
+
 def _act_matmul_kernel(x_ref, w_ref, o_ref):
   x = x_ref[...].astype(jnp.float32)                 # [blk_r, F]
   a = _gelu_f32(x)
@@ -53,16 +70,8 @@ def _act_matmul_fwd(x, W, blk_rows, blk_cols, interpret):
   for s in shape[:-1]:
     rows *= s
   xf = x.reshape(rows, f)
-  # here the CONTRACTED dim F = d_ff is the LARGE one (unlike ln_matmul,
-  # which contracts d_model), so both tiles need byte-footprint caps or
-  # big-F f32 shapes blow VMEM at the default block sizes (the failure
-  # mode layer_norm._pick_block records): the x block keeps a f32
-  # activation copy (itemsize=4 cap), and the [F, blk_n] W tile is held
-  # to ~4 MiB with a 128-lane floor
-  blk_r = _pick_block(rows, blk_rows, f, itemsize=4)
-  blk_cols = min(blk_cols,
-                 max(128, (4 << 20) // (f * W.dtype.itemsize)))
-  blk_n = _pick_col_block(n, blk_cols)
+  blk_r, blk_n = effective_blocks(rows, f, n, blk_rows, blk_cols,
+                                  W.dtype.itemsize)
 
   out = pl.pallas_call(
       _act_matmul_kernel,

@@ -46,6 +46,15 @@ def _pick_col_block(n: int, blk_cols: int) -> int:
   return blk
 
 
+def effective_blocks(rows: int, h: int, n: int, blk_rows: int,
+                     blk_cols: int):
+  """The (row, col) block pair the kernel will ACTUALLY run after
+  divisor fitting — the forward uses this, and tools/tpu_validate's
+  block sweep dedups/labels through it so tuning artifacts can never
+  name a configuration the kernel would silently snap away from."""
+  return _pick_block(rows, blk_rows, h), _pick_col_block(n, blk_cols)
+
+
 def _ln_matmul_fwd(x, w_ln, W, eps, blk_rows, blk_cols, interpret):
   shape = x.shape
   h = shape[-1]
@@ -55,8 +64,7 @@ def _ln_matmul_fwd(x, w_ln, W, eps, blk_rows, blk_cols, interpret):
     rows *= s
   xf = x.reshape(rows, h)
   wln2 = w_ln.reshape(1, h)
-  blk_r = _pick_block(rows, blk_rows, h)
-  blk_n = _pick_col_block(n, blk_cols)
+  blk_r, blk_n = effective_blocks(rows, h, n, blk_rows, blk_cols)
 
   out = pl.pallas_call(
       functools.partial(_ln_matmul_kernel, eps=eps),

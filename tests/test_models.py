@@ -522,6 +522,41 @@ class TestTransformer:
     assert float(loss) < 0.1, float(loss)
 
 
+class TestTransformerPipelineFused:
+  def test_pipeline_step_with_fusions_and_gqa(self):
+    """The 1F1B full-model step composes with the round-4 config surface
+    (GQA + fuse_qkv + ln/act fusions run mesh-free inside the stage
+    bodies): loss/grads stay finite and match the same config's dense
+    sequential AD."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    from tensorflowonspark_tpu.parallel import mesh as M
+
+    mesh = M.build_mesh(M.MeshSpec(data=2, pipeline=2),
+                        devices=jax.devices()[:4])
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+        d_model=32, d_ff=64, max_seq_len=8, dtype=jnp.float32,
+        remat=False, fuse_qkv=True, ln_matmul_impl="fused",
+        act_matmul_impl="fused")
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=8)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (8, 8)), jnp.int32)
+    lm_step = tfm.make_pipeline_train_step(cfg, mesh, num_microbatches=2)
+    loss, grads = jax.jit(lm_step)(state.params, tokens)
+
+    def dense_loss(p):
+      return tfm.causal_lm_loss(
+          tfm.Transformer(cfg, None).apply({"params": p}, tokens), tokens)
+
+    ref_l, ref_g = jax.value_and_grad(dense_loss)(state.params)
+    np.testing.assert_allclose(float(loss), float(ref_l), atol=1e-5,
+                               rtol=1e-5)
+    f0, _ = jax.flatten_util.ravel_pytree(grads)
+    f1, _ = jax.flatten_util.ravel_pytree(ref_g)
+    np.testing.assert_allclose(np.asarray(f0), np.asarray(f1),
+                               atol=1e-4, rtol=1e-4)
+
+
 class TestTransformerPipeline:
   """Full-model 1F1B pipeline training (make_pipeline_train_step): loss
   and EVERY grad — tied embed table (both stage contributions), blocks,
