@@ -63,7 +63,20 @@ class CheckpointManager(object):
       logger.info("checkpoint saved at step %d", step)
     return saved
 
-  def latest_step(self) -> Optional[int]:
+  def latest_step(self, refresh: bool = False) -> Optional[int]:
+    """Newest checkpointed step, or None.
+
+    orbax caches the directory's step listing at construction and after
+    its own saves — a manager that only READS (the evaluator-sidecar
+    pattern: another process writes the checkpoints) must pass
+    ``refresh=True`` to rescan, or it will report the world as of its
+    own birth forever.
+    """
+    if refresh:
+      try:
+        self._mgr.reload()
+      except AttributeError:   # older orbax: no reload(); best effort
+        pass
     return self._mgr.latest_step()
 
   def restore(self, state_template: Any, step: Optional[int] = None) -> Any:

@@ -93,6 +93,24 @@ class TestCheckpointManager:
     np.testing.assert_allclose(np.asarray(restored["w"]),
                                np.arange(4.0) + 5)
 
+  def test_reader_manager_sees_other_writers_saves(self, tmp_path):
+    """The evaluator-sidecar pattern: a manager that only READS must see
+    checkpoints another manager wrote after it was constructed — orbax
+    caches the step listing, so latest_step(refresh=True) rescans."""
+    import jax.numpy as jnp
+    from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
+
+    reader = CheckpointManager(str(tmp_path / "c"), save_interval_steps=1)
+    assert reader.latest_step() is None
+
+    writer = CheckpointManager(str(tmp_path / "c"), save_interval_steps=1)
+    writer.save(3, {"w": jnp.arange(4.0)}, is_chief=True)
+    writer.wait()
+
+    assert reader.latest_step(refresh=True) == 3
+    got = reader.restore({"w": jnp.zeros(4)}, step=3)
+    np.testing.assert_allclose(np.asarray(got["w"]), np.arange(4.0))
+
   def test_non_chief_never_writes(self, tmp_path):
     import jax.numpy as jnp
     from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
