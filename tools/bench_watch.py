@@ -12,7 +12,9 @@ full measurement stack in order:
   2. ``TOS_BENCH_SWEEP=1 python bench.py``    -> bench_artifacts/sweep.json
   3. ``tools/tpu_validate.py --json ...``     -> bench_artifacts/kernels.json
   4. ``tools/profile_step.py``                -> bench_artifacts/profile.txt
-  5. ``tools/feed_bench.py`` (if present)     -> bench_artifacts/feed.json
+  5. ``tools/tpu_validate.py --sweep-only``   -> bench_artifacts/blocks.json
+  6. ``tools/feed_bench.py`` (if present)     -> bench_artifacts/feed.json
+  7. ``tools/serve_bench.py``                 -> bench_artifacts/serve.json
 
 and appends a capture summary to ``BENCH_NOTES.md``. If the bench step
 yields a nonzero throughput the watcher exits 0 (capture done); otherwise
@@ -177,6 +179,14 @@ def capture():
       results["feed"] = json.loads(tail)
     except ValueError:
       results["feed"] = {"rc": rc, "raw": tail[:300]}
+
+  rc, tail = _run_step(
+      "serve", [sys.executable, "tools/serve_bench.py"], 900,
+      os.path.join(ART, "serve.json"))
+  try:
+    results["serve"] = json.loads(tail)
+  except ValueError:
+    results["serve"] = {"rc": rc, "raw": tail[:300]}
 
   _append_notes(results, complete=True)
   return value

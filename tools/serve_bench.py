@@ -1,0 +1,96 @@
+"""Serving micro-bench: KV-cache decode throughput (tokens/sec).
+
+The training side has `bench.py`; this is the serving side of the perf
+story — batched greedy decode through the per-layer KV cache
+(`models.transformer.greedy_generate_kv`, the path
+`make_serving_predict_fn` packages for `TFModel.transform`). Decode is
+memory-bound (every step re-reads the whole cache), so the headline
+lever is grouped-query attention: the cache and its per-step HBM reads
+shrink num_heads/num_kv_heads×. Measures MHA vs GQA at the bench model
+shape and prints ONE JSON line.
+
+Usage: python tools/serve_bench.py [--batch 8] [--prompt 128] [--steps 128]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench as _bench  # noqa: E402 - bench model shape, one source
+
+
+def measure(cfg_kwargs, batch, prompt_len, steps):
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+
+  cfg = tfm.TransformerConfig(
+      vocab_size=_bench.TFM_VOCAB, num_layers=_bench.TFM_LAYERS,
+      num_heads=_bench.TFM_HEADS, d_model=_bench.TFM_DMODEL,
+      d_ff=_bench.TFM_DFF, max_seq_len=prompt_len + steps, remat=False,
+      **cfg_kwargs)
+  state = tfm.create_state(jax.random.PRNGKey(0), cfg,
+                           seq_len=prompt_len + steps)
+  rng = np.random.RandomState(0)
+  prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt_len)),
+                       jnp.int32)
+
+  def decode(n):
+    return tfm.greedy_generate_kv(state.params, cfg, prompt, n)
+
+  # isolate DECODE from prefill: time a full run and a 1-step run and
+  # divide the extra tokens by the extra time (the bench.py subtraction
+  # trick) — otherwise the prompt's prefill forward pollutes the rate
+  for n in (1, steps):
+    jax.block_until_ready(decode(n))   # compile + warm both lengths
+  t0 = time.perf_counter()
+  jax.block_until_ready(decode(steps))
+  dt_full = time.perf_counter() - t0
+  t0 = time.perf_counter()
+  jax.block_until_ready(decode(1))
+  dt_one = time.perf_counter() - t0
+  if dt_full - dt_one <= 0.2 * dt_full:
+    return batch * steps / dt_full     # noise floor: conservative
+  return batch * (steps - 1) / (dt_full - dt_one)
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--batch", type=int, default=8)
+  ap.add_argument("--prompt", type=int, default=128)
+  ap.add_argument("--steps", type=int, default=128)
+  args = ap.parse_args()
+  if os.environ.get("TOS_BENCH_SMOKE"):
+    args.batch, args.prompt, args.steps = 2, 16, 16
+
+  # grouped config sized off the model's head count so the smoke shape
+  # (4 heads) still exercises a genuinely grouped cache (kv < heads)
+  h = _bench.TFM_HEADS
+  kv_g = 4 if h % 4 == 0 and h > 4 else max(1, h // 2)
+  results = {}
+  for name, kw in (("mha", {}),
+                   ("gqa%d" % kv_g, {"num_kv_heads": kv_g}),
+                   ("mqa", {"num_kv_heads": 1})):
+    try:
+      results[name] = {
+          "decode_tok_s": round(measure(kw, args.batch, args.prompt,
+                                        args.steps), 1)}
+    except Exception as e:  # noqa: BLE001 - record, keep measuring
+      results[name] = {"error": str(e)[:200]}
+    sys.stderr.write("serve %s: %r\n" % (name, results[name]))
+  print(json.dumps({
+      "metric": "kv_decode_tokens_per_sec",
+      "batch": args.batch, "prompt": args.prompt, "steps": args.steps,
+      "per_config": results,
+      "note": "batched greedy KV-cache decode; GQA shrinks the cache "
+              "and its per-step HBM reads num_heads/num_kv_heads x",
+  }))
+
+
+if __name__ == "__main__":
+  main()
