@@ -203,7 +203,10 @@ def prefetch_to_device(batches, size: int = 2, device=None):
           state, loss = step(state, x)
 
   With ``size=1`` this degrades to plain ``device_put`` per batch. The
-  buffer holds ``size`` batches in device memory — keep it small.
+  buffer holds ``size`` batches in device memory — keep it small. Note
+  the fill also gates startup: the first batch is yielded only once
+  ``size`` batches have staged (or the source ends), so a large ``size``
+  on a slow feed delays step 0 by ``size`` batch-fetches.
   Delegates to ``data.readers.device_prefetch`` — the FILES-mode input
   pipeline's prefetcher — so there is exactly ONE implementation of the
   overlap trick (``device`` may also be a sharding for SPMD staging).

@@ -19,6 +19,7 @@ traceback back through the collect (LocalEngine parity) instead of Spark
 aborting the whole job with one driver-side exception for all tasks.
 """
 
+import collections.abc
 import logging
 import threading
 from typing import Callable, List, Optional
@@ -167,6 +168,18 @@ class SparkEngine(Engine):
       return partitions
     # one list element per slice keeps the caller's partition boundaries;
     # the flatten unwraps each slice's single partition-list into its rows
+    was_stream = isinstance(partitions, collections.abc.Iterator)
     parts = list(partitions)
+
+    def _is_lazy(p):   # a handle, or _wrap_lazy's [handle] partition shape
+      return callable(p) or (isinstance(p, (list, tuple)) and len(p) == 1
+                             and callable(p[0]))
+
+    if was_stream and any(not _is_lazy(p) for p in parts):
+      logger.warning(
+          "SparkEngine: a one-shot partition stream carrying raw rows was "
+          "materialized on the DRIVER (O(dataset) driver memory). Ship "
+          "lazy handles (load_tfrecords(lazy=True)) or feed via "
+          "train_dstream to keep rows executor-side.")
     rdd = self.sc.parallelize(parts, max(1, len(parts)))
     return rdd.mapPartitions(lambda it: (row for part in it for row in part))

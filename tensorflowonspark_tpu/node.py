@@ -517,8 +517,10 @@ class DualInput(object):
     return got
 
   def _deliver_ring(self, got, max_items: int):
-    if None in got and not self._queue.empty():
-      idx = got.index(None)
+    # identity scan, not `None in got`: rows may be numpy arrays, whose
+    # __eq__ is elementwise and makes `in`/.index raise on truth-testing
+    idx = next((i for i, r in enumerate(got) if r is None), -1)
+    if idx >= 0 and not self._queue.empty():
       self._stash = got[idx:]
       prefix = got[:idx]
       if prefix:

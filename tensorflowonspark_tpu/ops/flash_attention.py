@@ -485,17 +485,21 @@ def default_bwd_mode() -> str:
 # q-blocks — each (q,k) pair read-modify-writes a blk_q-row slice of the
 # resident dQ accumulator, and 128 rows keeps that RMW on the critical
 # path shorter — while the split kernels match the forward's (256, 512).
-# Resolved per-mode here; override with blk_bwd_q/blk_bwd_k.
+# Resolved per-mode inside _bwd_impl — AFTER its VMEM fallback may have
+# switched fused→split, so a fallback under default tuning picks up the
+# split plan's blocks (an early comparison against the fused defaults
+# would miss whenever _blocks had already clamped them for short
+# sequences). Override with blk_bwd_q/blk_bwd_k (kept None = defaults).
 DEFAULT_BWD_BLOCKS = {"fused": (128, 512), "split": (256, 512)}
 
 
-def _resolve_bwd(bwd, blk_bwd_q, blk_bwd_k):
+def _resolve_bwd(bwd):
+  """Validate/default the backward mode (block tuning resolves later,
+  see DEFAULT_BWD_BLOCKS)."""
   bwd = bwd or default_bwd_mode()
   if bwd not in DEFAULT_BWD_BLOCKS:
     raise ValueError("bwd must be 'fused' or 'split', got %r" % (bwd,))
-  dq_blk, dk_blk = DEFAULT_BWD_BLOCKS[bwd]
-  return (bwd, dq_blk if blk_bwd_q is None else blk_bwd_q,
-          dk_blk if blk_bwd_k is None else blk_bwd_k)
+  return bwd
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
@@ -505,7 +509,6 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
   b, s_q, h, d = q.shape
   s_kv = k.shape[1]
   hk, grp = _group(q, k)
-  blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
   scale = 1.0 / (d ** 0.5)
   qf, of, gf = (_fold(x) for x in (q, out, g))
   kf, vf = _fold(k), _fold(v)
@@ -528,11 +531,12 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
   if bwd == "fused" and grp > 1 and not _gqa_fused_fits(
       s_q, s_kv, d, q.dtype.itemsize):
     bwd = "split"   # resident dK/dV would not fit VMEM; split plan wins
-    if (blk_q, blk_k) == DEFAULT_BWD_BLOCKS["fused"]:
-      # defaults were in play: re-resolve to the split plan's tuning
-      # (keep explicit caller overrides untouched)
-      blk_q, blk_k = DEFAULT_BWD_BLOCKS["split"]
-      blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
+  # block defaults resolve AFTER the fallback so a fused→split switch
+  # gets split tuning; explicit caller overrides (non-None) are untouched
+  dq_def, dk_def = DEFAULT_BWD_BLOCKS[bwd]
+  blk_q = dq_def if blk_q is None else blk_q
+  blk_k = dk_def if blk_k is None else blk_k
+  blk_q, blk_k = _blocks(s_q, s_kv, blk_q, blk_k)
 
   if bwd == "fused" and grp > 1:
     qrow = _q_row_map(h, hk, grp, qh_axis=1)
@@ -702,9 +706,8 @@ def flash_attention(q, k, v, causal: bool = True, blk_q: int = 256,
   dQ/dK/dV) or 'split' (two kernels); defaults to
   :func:`default_bwd_mode`. The backward uses its own block sizes
   (``DEFAULT_BWD_BLOCKS`` per mode unless overridden)."""
-  bwd, blk_bwd_q, blk_bwd_k = _resolve_bwd(bwd, blk_bwd_q, blk_bwd_k)
-  return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret, bwd,
-                    blk_bwd_q, blk_bwd_k)
+  return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret,
+                    _resolve_bwd(bwd), blk_bwd_q, blk_bwd_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
@@ -747,9 +750,9 @@ def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
   with :func:`merge_partials`. Differentiable in q/k/v (including through
   the lse output).
   """
-  bwd, blk_bwd_q, blk_bwd_k = _resolve_bwd(bwd, blk_bwd_q, blk_bwd_k)
   return _flash_block_vjp(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                          interpret, bwd, blk_bwd_q, blk_bwd_k)
+                          interpret, _resolve_bwd(bwd), blk_bwd_q,
+                          blk_bwd_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))

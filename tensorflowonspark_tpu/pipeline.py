@@ -266,6 +266,10 @@ def _host_local_slot(workers_per_host: int):
 
       claims = {s: p for s, p in claims.items()
                 if 0 <= s < workers_per_host and _alive(p)}
+      me = os.getpid()
+      for s, p in claims.items():
+        if p == me:  # idempotent under worker reuse: keep the held slot
+          return s
       free = [s for s in range(workers_per_host) if s not in claims]
       if not free:
         return None

@@ -233,6 +233,32 @@ class TestSparkEngineSpecific:
     got = spark_engine.map_partitions(rdd, _square_sum, timeout=30)
     assert sorted(got) == [5, 25]
 
+  def test_raw_row_stream_warns_driver_materialization(self, spark_engine,
+                                                       caplog):
+    """A one-shot stream of RAW-ROW partitions handed to _as_rdd drains
+    onto the driver (O(dataset) memory) — that hazard must be a runtime
+    warning, not just a code comment (round-4 advice; mirrors the
+    save_as_tfrecords warning)."""
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.engine.spark"):
+      got = spark_engine.map_partitions(
+          iter([[1, 2], [3, 4]]), _square_sum, timeout=30)
+    assert sorted(got) == [5, 25]
+    assert any("materialized on the DRIVER" in r.message
+               for r in caplog.records)
+    # lazy-handle streams ([callable] partitions) and re-iterable lists
+    # stay silent — rows are produced executor-side / driver already owns
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.engine.spark"):
+      spark_engine.map_partitions(
+          iter([[lambda: [1, 2]], [lambda: [3, 4]]]),
+          lambda it: [sum(1 for _ in it)], timeout=30)
+      spark_engine.map_partitions([[1, 2], [3, 4]], _square_sum,
+                                  timeout=30)
+    assert not caplog.records
+
   def test_barrier_timeout_enforced(self, spark_engine):
     def _slow_barrier_fn(it, ctx):
       list(it)

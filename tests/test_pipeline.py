@@ -381,7 +381,12 @@ class TestTransformChipAllocation:
     """With workers_per_host known, co-located Spark tasks claim disjoint
     slots from a host-local flock counter — even when their partition ids
     are congruent mod workers_per_host, the case where the plain
-    partition-id modulus double-claims a slot (round-3 advice)."""
+    partition-id modulus double-claims a slot (round-3 advice). A pid
+    that already holds a slot gets ITS slot back on re-claim (idempotent
+    under PySpark worker reuse, round-4 advice) instead of leaking a
+    second one until the file is exhausted."""
+    import json
+    import subprocess
     import sys as _sys
     import tempfile
     _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -394,12 +399,22 @@ class TestTransformChipAllocation:
     # every claimant reports partition id 0: the modulus heuristic would
     # put them all on slot 0; the slot file spreads them
     pyspark_stub.TaskContext._local.ctx = pyspark_stub.TaskContext(0, 0)
+    other = subprocess.Popen(["sleep", "60"])
+    path = tmp_path / ("tos_transform_slots.%d" % os.getuid())
     try:
-      slots = [pl._transform_worker_slot(2) for _ in range(2)]
-      assert slots == [0, 1]
-      # both slots held by live pids -> exhausted, heuristic fallback
+      # a live sibling process holds slot 0 -> this task claims slot 1
+      path.write_text(json.dumps({"0": other.pid}))
+      assert pl._transform_worker_slot(2) == 1
+      # re-claim from the same pid (worker reuse) returns the held slot
+      assert pl._transform_worker_slot(2) == 1
+      claims = {int(s): p for s, p in json.loads(path.read_text()).items()}
+      assert claims == {0: other.pid, 1: os.getpid()}
+      # every slot held by OTHER live pids -> exhausted, heuristic fallback
+      path.write_text(json.dumps({"0": other.pid, "1": other.pid}))
       assert pl._transform_worker_slot(2) == 0
     finally:
+      other.kill()
+      other.wait()
       pyspark_stub.TaskContext._local.ctx = None
     # workers_per_host unknown -> partition-id heuristic preserved
     pyspark_stub.TaskContext._local.ctx = pyspark_stub.TaskContext(3, 0)
@@ -421,9 +436,15 @@ class TestTransformChipAllocation:
     proc = subprocess.Popen(["true"])
     proc.wait()
     dead = proc.pid
-    path = tmp_path / ("tos_transform_slots.%d" % os.getuid())
-    path.write_text(json.dumps({"0": dead, "1": os.getpid()}))
-    # slot 0's holder is dead -> reclaimed; slot 1 stays with the live pid
-    assert pl._host_local_slot(2) == 0
-    claims = json.loads(path.read_text())
-    assert claims["0"] == os.getpid() and claims["1"] == os.getpid()
+    other = subprocess.Popen(["sleep", "60"])
+    try:
+      path = tmp_path / ("tos_transform_slots.%d" % os.getuid())
+      path.write_text(json.dumps({"0": dead, "1": other.pid}))
+      # slot 0's holder is dead -> reclaimed; slot 1 stays with its live
+      # (sibling-process) holder
+      assert pl._host_local_slot(2) == 0
+      claims = json.loads(path.read_text())
+      assert claims["0"] == os.getpid() and claims["1"] == other.pid
+    finally:
+      other.kill()
+      other.wait()

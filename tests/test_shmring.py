@@ -107,6 +107,47 @@ class TestShmRing:
       # queue drained: the stashed marker is finally released
       assert dual.get_many(8, timeout=0.5) == [None]
 
+  def test_dual_input_numpy_rows_with_marker(self):
+    """numpy-array rows alongside the end-of-feed marker: the marker scan
+    must use identity, not ``None in got`` — ndarray __eq__ is
+    elementwise and makes ``in``/.index raise ValueError on
+    truth-testing (round-5 drive regression)."""
+    from collections import deque
+    from tensorflowonspark_tpu.node import DualInput
+
+    class StubQueue:
+      def __init__(self, rows):
+        self._rows = deque(rows)
+
+      def get_many(self, n, block=True, timeout=None):
+        out = []
+        while self._rows and len(out) < n:
+          out.append(self._rows.popleft())
+        return out
+
+      def empty(self):
+        return not self._rows
+
+      def qsize(self):
+        return len(self._rows)
+
+      def task_done(self, n=1):
+        pass
+
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      adapter = shmring.RingQueueAdapter(ring)
+      adapter.put_many([np.array([1.0, 2.0]), np.array([3.0, 4.0])])
+      adapter.put_many([None])
+      stub = StubQueue([np.array([9.0])])
+      dual = DualInput(adapter, stub)
+      got = dual.get_many(8, timeout=0.5)
+      # marker held back while the queue still has rows; array rows pass
+      # through intact
+      assert [np.asarray(r).tolist() for r in got] == [[1.0, 2.0],
+                                                       [3.0, 4.0]]
+      assert np.asarray(dual.get_many(8, timeout=0.5)[0]).tolist() == [9.0]
+      assert dual.get_many(8, timeout=0.5) == [None]
+
   def test_dual_input_holds_synthesized_close_marker(self):
     """A ring closed without an in-band marker synthesizes one — which must
     ALSO wait for the hub queue to drain."""
