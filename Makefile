@@ -4,10 +4,11 @@
 
 PY ?= python
 
-.PHONY: test lint native bench dryrun validate clean
+.PHONY: test lint native bench dryrun mosaic-gate validate clean
 
-# the end-of-round ritual: lint gate + full suite + multichip dryrun
-validate: test dryrun
+# the end-of-round ritual: lint gate + full suite + multichip dryrun +
+# deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
+validate: test dryrun mosaic-gate
 
 # stdlib-only lint gate (this image has no ruff/pycodestyle/mypy and no
 # network); scope parity with the reference's tox pycodestyle/pylint envs
@@ -22,6 +23,12 @@ native:
 
 bench:
 	$(PY) bench.py
+
+# AOT-compile every Pallas kernel + the full fused train step against a
+# deviceless v5e topology (real Mosaic lowering via local libtpu; no chip
+# claimed — the tool sanitizes its env via utils.platform_env)
+mosaic-gate:
+	$(PY) tools/mosaic_gate.py
 
 # dryrun_multichip self-sanitizes via utils/platform_env.py; the env prefix is
 # redundant belt-and-suspenders for sandboxes with a remote-TPU sitecustomize.

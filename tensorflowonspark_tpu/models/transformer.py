@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 import flax.linen as nn
 
+from tensorflowonspark_tpu import ops
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 from tensorflowonspark_tpu.parallel import ring_attention as ra
 
@@ -209,7 +210,7 @@ class FusedLayerNorm(nn.Module):
 def _make_layer_norm(cfg: TransformerConfig, mesh, name: str):
   if _fused_ln_eligible(cfg):
     return FusedLayerNorm(mesh=mesh, name=name,
-                          interpret=jax.default_backend() != "tpu")
+                          interpret=ops.pallas_interpret())
   return nn.LayerNorm(dtype=jnp.float32, use_bias=False, name=name)
 
 
@@ -220,7 +221,7 @@ def _ln_matmul_call(x, ln_scale, w2, mesh=None):
   multi-chip training path gets the fusion too."""
   from tensorflowonspark_tpu.ops import ln_matmul as _ln_mm
   from tensorflowonspark_tpu.ops import ln_matmul_sharded as _ln_mm_sh
-  interp = jax.default_backend() != "tpu"
+  interp = ops.pallas_interpret()
   if mesh is not None:
     return _ln_mm_sh(x, ln_scale, w2, mesh, interpret=interp)
   return _ln_mm(x, ln_scale, w2, interpret=interp)
@@ -306,7 +307,7 @@ class Attention(nn.Module):
     q = _rotary(q, positions)
     k = _rotary(k, positions)
 
-    interp = jax.default_backend() != "tpu"   # forced-flash CI runs
+    interp = ops.pallas_interpret()           # forced-flash CI runs
     if cfg.use_ring_attention and self.mesh is not None:
       # the ring takes GROUPED K/V as-is: unexpanded blocks rotate on the
       # ICI (num_heads/kv_heads less traffic); the flash kernels consume
@@ -423,7 +424,7 @@ def _gelu_matmul_call(x, w, mesh=None):
   policy; per-shard through shard_map under a mesh (with the tensor-axis
   psum the unfused down-proj needs anyway)."""
   from tensorflowonspark_tpu.ops import gelu_matmul, gelu_matmul_sharded
-  interp = jax.default_backend() != "tpu"
+  interp = ops.pallas_interpret()
   if mesh is not None:
     return gelu_matmul_sharded(x, w, mesh, interpret=interp)
   return gelu_matmul(x, w, interpret=interp)

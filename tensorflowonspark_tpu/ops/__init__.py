@@ -8,7 +8,28 @@ few ops XLA cannot fuse optimally are written in Pallas:
   ring attention's sequence parallelism.
 """
 
-from tensorflowonspark_tpu.ops.flash_attention import (  # noqa: F401
+def pallas_interpret() -> bool:
+  """Whether Pallas kernels should run in interpret (emulation) mode.
+
+  Default policy: interpret off-TPU (how CPU CI trains through the
+  production kernel paths), real Mosaic lowering on TPU. Override with
+  ``TOS_PALLAS_INTERPRET=0`` to force real kernels even when the default
+  backend is not TPU — that is how the deviceless Mosaic gate
+  (tools/mosaic_gate.py) AOT-compiles every production kernel against a
+  TPU topology from a CPU-only host, with no chip claimed. ``=1`` forces
+  interpret everywhere (debugging on-chip numerics).
+  """
+  import os
+  v = os.environ.get("TOS_PALLAS_INTERPRET", "auto").lower()
+  if v in ("0", "false"):
+    return False
+  if v in ("1", "true"):
+    return True
+  import jax
+  return jax.default_backend() != "tpu"
+
+
+from tensorflowonspark_tpu.ops.flash_attention import (  # noqa: F401,E402
     flash_attention, flash_attention_block, merge_partials,
 )
 from tensorflowonspark_tpu.ops.layer_norm import (  # noqa: F401

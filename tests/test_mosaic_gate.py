@@ -1,0 +1,79 @@
+"""The deviceless Mosaic-lowering gate (tools/mosaic_gate.py).
+
+Round-2's on-chip session proved interpret-green Pallas kernels can be
+rejected by real Mosaic lowering ("XLA layout ... does not match Mosaic
+layout"); rounds 3-4 could not re-check because the device claim service
+was down. The gate AOT-compiles kernels against a TPU *topology*
+(jax.experimental.topologies) — libtpu's real compiler, no chip claimed —
+so Mosaic validity is a CI property of this image. These tests assert the
+gate is wired correctly AND has teeth (a Mosaic-invalid kernel turns red).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _topology_or_skip():
+  try:
+    from tools.mosaic_gate import _topology
+    return _topology("v5e:2x2")
+  except Exception as e:  # noqa: BLE001 - no local libtpu: gate unavailable
+    pytest.skip("deviceless TPU topology unavailable: %r" % (e,))
+
+
+def test_gate_green_on_production_kernels():
+  """A fused-backward flash target (short-seq clamp path) and the fused
+  LayerNorm compile through real Mosaic lowering, devicelessly."""
+  _topology_or_skip()
+  from tools.mosaic_gate import run_gate
+  results = run_gate(["layer_norm", "flash_short_seq_bwd"])
+  assert all(r["ok"] for r in results), results
+
+
+def test_gate_red_on_mosaic_invalid_kernel():
+  """A kernel that interpret mode happily runs (1-D iota) must FAIL the
+  deviceless compile — proof the gate exercises real Mosaic lowering, not
+  the interpret emulation."""
+  import numpy as np
+  _topology_or_skip()
+  import jax
+  import jax.numpy as jnp
+  from jax.experimental import pallas as pl
+  from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+  from tools.mosaic_gate import _topology
+
+  mesh = Mesh(np.array(_topology("v5e:2x2").devices[:1]), ("one",))
+
+  def kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + jax.lax.iota(jnp.float32, 128)
+
+  def call(x):
+    return pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct((128,), jnp.float32))(x)
+
+  x = jax.ShapeDtypeStruct((128,), jnp.float32)
+  # interpret mode: green (the blind spot the gate exists to close)
+  jax.jit(lambda x: pl.pallas_call(
+      kern, out_shape=jax.ShapeDtypeStruct((128,), jnp.float32),
+      interpret=True)(x)).lower(x).compile()
+  # real Mosaic lowering: red — and specifically the Mosaic verifier
+  # rejecting the op, not some unrelated topology/sharding failure
+  f = jax.jit(call, in_shardings=(NamedSharding(mesh, P()),))
+  with pytest.raises(Exception, match=r"tpu\.iota|[Mm]osaic"):
+    f.lower(x).compile()
+
+
+def test_gate_full_train_step_compiles(monkeypatch):
+  """The dryrun-config 8-chip fused training step (ring + GQA flash +
+  ln_matmul_sharded + act fusion + remat) Mosaic-compiles on a v5e:2x4
+  topology with abstract state — the multi-chip production path is
+  compile-checked without any device."""
+  _topology_or_skip()
+  monkeypatch.setenv("TOS_PALLAS_INTERPRET", "0")
+  from tools.mosaic_gate import run_gate
+  results = run_gate(["train_step"])
+  assert results[0]["ok"], results
