@@ -251,6 +251,18 @@ class _QKVKernel(nn.Module):
         (self.d_model, self.n_heads_total, self.head_dim), jnp.float32)
 
 
+def _heads_logical(n_heads: int, mesh) -> Optional[str]:
+  """The logical axis for a heads dimension: "heads" (→ the
+  tensor-parallel mesh axis) when the head count divides the tensor axis,
+  else None (replicated). ONE rule shared by the projection kernels and
+  the KV-cache constraint — a head count the axis can't divide (grouped
+  KV heads, or the fused h+2·hk projection) must fall back to replication
+  on BOTH sides or params and cache shard inconsistently (GSPMD then
+  gathers the cache every decode step)."""
+  t = 1 if mesh is None else mesh.shape.get(mesh_lib.AXIS_TENSOR, 1)
+  return "heads" if n_heads % max(1, t) == 0 else None
+
+
 class Attention(nn.Module):
   cfg: TransformerConfig
   mesh: Optional[Any] = None
@@ -265,14 +277,7 @@ class Attention(nn.Module):
         feats, axis=-1, dtype=cfg.dtype, use_bias=False, name=name,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.lecun_normal(), logical))
-    def heads_axis(n_heads):
-      # the "heads" logical axis maps to the tensor-parallel mesh axis;
-      # a head count the axis can't divide (grouped KV heads, or the
-      # fused h+2·hk projection) must fall back to replication or state
-      # init fails on the divisibility check
-      t = 1 if self.mesh is None else \
-          self.mesh.shape.get(mesh_lib.AXIS_TENSOR, 1)
-      return "heads" if n_heads % max(1, t) == 0 else None
+    heads_axis = lambda n: _heads_logical(n, self.mesh)  # noqa: E731
 
     if cfg.fuse_qkv:
       # one MXU matmul for all three projections, sliced on the heads axis
@@ -365,10 +370,17 @@ class Attention(nn.Module):
     positions = idx + jnp.broadcast_to(jnp.arange(seg), (b, seg))
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-    cached_k.value = jax.lax.dynamic_update_slice(
-        cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-    cached_v.value = jax.lax.dynamic_update_slice(
-        cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+    # tensor-parallel serving: keep the cache sharded on its (grouped)
+    # heads dim so each chip holds 1/t of the KV bytes and attends its own
+    # head slice — without the constraint GSPMD may gather the cache.
+    # Same divisibility rule as the projection kernels (_heads_logical).
+    kv_spec = ("batch", None, _heads_logical(hk, self.mesh), "kv")
+    cached_k.value = _constrain(jax.lax.dynamic_update_slice(
+        cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)),
+        kv_spec, self.mesh)
+    cached_v.value = _constrain(jax.lax.dynamic_update_slice(
+        cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)),
+        kv_spec, self.mesh)
     cursor.value = idx + seg
 
     scale = 1.0 / (d ** 0.5)
@@ -735,11 +747,20 @@ def _select_token(logits, rng, temperature: float, top_k: int):
 
 @functools.lru_cache(maxsize=8)
 def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
-                    num_steps: int, temperature: float, top_k: int):
+                    num_steps: int, temperature: float, top_k: int,
+                    mesh=None):
   """Cached jitted KV-cache decode: prefill once, then one token per step
   against the per-layer key/value cache — O(1) attention work per new
-  token instead of a full-sequence recompute."""
-  model = Transformer(cfg)
+  token instead of a full-sequence recompute.
+
+  With ``mesh``, decode is tensor-parallel (the reference's dedicated
+  inference layer scaled past one chip, TFModel.scala:245-292): params go
+  in under their logical shardings (heads over the tensor axis), the KV
+  cache stays heads-sharded on-chip (``_decode_attend``'s constraint), the
+  batch dim rides the data axes, and the output gathers replicated. The
+  jit carries explicit in/out shardings so host-resident bundle params are
+  placed correctly on first call."""
+  model = Transformer(cfg, mesh=mesh)
 
   def decode(params, prompt, rng):
     # init runs the decode path on a dummy token (advancing the cursor and
@@ -770,19 +791,32 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
         if num_steps > 1 else nxt[:, None]
     return jnp.concatenate([prompt, generated], axis=1)
 
-  return jax.jit(decode)
+  if mesh is None:
+    return jax.jit(decode)
+  from tensorflowonspark_tpu.parallel import sharding as sh
+  abs_boxed = jax.eval_shape(
+      lambda: model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((batch, 1), jnp.int32),
+                         decode=True))["params"]
+  param_sharding = sh.param_sharding_from_boxed(abs_boxed, mesh)
+  return jax.jit(decode,
+                 in_shardings=(param_sharding, sh.batch_sharding(mesh),
+                               sh.replicated(mesh)),
+                 out_shardings=sh.replicated(mesh))
 
 
 def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
                        num_steps: int, temperature: float = 0.0,
-                       top_k: int = 0, rng=None):
+                       top_k: int = 0, rng=None, mesh=None):
   """Decoding with a per-layer KV cache (the serving path).
 
   Greedy by default; ``temperature > 0`` samples (optionally top-k
   filtered) using ``rng``. Semantically identical to
   :func:`greedy_generate` when greedy, but each new token attends against
   cached keys/values rather than recomputing the full prefix — requires
-  prompt_len + num_steps <= cfg.max_seq_len.
+  prompt_len + num_steps <= cfg.max_seq_len. With ``mesh``, decode runs
+  tensor-parallel: heads (and the heads-sharded KV cache) split over the
+  tensor axis, batch over the data axes (see ``_kv_generate_fn``).
   """
   b, plen = prompt.shape
   if plen + num_steps > cfg.max_seq_len:
@@ -796,13 +830,30 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
       # a silent fixed key would make every "sampled" call identical
       raise ValueError("temperature > 0 requires an explicit rng key")
     rng = jax.random.PRNGKey(0)
-  return _kv_generate_fn(cfg, b, plen, num_steps, float(temperature),
-                         int(top_k))(params, prompt.astype(jnp.int32), rng)
+  pad = 0
+  if mesh is not None:
+    # the batch dim shards over the data axes; a ragged final serving
+    # batch (pipeline.yield_batch's `if count > 0` tail) is padded up to
+    # the axis extent and sliced back after — decode rows are independent,
+    # so padding never changes real rows' greedy tokens (with
+    # temperature > 0 the padded shape shifts the vectorized draw, which
+    # sampling semantics permit)
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+    pad = (-b) % mesh_lib.axis_size(mesh, mesh_lib.AXIS_DATA,
+                                    mesh_lib.AXIS_FSDP)
+  if pad:
+    prompt = jnp.concatenate(
+        [prompt.astype(jnp.int32),
+         jnp.zeros((pad, plen), jnp.int32)], axis=0)
+  out = _kv_generate_fn(cfg, b + pad, plen, num_steps, float(temperature),
+                        int(top_k), mesh)(params,
+                                          prompt.astype(jnp.int32), rng)
+  return out[:b] if pad else out
 
 
 def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
                             temperature: float = 0.0, top_k: int = 0,
-                            seed: int = 0):
+                            seed: int = 0, mesh=None):
   """Build a ``predict_fn(params, batch)`` for ``pipeline.export_bundle``.
 
   The batched KV-cache serving loop as a pipeline bundle: TFModel.transform
@@ -818,7 +869,8 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
   and a per-process call counter, so different batches (and repeated
   serves of the same batch) draw different streams — never the fixed-key
   repetition ``greedy_generate_kv``'s explicit-rng guard exists to
-  prevent.
+  prevent. ``mesh`` makes each serve tensor-parallel over its axes (the
+  multi-chip inference layer, reference TFModel.scala:245-292).
   """
   state = {"calls": 0}
 
@@ -836,7 +888,8 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
                              zlib.crc32(prompts.tobytes())),
           state["calls"])
     out = greedy_generate_kv(params, cfg, jnp.asarray(prompts), num_steps,
-                             temperature=temperature, top_k=top_k, rng=rng)
+                             temperature=temperature, top_k=top_k, rng=rng,
+                             mesh=mesh)
     return {"tokens": np.asarray(out)}
 
   return predict_fn

@@ -287,6 +287,30 @@ def t_train_step():
   return step, (abs_state, tokens)
 
 
+def t_serving_decode():
+  """Tensor-parallel KV-cache decode (heads + cache over `tensor`, batch
+  over `data`) — the multi-chip serving path, compiled with abstract
+  params and an abstract PRNG key (nothing materializes)."""
+  import jax
+  import jax.numpy as jnp
+  from flax.core import meta
+  from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(data=-1, tensor=2),
+      devices=list(_topology("v5e:2x2").devices))
+  cfg = tfm.TransformerConfig(
+      vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+      d_model=128, d_ff=256, max_seq_len=64, remat=False)
+  fn = tfm._kv_generate_fn(cfg, 4, 16, 8, 0.0, 0, mesh)
+  model = tfm.Transformer(cfg, mesh=mesh)
+  abs_params = jax.eval_shape(lambda: meta.unbox(model.init(
+      jax.random.PRNGKey(0), jnp.zeros((4, 1), jnp.int32),
+      decode=True)["params"]))
+  key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+  return fn, (abs_params, jax.ShapeDtypeStruct((4, 16), jnp.int32), key)
+
+
 TARGETS = {
     "flash_mha_fwd": t_flash_mha_fwd,
     "flash_mha_fused_bwd": t_flash_mha_fused_bwd,
@@ -302,6 +326,7 @@ TARGETS = {
     "gelu_matmul": t_gelu_matmul,
     "gelu_matmul_sharded": t_gelu_matmul_sharded,
     "train_step": t_train_step,
+    "serving_decode": t_serving_decode,
 }
 
 
