@@ -511,6 +511,26 @@ class TestPipeline1F1B:
                                np.asarray(jax.grad(seq_loss)(W)),
                                atol=0.05)
 
+  def test_inputs_scattered_along_pipeline(self, devices):
+    """With n_micro % S == 0 the token/target microbatches are scattered
+    over the pipeline axis and ride ppermute conveyors (round-4 verdict
+    item 6) — visible as extra collective-permutes in the compiled HLO
+    versus the replicated fallback (n_micro < S). A silent regression to
+    always-replicate would pass the parity tests; this pins the path."""
+    PP, stage_fn, loss_fn, W, x, t, _ = self._setup()
+    mesh = M.build_mesh(M.MeshSpec(pipeline=4), devices=devices[:4])
+
+    def cp_count(n_micro):
+      hlo = jax.jit(lambda W, x, t: PP.pipeline_train_step(
+          stage_fn, loss_fn, W, x, t, mesh,
+          num_microbatches=n_micro)).lower(W, x, t).compile().as_text()
+      return hlo.count("collective-permute(")
+
+    replicated = cp_count(2)    # 2 < S=4 -> fallback: act + cotangent CPs
+    scattered = cp_count(4)     # divisible -> + token & target conveyors
+    assert replicated >= 2
+    assert scattered > replicated, (replicated, scattered)
+
   def test_microbatch_data_divisibility_asserts(self, devices):
     PP, stage_fn, loss_fn, W, x, t, _ = self._setup()
     mesh = M.build_mesh(M.MeshSpec(data=2, pipeline=4), devices=devices)
