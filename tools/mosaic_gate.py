@@ -311,6 +311,77 @@ def t_serving_decode():
   return fn, (abs_params, jax.ShapeDtypeStruct((4, 16), jnp.int32), key)
 
 
+def t_pipeline_1f1b():
+  """The 1F1B schedule with scattered-input conveyors (4 stages, n_micro=8
+  → the ppermute token/target conveyors are engaged) through the real TPU
+  compiler — loop + collective lowering, no Pallas."""
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  from tensorflowonspark_tpu.parallel import pipeline_parallel as pp
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(pipeline=4),
+      devices=list(_topology("v5e:2x2").devices))
+
+  def step(W, x, t):
+    return pp.pipeline_train_step(
+        lambda w, a: jnp.tanh(a @ w),
+        lambda y, tg: jnp.mean((y - tg) ** 2),
+        W, x, t, mesh, num_microbatches=8)
+
+  fn = jax.jit(step, in_shardings=(_repl(mesh),) * 3)
+  d = 128
+  return fn, (_sh(4, d, d, dtype=jnp.float32),
+              _sh(32, d, dtype=jnp.float32),
+              _sh(32, d, dtype=jnp.float32))
+
+
+def t_pipeline_lm_flash():
+  """The FULL transformer through the 1F1B pipe with flash attention
+  forced inside the pipelined stages: Pallas kernels inside a fori_loop
+  inside shard_map lax.cond — the hardest lowering composition in the
+  repo, previously exercised only in CPU interpret mode."""
+  import jax
+  import jax.numpy as jnp
+  from flax.core import meta
+  from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(pipeline=2),
+      devices=list(_topology("v5e:2x2").devices)[:2])
+  cfg = tfm.TransformerConfig(
+      vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+      d_model=128, d_ff=256, max_seq_len=128, remat=False,
+      attention_impl="flash", dtype=jnp.float32)
+  model = tfm.Transformer(cfg)
+  abs_params = jax.eval_shape(lambda: meta.unbox(model.init(
+      jax.random.PRNGKey(0), jnp.zeros((1, 128), jnp.int32))["params"]))
+  lm_step = tfm.make_pipeline_train_step(cfg, mesh, num_microbatches=4)
+  fn = jax.jit(lm_step)
+  return fn, (abs_params, _sh(8, 128, dtype=jnp.int32))
+
+
+def t_expert_a2a():
+  """MoE all-to-all dispatch (top-k gating, capacity drop/combine) over a
+  data×expert mesh through the TPU compiler."""
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.parallel import expert_parallel as ep
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(data=2, expert=2),
+      devices=list(_topology("v5e:2x2").devices))
+  params = jax.eval_shape(
+      lambda: ep.init_moe_params(jax.random.PRNGKey(0), 4, 128, 512))
+
+  def step(p, x):
+    out = ep.moe_ffn_a2a(p, x, mesh, capacity_factor=2.0, top_k=2)
+    return out.sum()
+
+  fn = jax.jit(jax.grad(step, argnums=0))
+  return fn, (params, _sh(64, 128, dtype=jnp.float32))
+
+
 TARGETS = {
     "flash_mha_fwd": t_flash_mha_fwd,
     "flash_mha_fused_bwd": t_flash_mha_fused_bwd,
@@ -327,6 +398,9 @@ TARGETS = {
     "gelu_matmul_sharded": t_gelu_matmul_sharded,
     "train_step": t_train_step,
     "serving_decode": t_serving_decode,
+    "pipeline_1f1b": t_pipeline_1f1b,
+    "pipeline_lm_flash": t_pipeline_lm_flash,
+    "expert_a2a": t_expert_a2a,
 }
 
 
