@@ -301,49 +301,55 @@ def _bench_long_context():
 _PARTIAL = {"value": 0.0, "extra": None}
 
 
+# The MFU-hunt candidate configs (round-2 verdict: fused QKV on chip,
+# s=2048, fused-vs-flax LayerNorm; round-3/4 add the ln/act fusions, remat
+# policies and GQA). Module-level so tools/mosaic_gate.py --bench-sweep can
+# compile-validate every candidate against the deviceless TPU topology
+# BEFORE a chip is ever claimed — sweep day then measures, not debugs.
+SWEEP_CONFIGS = [
+    ("b16_s1024_base", {}),
+    ("b16_s1024_fuseqkv", {"fuse_qkv": True}),
+    ("b16_s1024_flaxln", {"layer_norm_impl": "flax"}),
+    ("b16_s1024_lnmm", {"ln_matmul_impl": "fused"}),
+    ("b16_s1024_lnmm_fuseqkv", {"ln_matmul_impl": "fused",
+                                "fuse_qkv": True}),
+    ("b16_s1024_actmm", {"act_matmul_impl": "fused"}),
+    # everything fused: ln1+QKV, ln2+up, gelu+down each one kernel
+    ("b16_s1024_allfused", {"ln_matmul_impl": "fused", "fuse_qkv": True,
+                            "act_matmul_impl": "fused"}),
+    ("b8_s2048", {"batch": 8, "seq": 2048}),
+    ("b8_s2048_fuseqkv", {"batch": 8, "seq": 2048, "fuse_qkv": True}),
+    ("b8_s2048_allfused", {"batch": 8, "seq": 2048,
+                           "ln_matmul_impl": "fused", "fuse_qkv": True,
+                           "act_matmul_impl": "fused"}),
+    # selective remat: save MXU outputs, recompute elementwise only —
+    # batch 24/32 OOM without remat and full remat costs ~21%; "dots"
+    # aims at the bigger batch for a fraction of the recompute
+    ("b24_s1024_rematdots", {"batch": 24, "remat": True,
+                             "remat_policy": "dots"}),
+    ("b32_s1024_rematdots", {"batch": 32, "remat": True,
+                             "remat_policy": "dots"}),
+    ("b32_s1024_rematdots_allfused", {"batch": 32, "remat": True,
+                                      "remat_policy": "dots",
+                                      "ln_matmul_impl": "fused",
+                                      "fuse_qkv": True,
+                                      "act_matmul_impl": "fused"}),
+    # GQA at the bench shape: 12 query heads on 4 KV heads — the
+    # grouped kernels read 3x less KV from HBM; with allfused on top
+    ("b16_s1024_gqa4", {"num_kv_heads": 4}),
+    ("b16_s1024_gqa4_allfused", {"num_kv_heads": 4,
+                                 "ln_matmul_impl": "fused",
+                                 "fuse_qkv": True,
+                                 "act_matmul_impl": "fused"}),
+]
+
+
 def _sweep():
   """MFU-hunt mode (`TOS_BENCH_SWEEP=1`, manual runs only — the driver
   contract of one JSON line does not apply): measure the transformer bench
-  across the candidate configs from the round-2 verdict (fused QKV on
-  chip, s=2048, fused-vs-flax LayerNorm) and print one JSON object with
-  all of them."""
+  across SWEEP_CONFIGS and print one JSON object with all of them."""
   results = {}
-  for name, kw in [
-      ("b16_s1024_base", {}),
-      ("b16_s1024_fuseqkv", {"fuse_qkv": True}),
-      ("b16_s1024_flaxln", {"layer_norm_impl": "flax"}),
-      ("b16_s1024_lnmm", {"ln_matmul_impl": "fused"}),
-      ("b16_s1024_lnmm_fuseqkv", {"ln_matmul_impl": "fused",
-                                  "fuse_qkv": True}),
-      ("b16_s1024_actmm", {"act_matmul_impl": "fused"}),
-      # everything fused: ln1+QKV, ln2+up, gelu+down each one kernel
-      ("b16_s1024_allfused", {"ln_matmul_impl": "fused", "fuse_qkv": True,
-                              "act_matmul_impl": "fused"}),
-      ("b8_s2048", {"batch": 8, "seq": 2048}),
-      ("b8_s2048_fuseqkv", {"batch": 8, "seq": 2048, "fuse_qkv": True}),
-      ("b8_s2048_allfused", {"batch": 8, "seq": 2048,
-                             "ln_matmul_impl": "fused", "fuse_qkv": True,
-                             "act_matmul_impl": "fused"}),
-      # selective remat: save MXU outputs, recompute elementwise only —
-      # batch 24/32 OOM without remat and full remat costs ~21%; "dots"
-      # aims at the bigger batch for a fraction of the recompute
-      ("b24_s1024_rematdots", {"batch": 24, "remat": True,
-                               "remat_policy": "dots"}),
-      ("b32_s1024_rematdots", {"batch": 32, "remat": True,
-                               "remat_policy": "dots"}),
-      ("b32_s1024_rematdots_allfused", {"batch": 32, "remat": True,
-                                        "remat_policy": "dots",
-                                        "ln_matmul_impl": "fused",
-                                        "fuse_qkv": True,
-                                        "act_matmul_impl": "fused"}),
-      # GQA at the bench shape: 12 query heads on 4 KV heads — the
-      # grouped kernels read 3x less KV from HBM; with allfused on top
-      ("b16_s1024_gqa4", {"num_kv_heads": 4}),
-      ("b16_s1024_gqa4_allfused", {"num_kv_heads": 4,
-                                   "ln_matmul_impl": "fused",
-                                   "fuse_qkv": True,
-                                   "act_matmul_impl": "fused"}),
-  ]:
+  for name, kw in SWEEP_CONFIGS:
     try:
       r = _bench_transformer(**kw)
       results[name] = {"tok_s": r["transformer_tokens_per_sec"],

@@ -167,16 +167,21 @@ def _flash_eligible(cfg: TransformerConfig, seq_len: int) -> bool:
           "not divide into kernel blocks — pad the sequence or use 'auto'"
           % seq_len)
     return True
-  return jax.default_backend() == "tpu" and divisible
+  # "auto" = the kernel wherever kernels are in play: the TPU backend
+  # (even with interpret forced on for numerics debugging), or under
+  # TOS_PALLAS_INTERPRET=0 (the deviceless Mosaic gate compiling FOR a
+  # TPU topology from a CPU client — it must compile what the chip runs)
+  return ops.pallas_kernels_enabled() and divisible
 
 
 def _fused_ln_eligible(cfg: TransformerConfig) -> bool:
-  """Whether blocks should use the fused Pallas LayerNorm."""
+  """Whether blocks should use the fused Pallas LayerNorm ("auto" follows
+  the same kernels-in-play policy as attention, see _flash_eligible)."""
   if cfg.layer_norm_impl == "flax":
     return False
   if cfg.layer_norm_impl == "fused":
     return True
-  return jax.default_backend() == "tpu"
+  return ops.pallas_kernels_enabled()
 
 
 class FusedLayerNorm(nn.Module):

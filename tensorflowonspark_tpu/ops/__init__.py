@@ -29,6 +29,23 @@ def pallas_interpret() -> bool:
   return jax.default_backend() != "tpu"
 
 
+def pallas_kernels_enabled() -> bool:
+  """Whether "auto" impl settings should pick the Pallas kernels at all.
+
+  Distinct from :func:`pallas_interpret` (HOW kernels run) — this decides
+  WHETHER "auto" uses them: on the real TPU backend, or under
+  ``TOS_PALLAS_INTERPRET=0`` (the deviceless gate compiling FOR a TPU
+  topology from a CPU client). ``TOS_PALLAS_INTERPRET=1`` on a TPU does
+  NOT disable them — the kernels stay selected and run in interpret mode,
+  which is the flag's on-chip numerics-debugging purpose.
+  """
+  import os
+  if os.environ.get("TOS_PALLAS_INTERPRET", "").lower() in ("0", "false"):
+    return True
+  import jax
+  return jax.default_backend() == "tpu"
+
+
 from tensorflowonspark_tpu.ops.flash_attention import (  # noqa: F401,E402
     flash_attention, flash_attention_block, merge_partials,
 )

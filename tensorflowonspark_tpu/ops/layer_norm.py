@@ -111,21 +111,31 @@ def _ln_fwd_rule(x, weight, eps, blk_rows, interpret):
 
 
 def _pick_block(rows: int, blk_rows: int, h: int, itemsize: int = 0) -> int:
-  """Largest block <= blk_rows that divides the row count (always >= 1),
-  so any shape works without padding or uncovered rows.
+  """Largest SUBLANE-ALIGNED block <= blk_rows that divides the row count
+  (a multiple of 8 — Mosaic accepts a second-minor block dim only if it
+  is 8-aligned or the whole dimension; when no aligned divisor exists,
+  e.g. odd row counts, fall back to one full-dimension block).
 
   With ``itemsize`` set (the BACKWARD path), the block is additionally
   capped so one [blk, H] input block stays <= 1 MiB: the f32 backward at
   H=4096 with 128-row blocks crashes the real-TPU compile helper, while
   the forward at the same shape, the bf16 backward at blk=128, and the
   f32 backward at blk=64 all compile fine — so the cap keys off the
-  actual element footprint and is not applied to the forward."""
+  actual element footprint and is not applied to the forward.
+
+  The full-dimension fallback (rows not a multiple of 8, e.g. 4100) can
+  exceed the cap — deliberately: a small unaligned divisor would pass
+  interpret mode and fail real Mosaic lowering (the round-2 trap), so
+  the ONLY Mosaic-valid block for such shapes is the whole dimension,
+  VMEM cost and all. Pad the row count to a multiple of 8 upstream if
+  that footprint is too large."""
   blk = min(blk_rows, rows)
   if itemsize:
     blk = min(blk, max(8, (1 << 20) // (h * itemsize)))
-  while rows % blk != 0:
-    blk -= 1
-  return blk
+  for b in range(blk - blk % 8, 0, -8):
+    if rows % b == 0:
+      return b
+  return rows
 
 
 def _ln_fwd(x, weight, eps, blk_rows, interpret):

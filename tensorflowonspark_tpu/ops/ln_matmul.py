@@ -40,10 +40,17 @@ def _ln_matmul_kernel(x_ref, wln_ref, w_ref, o_ref, *, eps: float):
 
 
 def _pick_col_block(n: int, blk_cols: int) -> int:
+  """Largest LANE-ALIGNED divisor of ``n`` <= blk_cols, or ``n`` itself
+  when none exists. Mosaic accepts a last-dim block only if it is a
+  multiple of 128 or the whole dimension — a bare largest-divisor snap
+  (1280 cols @ blk 512 → 320) fails real TPU lowering; caught by the
+  deviceless gate on the GQA fused-QKV sweep config (its h+2·hk=20-head
+  projection has N=1280)."""
   blk = min(blk_cols, n)
-  while n % blk != 0:
-    blk -= 1
-  return blk
+  for b in range(blk - blk % 128, 0, -128):
+    if n % b == 0:
+      return b
+  return n
 
 
 def effective_blocks(rows: int, h: int, n: int, blk_rows: int,

@@ -357,6 +357,30 @@ class TestGeluMatmul:
                                atol=2e-4, rtol=2e-4)
 
 
+class TestBlockPickers:
+  """Mosaic accepts a last-dim block only when it is a multiple of 128
+  (lanes) — or the whole dim — and a second-minor block only when a
+  multiple of 8 (sublanes) or the whole dim. The pickers must never snap
+  to a bare divisor violating that: caught by the deviceless gate on the
+  GQA fused-QKV sweep config (N = 20 heads · 64 = 1280 snapped to 320 and
+  failed real TPU lowering)."""
+
+  def test_col_picker_lane_aligned(self):
+    from tensorflowonspark_tpu.ops.ln_matmul import _pick_col_block
+    assert _pick_col_block(1280, 512) == 256    # not 320
+    assert _pick_col_block(768, 192) == 128     # 192 divides, but %128!=0
+    assert _pick_col_block(3072, 512) == 512
+    assert _pick_col_block(96, 512) == 96       # < 128: full dim only
+    assert _pick_col_block(1152, 512) == 384
+
+  def test_row_picker_sublane_aligned(self):
+    from tensorflowonspark_tpu.ops.layer_norm import _pick_block
+    assert _pick_block(16384, 128, 768) == 128
+    assert _pick_block(96, 64, 768) == 48
+    # no 8-aligned divisor (100 = 4*25): one full-dim block, never 50
+    assert _pick_block(100, 64, 768) == 100
+
+
 class TestLNMatmul:
   """Fused LayerNorm + matmul (ops.ln_matmul): LN(x) @ W in one kernel."""
 

@@ -404,6 +404,180 @@ TARGETS = {
 }
 
 
+def _abs_bench_step(batch, seq, cfg_kwargs, vocab, layers, heads, d_model,
+                    d_ff, loss_impl="full"):
+  """(jitted step, abstract args) for a single-chip bench config — the
+  exact `bench._bench_transformer` / `_bench_long_context` computation
+  with eval_shape state, pinned to the 1-device topology mesh."""
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+  mesh = _mesh1()
+  repl = _repl(mesh)
+  cfg = tfm.TransformerConfig(
+      vocab_size=vocab, num_layers=layers, num_heads=heads,
+      d_model=d_model, d_ff=d_ff, max_seq_len=seq, **cfg_kwargs)
+  abs_state = jax.eval_shape(
+      lambda: tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=seq))
+
+  def train_step(state, tokens):
+    def loss_fn(params):
+      if loss_impl == "blocked":
+        hidden = state.apply_fn({"params": params}, tokens,
+                                return_hidden=True)
+        return tfm.causal_lm_loss_blocked(
+            hidden, tfm.tied_embedding_table(params), tokens)
+      logits = state.apply_fn({"params": params}, tokens)
+      return tfm.causal_lm_loss(logits, tokens)
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    return state.apply_gradients(grads=grads), loss
+
+  fn = jax.jit(train_step, in_shardings=(repl, repl),
+               out_shardings=(repl, repl))
+  tokens = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+  return fn, (abs_state, tokens)
+
+
+def run_bench_sweep_gate(json_path):
+  """Compile-validate every TOS_BENCH_SWEEP candidate config (plus the
+  long-context bench) against the deviceless topology, so sweep day on a
+  real chip measures instead of debugging Mosaic rejections."""
+  import bench
+  results = []
+  entries = [(name, dict(kw)) for name, kw in bench.SWEEP_CONFIGS]
+  for name, kw in entries:
+    batch = kw.pop("batch", bench.TFM_BATCH)
+    seq = kw.pop("seq", bench.TFM_SEQ)
+    kw.setdefault("remat", bench.TFM_REMAT)
+    t0 = time.perf_counter()
+    try:
+      fn, args = _abs_bench_step(batch, seq, kw, bench.TFM_VOCAB,
+                                 bench.TFM_LAYERS, bench.TFM_HEADS,
+                                 bench.TFM_DMODEL, bench.TFM_DFF)
+      fn.lower(*args).compile()
+      results.append(dict(config=name, ok=True,
+                          seconds=round(time.perf_counter() - t0, 2)))
+      print("PASS sweep:%-28s %.1fs" % (name, time.perf_counter() - t0),
+            flush=True)
+    except Exception as e:  # noqa: BLE001 - the error IS the result
+      results.append(dict(config=name, ok=False, error=repr(e)[:800]))
+      print("FAIL sweep:%-28s %s" % (name, repr(e)[:160]), flush=True)
+  # the long-context headline config: s=4096 flash + blocked loss
+  t0 = time.perf_counter()
+  try:
+    fn, args = _abs_bench_step(4, 4096, dict(remat=False), bench.TFM_VOCAB,
+                               4, 8, 1024, 4096, loss_impl="blocked")
+    fn.lower(*args).compile()
+    results.append(dict(config="long_context_s4096", ok=True,
+                        seconds=round(time.perf_counter() - t0, 2)))
+    print("PASS sweep:%-28s %.1fs"
+          % ("long_context_s4096", time.perf_counter() - t0), flush=True)
+  except Exception as e:  # noqa: BLE001
+    results.append(dict(config="long_context_s4096", ok=False,
+                        error=repr(e)[:800]))
+    print("FAIL sweep:long_context_s4096 %s" % repr(e)[:160], flush=True)
+
+  import jax
+  n_fail = sum(1 for r in results if not r["ok"])
+  with open(json_path, "w") as f:
+    json.dump(dict(timestamp=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   jax=jax.__version__,
+                   mode="deviceless compile of bench sweep configs "
+                        "(real kernels forced, 1-device v5e topology)",
+                   passed=len(results) - n_fail, failed=n_fail,
+                   results=results), f, indent=1)
+  print("bench-sweep gate: %d/%d passed -> %s"
+        % (len(results) - n_fail, len(results), json_path))
+  return 1 if n_fail else 0
+
+
+def run_tile_sweep_gate(json_path):
+  """Compile-validate every tile candidate `tpu_validate.py --sweep-only`
+  will time on-chip (same shapes, same per-kernel grids) so the auto-tune
+  pass never wastes chip time on Mosaic-invalid tiles."""
+  import jax
+  import jax.numpy as jnp
+  # importlib: ops/__init__ re-exports `ln_matmul`/`gelu_matmul` as
+  # FUNCTIONS, shadowing the submodule attribute even for
+  # `import ...ops.ln_matmul as m` (same pattern as tpu_validate.py)
+  import importlib
+  am_mod = importlib.import_module("tensorflowonspark_tpu.ops.act_matmul")
+  lnmm_mod = importlib.import_module("tensorflowonspark_tpu.ops.ln_matmul")
+  from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+  # ONE source of truth for shapes/grids: whatever the on-chip sweep will
+  # time is exactly what this gate compile-validates
+  from tools.tpu_validate import (SWEEP_ATTN_SHAPE, SWEEP_FLASH_GRID,
+                                  SWEEP_MM_GRIDS, SWEEP_MM_SHAPE)
+  mesh = _mesh1()
+  repl = _repl(mesh)
+  results = []
+
+  def _compile(name, fn, args):
+    t0 = time.perf_counter()
+    try:
+      fn.lower(*args).compile()
+      results.append(dict(tile=name, ok=True,
+                          seconds=round(time.perf_counter() - t0, 2)))
+      print("PASS tile:%-34s %.1fs" % (name, time.perf_counter() - t0),
+            flush=True)
+    except Exception as e:  # noqa: BLE001 - the error IS the result
+      results.append(dict(tile=name, ok=False, error=repr(e)[:400]))
+      print("FAIL tile:%-34s %s" % (name, repr(e)[:140]), flush=True)
+
+  b, s, h, d = SWEEP_ATTN_SHAPE
+  q = _sh(b, s, h, d)
+  for blk_q, blk_k in SWEEP_FLASH_GRID:
+    _compile("flash_fwd[%dx%d]" % (blk_q, blk_k),
+             jax.jit(lambda q, k, v, bq=blk_q, bk=blk_k: flash_attention(
+                 q, k, v, causal=True, blk_q=bq, blk_k=bk),
+                 in_shardings=(repl,) * 3), (q, q, q))
+    for bwd in ("fused", "split"):
+      _compile("flash_bwd_%s[%dx%d]" % (bwd, blk_q, blk_k),
+               jax.jit(jax.grad(
+                   lambda q, k, v, bq=blk_q, bk=blk_k, bm=bwd: jnp.sum(
+                       flash_attention(q, k, v, causal=True, bwd=bm,
+                                       blk_bwd_q=bq, blk_bwd_k=bk)
+                       .astype(jnp.float32)), argnums=(0, 1, 2)),
+                   in_shardings=(repl,) * 3), (q, q, q))
+
+  # ln_matmul / gelu_matmul grids at the sweep's bench shapes, deduped by
+  # the kernels' own effective-block snap (tpu_validate.py does the same)
+  rows, dd, n = SWEEP_MM_SHAPE
+  x, gamma, W = _sh(rows, dd), _sh(dd, dtype=jnp.float32), _sh(dd, n)
+  xg, Wd = _sh(rows, n), _sh(n, dd)
+  seen = set()
+  for blk_r, blk_c in SWEEP_MM_GRIDS["ln_matmul"]:
+    eff = lnmm_mod.effective_blocks(rows, dd, n, blk_r, blk_c)
+    if ("ln", eff) in seen:
+      continue
+    seen.add(("ln", eff))
+    _compile("ln_matmul[%dx%d]" % eff,
+             jax.jit(lambda x, g, w, br=blk_r, bc=blk_c: lnmm_mod.ln_matmul(
+                 x, g, w, blk_rows=br, blk_cols=bc),
+                 in_shardings=(repl,) * 3), (x, gamma, W))
+  for blk_r, blk_c in SWEEP_MM_GRIDS["gelu_matmul"]:
+    eff = am_mod.effective_blocks(rows, n, dd, blk_r, blk_c, 2)
+    if ("gelu", eff) in seen:
+      continue
+    seen.add(("gelu", eff))
+    _compile("gelu_matmul[%dx%d]" % eff,
+             jax.jit(lambda x, w, br=blk_r, bc=blk_c: am_mod.gelu_matmul(
+                 x, w, blk_rows=br, blk_cols=bc),
+                 in_shardings=(repl,) * 2), (xg, Wd))
+
+  n_fail = sum(1 for r in results if not r["ok"])
+  with open(json_path, "w") as f:
+    json.dump(dict(timestamp=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   jax=jax.__version__,
+                   mode="deviceless compile of tpu_validate --sweep-only "
+                        "tile candidates (1-device v5e topology)",
+                   passed=len(results) - n_fail, failed=n_fail,
+                   results=results), f, indent=1)
+  print("tile-sweep gate: %d/%d passed -> %s"
+        % (len(results) - n_fail, len(results), json_path))
+  return 1 if n_fail else 0
+
+
 def run_gate(names):
   results = []
   for name in names:
@@ -434,10 +608,21 @@ def main(argv=None):
                   help="comma-separated subset (default: all)")
   ap.add_argument("--json", default=os.path.join(_REPO, "MOSAIC_GATE.json"))
   ap.add_argument("--list", action="store_true")
+  ap.add_argument("--bench-sweep", action="store_true",
+                  help="compile-validate every bench.SWEEP_CONFIGS entry "
+                       "instead of the kernel targets; writes "
+                       "SWEEP_COMPILE.json")
+  ap.add_argument("--tile-sweep", action="store_true",
+                  help="compile-validate every tpu_validate --sweep-only "
+                       "tile candidate; writes TILE_COMPILE.json")
   args = ap.parse_args(argv)
   if args.list:
     print("\n".join(TARGETS))
     return 0
+  if args.bench_sweep:
+    return run_bench_sweep_gate(os.path.join(_REPO, "SWEEP_COMPILE.json"))
+  if args.tile_sweep:
+    return run_tile_sweep_gate(os.path.join(_REPO, "TILE_COMPILE.json"))
   names = args.targets.split(",") if args.targets else list(TARGETS)
   unknown = [n for n in names if n not in TARGETS]
   if unknown:

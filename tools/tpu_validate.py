@@ -420,6 +420,21 @@ def check_gelu_matmul(results, shapes):
       results.append(dict(kernel=name, ok=False, error=repr(e)[:400]))
 
 
+# The sweep's shapes and tile grids — module-level so the deviceless gate
+# (tools/mosaic_gate.py --tile-sweep) compile-validates EXACTLY the tiles
+# this sweep will time on-chip; retune them here and the gate follows.
+SWEEP_ATTN_SHAPE = (2, 1024, 8, 64)          # bench-class b, s, h, d
+SWEEP_FLASH_GRID = [(128, 256), (128, 512), (256, 256), (256, 512),
+                    (256, 1024), (512, 512)]
+SWEEP_MM_SHAPE = (16384, 768, 3072)          # bench rows, d_model, N
+SWEEP_MM_GRIDS = {
+    "ln_matmul": [(128, 256), (128, 512), (256, 512), (256, 1024),
+                  (512, 512), (512, 1536)],
+    "gelu_matmul": [(16, 128), (32, 128), (32, 192), (32, 384),
+                    (64, 128), (64, 192), (64, 256), (64, 384)],
+}
+
+
 def sweep_blocks(results):
   """Auto-tune kernel tile sizes at the bench shapes (``--sweep-blocks``).
 
@@ -438,7 +453,7 @@ def sweep_blocks(results):
   lnmm = importlib.import_module('tensorflowonspark_tpu.ops.ln_matmul')
   am = importlib.import_module('tensorflowonspark_tpu.ops.act_matmul')
 
-  b, s, h, d = 2, 1024, 8, 64         # bench-class attention shape
+  b, s, h, d = SWEEP_ATTN_SHAPE
   key = jax.random.PRNGKey(7)
   kq, kk, kv, kg = jax.random.split(key, 4)
   q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
@@ -446,8 +461,7 @@ def sweep_blocks(results):
   v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
   g = jax.random.normal(kg, (b, s, h, d), jnp.bfloat16)
 
-  grid = [(128, 256), (128, 512), (256, 256), (256, 512), (256, 1024),
-          (512, 512)]
+  grid = SWEEP_FLASH_GRID
   best = {}
   for blk_q, blk_k in grid:
     name = "flash_fwd_blocks[%dx%d]" % (blk_q, blk_k)
@@ -481,7 +495,7 @@ def sweep_blocks(results):
         results.append(dict(kernel=name, ok=False, sweep=True,
                             error=repr(e)[:200]))
 
-  rows, dd, n = 16384, 768, 3072      # bench lnmm shape
+  rows, dd, n = SWEEP_MM_SHAPE
   x = jax.random.normal(jax.random.PRNGKey(8), (rows, dd), jnp.bfloat16)
   gamma = jnp.ones((dd,), jnp.float32)
   W = (jax.random.normal(jax.random.PRNGKey(9), (dd, n), jnp.bfloat16)
@@ -501,12 +515,7 @@ def sweep_blocks(results):
     return am.effective_blocks(rows, n, dd, blk_r, blk_c,
                                Wd.dtype.itemsize)
 
-  mm_grids = {
-      "ln_matmul": [(128, 256), (128, 512), (256, 512), (256, 1024),
-                    (512, 512), (512, 1536)],
-      "gelu_matmul": [(16, 128), (32, 128), (32, 192), (32, 384),
-                      (64, 128), (64, 192), (64, 256), (64, 384)],
-  }
+  mm_grids = SWEEP_MM_GRIDS
   seen = set()
   for label, fn_maker_t in (
       ("ln_matmul", lambda br, bc: jax.jit(
