@@ -32,6 +32,11 @@ if __name__ == "__main__":
   parser.add_argument("--temperature", type=float, default=0.0)
   parser.add_argument("--export_dir", default="/tmp/tos_tpu_serve_gpt")
   parser.add_argument("--executors", type=int, default=2)
+  parser.add_argument("--tensor", type=int, default=1,
+                      help="tensor-parallel degree per executor: the "
+                           "bundle carries a MeshSpec and each executor "
+                           "builds its mesh from its own devices (heads "
+                           "+ KV cache sharded, batch over data)")
   args = parser.parse_args()
 
   import numpy as np
@@ -41,13 +46,18 @@ if __name__ == "__main__":
   from tensorflowonspark_tpu.models import transformer as tfm
 
   cfg = tfm.TransformerConfig(vocab_size=256, num_layers=2, num_heads=4,
-                              d_model=128, d_ff=256, max_seq_len=64,
-                              remat=False)
+                              num_kv_heads=2, d_model=128, d_ff=256,
+                              max_seq_len=64, remat=False)
   state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+  mesh_spec = None
+  if args.tensor > 1:
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+    mesh_spec = mesh_lib.MeshSpec(data=-1, tensor=args.tensor)
   pipeline.export_bundle(
       state.params,
       tfm.make_serving_predict_fn(cfg, args.steps,
-                                  temperature=args.temperature),
+                                  temperature=args.temperature,
+                                  mesh_spec=mesh_spec),
       args.export_dir)
   print("exported bundle to", args.export_dir)
 

@@ -804,10 +804,19 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
                          jnp.zeros((batch, 1), jnp.int32),
                          decode=True))["params"]
   param_sharding = sh.param_sharding_from_boxed(abs_boxed, mesh)
-  return jax.jit(decode,
-                 in_shardings=(param_sharding, sh.batch_sharding(mesh),
-                               sh.replicated(mesh)),
-                 out_shardings=sh.replicated(mesh))
+  jitted = jax.jit(decode,
+                   in_shardings=(param_sharding, sh.batch_sharding(mesh),
+                                 sh.replicated(mesh)),
+                   out_shardings=sh.replicated(mesh))
+
+  def call(params, prompt, rng):
+    # checkpoint-restored params arrive COMMITTED to one device and jit
+    # refuses to reshard committed args — device_put places them onto the
+    # mesh (a no-op for already-placed arrays, so steady-state serving
+    # pays nothing)
+    return jitted(jax.device_put(params, param_sharding), prompt, rng)
+
+  return call
 
 
 def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
@@ -856,9 +865,14 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
   return out[:b] if pad else out
 
 
+# per-process meshes for MeshSpec-carrying serving bundles (see
+# make_serving_predict_fn._mesh — deliberately NOT closure state)
+_SERVING_MESH_CACHE = {}
+
+
 def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
                             temperature: float = 0.0, top_k: int = 0,
-                            seed: int = 0, mesh=None):
+                            seed: int = 0, mesh=None, mesh_spec=None):
   """Build a ``predict_fn(params, batch)`` for ``pipeline.export_bundle``.
 
   The batched KV-cache serving loop as a pipeline bundle: TFModel.transform
@@ -875,9 +889,34 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
   serves of the same batch) draw different streams — never the fixed-key
   repetition ``greedy_generate_kv``'s explicit-rng guard exists to
   prevent. ``mesh`` makes each serve tensor-parallel over its axes (the
-  multi-chip inference layer, reference TFModel.scala:245-292).
+  multi-chip inference layer, reference TFModel.scala:245-292). A live
+  Mesh holds PJRT device objects and cannot ride a pickled bundle — for
+  serving through ``pipeline.export_bundle`` / ``TFModel.transform`` pass
+  ``mesh_spec`` (a picklable ``parallel.mesh.MeshSpec``) instead: each
+  executor process builds the mesh from ITS visible devices on first
+  serve (the per-executor-session pattern of the reference's JVM layer).
   """
+  if mesh is not None and mesh_spec is not None:
+    raise ValueError("pass mesh OR mesh_spec, not both")
   state = {"calls": 0}
+
+  def _mesh():
+    if mesh is not None:
+      return mesh
+    if mesh_spec is None:
+      return None
+    # cache OUTSIDE the closure, reached via an IMPORT at call time: a
+    # live Mesh stashed in `state` — or in a module global this dynamic
+    # closure referenced directly, which cloudpickle serializes BY VALUE —
+    # would ride along when export_bundle pickles predict_fn and crash on
+    # the PJRT device objects the moment the fn was smoke-served first
+    import tensorflowonspark_tpu.models.transformer as _self
+    key = tuple(sorted(mesh_spec.degrees().items()))
+    m = _self._SERVING_MESH_CACHE.get(key)
+    if m is None:
+      from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+      m = _self._SERVING_MESH_CACHE[key] = mesh_lib.build_mesh(mesh_spec)
+    return m
 
   def predict_fn(params, batch):
     import zlib
@@ -894,7 +933,7 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
           state["calls"])
     out = greedy_generate_kv(params, cfg, jnp.asarray(prompts), num_steps,
                              temperature=temperature, top_k=top_k, rng=rng,
-                             mesh=mesh)
+                             mesh=_mesh())
     return {"tokens": np.asarray(out)}
 
   return predict_fn

@@ -135,6 +135,11 @@ def _pick_block(rows: int, blk_rows: int, h: int, itemsize: int = 0) -> int:
   for b in range(blk - blk % 8, 0, -8):
     if rows % b == 0:
       return b
+  # under the 8-sublane floor: snap UP to the smallest aligned divisor
+  # before resorting to one whole-dimension block
+  for b in range(8, rows, 8):
+    if rows % b == 0:
+      return b
   return rows
 
 

@@ -50,6 +50,12 @@ def _pick_col_block(n: int, blk_cols: int) -> int:
   for b in range(blk - blk % 128, 0, -128):
     if n % b == 0:
       return b
+  # requested block under the 128-lane floor (or no aligned divisor
+  # beneath it): snap UP to the smallest aligned divisor before falling
+  # back to one whole-dimension block
+  for b in range(128, n, 128):
+    if n % b == 0:
+      return b
   return n
 
 

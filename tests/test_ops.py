@@ -372,6 +372,9 @@ class TestBlockPickers:
     assert _pick_col_block(3072, 512) == 512
     assert _pick_col_block(96, 512) == 96       # < 128: full dim only
     assert _pick_col_block(1152, 512) == 384
+    # request below the lane floor snaps UP to the smallest aligned
+    # divisor, never to the whole dimension
+    assert _pick_col_block(3072, 64) == 128
 
   def test_row_picker_sublane_aligned(self):
     from tensorflowonspark_tpu.ops.layer_norm import _pick_block
@@ -379,6 +382,8 @@ class TestBlockPickers:
     assert _pick_block(96, 64, 768) == 48
     # no 8-aligned divisor (100 = 4*25): one full-dim block, never 50
     assert _pick_block(100, 64, 768) == 100
+    # sub-floor request snaps UP to 8, not to the whole dimension
+    assert _pick_block(16384, 4, 768) == 8
 
 
 class TestLNMatmul:

@@ -507,7 +507,8 @@ def run_tile_sweep_gate(json_path):
   # ONE source of truth for shapes/grids: whatever the on-chip sweep will
   # time is exactly what this gate compile-validates
   from tools.tpu_validate import (SWEEP_ATTN_SHAPE, SWEEP_FLASH_GRID,
-                                  SWEEP_MM_GRIDS, SWEEP_MM_SHAPE)
+                                  SWEEP_MM_DTYPE, SWEEP_MM_GRIDS,
+                                  SWEEP_MM_SHAPE)
   mesh = _mesh1()
   repl = _repl(mesh)
   results = []
@@ -543,8 +544,10 @@ def run_tile_sweep_gate(json_path):
   # ln_matmul / gelu_matmul grids at the sweep's bench shapes, deduped by
   # the kernels' own effective-block snap (tpu_validate.py does the same)
   rows, dd, n = SWEEP_MM_SHAPE
-  x, gamma, W = _sh(rows, dd), _sh(dd, dtype=jnp.float32), _sh(dd, n)
-  xg, Wd = _sh(rows, n), _sh(n, dd)
+  mm_dt = jnp.dtype(SWEEP_MM_DTYPE)
+  x = _sh(rows, dd, dtype=mm_dt)
+  gamma, W = _sh(dd, dtype=jnp.float32), _sh(dd, n, dtype=mm_dt)
+  xg, Wd = _sh(rows, n, dtype=mm_dt), _sh(n, dd, dtype=mm_dt)
   seen = set()
   for blk_r, blk_c in SWEEP_MM_GRIDS["ln_matmul"]:
     eff = lnmm_mod.effective_blocks(rows, dd, n, blk_r, blk_c)
@@ -556,7 +559,8 @@ def run_tile_sweep_gate(json_path):
                  x, g, w, blk_rows=br, blk_cols=bc),
                  in_shardings=(repl,) * 3), (x, gamma, W))
   for blk_r, blk_c in SWEEP_MM_GRIDS["gelu_matmul"]:
-    eff = am_mod.effective_blocks(rows, n, dd, blk_r, blk_c, 2)
+    eff = am_mod.effective_blocks(rows, n, dd, blk_r, blk_c,
+                                  mm_dt.itemsize)
     if ("gelu", eff) in seen:
       continue
     seen.add(("gelu", eff))

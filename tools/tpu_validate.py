@@ -427,6 +427,7 @@ SWEEP_ATTN_SHAPE = (2, 1024, 8, 64)          # bench-class b, s, h, d
 SWEEP_FLASH_GRID = [(128, 256), (128, 512), (256, 256), (256, 512),
                     (256, 1024), (512, 512)]
 SWEEP_MM_SHAPE = (16384, 768, 3072)          # bench rows, d_model, N
+SWEEP_MM_DTYPE = "bfloat16"                  # drives the gelu W-tile cap too
 SWEEP_MM_GRIDS = {
     "ln_matmul": [(128, 256), (128, 512), (256, 512), (256, 1024),
                   (512, 512), (512, 1536)],
@@ -496,13 +497,14 @@ def sweep_blocks(results):
                             error=repr(e)[:200]))
 
   rows, dd, n = SWEEP_MM_SHAPE
-  x = jax.random.normal(jax.random.PRNGKey(8), (rows, dd), jnp.bfloat16)
+  mm_dt = jnp.dtype(SWEEP_MM_DTYPE)
+  x = jax.random.normal(jax.random.PRNGKey(8), (rows, dd), mm_dt)
   gamma = jnp.ones((dd,), jnp.float32)
-  W = (jax.random.normal(jax.random.PRNGKey(9), (dd, n), jnp.bfloat16)
-       * 0.05).astype(jnp.bfloat16)
-  xg = jax.random.normal(jax.random.PRNGKey(10), (rows, n), jnp.bfloat16)
-  Wd = (jax.random.normal(jax.random.PRNGKey(11), (n, dd), jnp.bfloat16)
-        * 0.05).astype(jnp.bfloat16)
+  W = (jax.random.normal(jax.random.PRNGKey(9), (dd, n), mm_dt)
+       * 0.05).astype(mm_dt)
+  xg = jax.random.normal(jax.random.PRNGKey(10), (rows, n), mm_dt)
+  Wd = (jax.random.normal(jax.random.PRNGKey(11), (n, dd), mm_dt)
+        * 0.05).astype(mm_dt)
   # the kernels' OWN effective-block functions drive dedup and labels,
   # so the sweep can never name a configuration the kernel would
   # silently snap away from, and cap retunes propagate automatically.
