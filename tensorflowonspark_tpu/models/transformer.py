@@ -816,6 +816,7 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
     # pays nothing)
     return jitted(jax.device_put(params, param_sharding), prompt, rng)
 
+  call.jitted = jitted   # AOT surface (mosaic_gate lowers this directly)
   return call
 
 

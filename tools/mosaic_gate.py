@@ -303,6 +303,7 @@ def t_serving_decode():
       vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
       d_model=128, d_ff=256, max_seq_len=64, remat=False)
   fn = tfm._kv_generate_fn(cfg, 4, 16, 8, 0.0, 0, mesh)
+  fn = getattr(fn, "jitted", fn)   # the mesh path wraps jit in device_put
   model = tfm.Transformer(cfg, mesh=mesh)
   abs_params = jax.eval_shape(lambda: meta.unbox(model.init(
       jax.random.PRNGKey(0), jnp.zeros((4, 1), jnp.int32),
