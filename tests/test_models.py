@@ -262,6 +262,39 @@ class TestTransformer:
                                   mesh=mesh)
     np.testing.assert_array_equal(np.asarray(ref)[:3], np.asarray(out3))
 
+  @pytest.mark.parametrize("plen", [64, 128])
+  def test_flash_prefill_matches_dense_decode(self, plen):
+    """The serving prefill fast path is a pure substitution: the prefill
+    LOGITS through the GQA flash kernel (forced flash = interpret mode on
+    CPU) match the dense cache path within numerics (blockwise online
+    softmax reorders the sums, so exact token equality would be an
+    environment-fragile assertion on near-tied logits). plen=64 also pins
+    that forcing flash engages below 128 — _flash_eligible's own
+    divisibility rule decides, not a duplicated block constant."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    base = dict(vocab_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+                d_model=32, d_ff=64, max_seq_len=160, remat=False,
+                dtype=jnp.float32)
+    cfg_flash = tfm.TransformerConfig(attention_impl="flash", **base)
+    cfg_dense = tfm.TransformerConfig(attention_impl="dense", **base)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg_dense, seq_len=16)
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, 64, (2, plen)), jnp.int32)
+
+    def prefill_logits(cfg):
+      model = tfm.Transformer(cfg)
+      cache = jax.tree.map(
+          jnp.zeros_like,
+          model.init(jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+                     decode=True)["cache"])
+      logits, _ = model.apply({"params": state.params, "cache": cache},
+                              prompt, decode=True, mutable=["cache"])
+      return np.asarray(logits)
+
+    np.testing.assert_allclose(prefill_logits(cfg_flash),
+                               prefill_logits(cfg_dense),
+                               atol=1e-4, rtol=1e-4)
+
   def test_kv_cache_respects_max_len(self):
     from tensorflowonspark_tpu.models import transformer as tfm
     cfg = tfm.TransformerConfig(vocab_size=8, num_layers=1, num_heads=2,

@@ -451,6 +451,35 @@ def t_ring_attention_pod():
               _sh(1, 8192, 2, 128))
 
 
+def t_serving_prefill_flash():
+  """Single-chip serving with a 128-token prompt: the fresh-cache prefill
+  runs through the GQA flash kernel inside the decode program's lax.cond
+  (dense fallback branch compiled alongside)."""
+  import jax
+  import jax.numpy as jnp
+  from flax.core import meta
+  from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  # standard axis names on ONE topology device (the logical rules map
+  # heads->tensor etc.; a bare ('one',) mesh can't host those specs), and
+  # mesh.size == 1 keeps the flash prefill path enabled
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(data=1),
+      devices=list(_topology("v5e:2x2").devices)[:1])
+  cfg = tfm.TransformerConfig(
+      vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+      d_model=128, d_ff=256, max_seq_len=192, remat=False,
+      attention_impl="flash")
+  fn = tfm._kv_generate_fn(cfg, 2, 128, 8, 0.0, 0, mesh)
+  fn = getattr(fn, "jitted", fn)
+  model = tfm.Transformer(cfg, mesh=mesh)
+  abs_params = jax.eval_shape(lambda: meta.unbox(model.init(
+      jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+      decode=True)["params"]))
+  key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+  return fn, (abs_params, jax.ShapeDtypeStruct((2, 128), jnp.int32), key)
+
+
 def t_pipeline_gpipe():
   """The GPipe fill-drain forward (grad through whole-loop AD) — the
   other pipeline schedule, compiled for TPU."""
@@ -490,6 +519,7 @@ TARGETS = {
     "pipeline_1f1b": t_pipeline_1f1b,
     "pipeline_lm_flash": t_pipeline_lm_flash,
     "expert_a2a": t_expert_a2a,
+    "serving_prefill_flash": t_serving_prefill_flash,
     "pipeline_gpipe": t_pipeline_gpipe,
     "train_step_pod": t_train_step_pod,
     "ring_attention_pod": t_ring_attention_pod,
