@@ -55,8 +55,13 @@ def measure(cfg_kwargs, batch, prompt_len, steps):
   jax.block_until_ready(decode(1))
   dt_one = time.perf_counter() - t0
   if dt_full - dt_one <= 0.2 * dt_full:
-    return batch * steps / dt_full     # noise floor: conservative
-  return batch * (steps - 1) / (dt_full - dt_one)
+    tok_s = batch * steps / dt_full    # noise floor: conservative
+  else:
+    tok_s = batch * (steps - 1) / (dt_full - dt_one)
+  # decode(1) is prefill-ONLY: the prompt apply itself yields token 1 and
+  # the scan runs num_steps-1 = 0 iterations — so dt_one IS the prompt
+  # cost (the flash-prefill lever's target, transformer._decode_attend)
+  return tok_s, dt_one * 1e3
 
 
 def main():
@@ -75,11 +80,15 @@ def main():
   results = {}
   for name, kw in (("mha", {}),
                    ("gqa%d" % kv_g, {"num_kv_heads": kv_g}),
-                   ("mqa", {"num_kv_heads": 1})):
+                   ("mqa", {"num_kv_heads": 1}),
+                   # same cache layout as "mha" but prefill pinned to the
+                   # dense einsum: the delta vs "mha" (flash prefill on
+                   # chip via "auto") isolates the prefill fast path
+                   ("mha_dense_prefill", {"attention_impl": "dense"})):
     try:
-      results[name] = {
-          "decode_tok_s": round(measure(kw, args.batch, args.prompt,
-                                        args.steps), 1)}
+      tok_s, prefill_ms = measure(kw, args.batch, args.prompt, args.steps)
+      results[name] = {"decode_tok_s": round(tok_s, 1),
+                       "prefill_ms": round(prefill_ms, 2)}
     except Exception as e:  # noqa: BLE001 - record, keep measuring
       results[name] = {"error": str(e)[:200]}
     sys.stderr.write("serve %s: %r\n" % (name, results[name]))
@@ -88,7 +97,9 @@ def main():
       "batch": args.batch, "prompt": args.prompt, "steps": args.steps,
       "per_config": results,
       "note": "batched greedy KV-cache decode; GQA shrinks the cache "
-              "and its per-step HBM reads num_heads/num_kv_heads x",
+              "and its per-step HBM reads num_heads/num_kv_heads x; "
+              "prefill_ms isolates the prompt pass (flash prefill vs "
+              "the mha_dense_prefill pin)",
   }))
 
 
