@@ -414,13 +414,17 @@ class Attention(nn.Module):
     # cursor check is traced, so chunked prefill (idx > 0, where queries
     # must also see earlier cache entries) falls through to the dense
     # branch of the SAME cond and stays correct.
-    # (single-device only: under a >1-device mesh the unpartitioned
-    # pallas_call would need a shard_map wrap — GSPMD refuses to
-    # auto-partition Mosaic kernels — so tensor-parallel serving prefills
-    # through the dense einsums, which GSPMD shards fine)
+    # Under a >1-device mesh the kernel needs a shard_map wrap — GSPMD
+    # refuses to auto-partition Mosaic kernels — with query and KV heads
+    # sharded CONSISTENTLY (both over tensor, or both replicated):
+    # mismatched head layouts would break the kernel's local i//g
+    # query→KV-head mapping, so such configs prefill through the dense
+    # einsums instead.
     single = self.mesh is None or self.mesh.size == 1
+    heads_consistent = single or (
+        _heads_logical(h, self.mesh) == _heads_logical(hk, self.mesh))
     use_flash_prefill = False
-    if single and seg > 1 and cfg.attention_impl != "dense":
+    if heads_consistent and seg > 1 and cfg.attention_impl != "dense":
       ecfg = cfg
       if cfg.attention_impl == "flash" and seg % min(128, seg) != 0:
         # serving accepts arbitrary prompt lengths the caller doesn't
@@ -432,9 +436,22 @@ class Attention(nn.Module):
       from tensorflowonspark_tpu.ops import flash_attention
 
       def _flash_prefill(_):
-        return flash_attention(q, k, v, causal=True,
-                               interpret=ops.pallas_interpret()
-                               ).astype(q.dtype)
+        interp = ops.pallas_interpret()
+        if single:
+          return flash_attention(q, k, v, causal=True,
+                                 interpret=interp).astype(q.dtype)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        batch_axes = mesh_lib.data_axes(self.mesh) or None
+        t_ax = mesh_lib.AXIS_TENSOR \
+            if _heads_logical(hk, self.mesh) == "heads" else None
+        spec = P(batch_axes, None, t_ax, None)
+        fn = shard_map(
+            lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=True,
+                                               interpret=interp),
+            mesh=self.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v).astype(q.dtype)
 
       out = lax.cond(idx == 0, _flash_prefill, _dense_attend, None)
     else:

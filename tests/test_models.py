@@ -261,6 +261,37 @@ class TestTransformer:
     out3 = tfm.greedy_generate_kv(state.params, cfg, prompt[:3], 6,
                                   mesh=mesh)
     np.testing.assert_array_equal(np.asarray(ref)[:3], np.asarray(out3))
+    # forced-flash + long prompt on the mesh: the prefill kernel runs
+    # shard_mapped (heads over tensor, batch over data) and must match
+    # the dense meshed decode token-for-token at this logit scale
+    cfg_f = tfm.TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        d_model=64, d_ff=128, max_seq_len=160, remat=False,
+        dtype=jnp.float32, attention_impl="flash")
+    cfg_d = tfm.TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        d_model=64, d_ff=128, max_seq_len=160, remat=False,
+        dtype=jnp.float32, attention_impl="dense")
+    state_l = tfm.create_state(jax.random.PRNGKey(1), cfg_d, seq_len=16)
+    long_prompt = jnp.asarray(
+        np.random.RandomState(5).randint(0, 128, (4, 128)), jnp.int32)
+
+    def meshed_prefill_logits(cfg):
+      model = tfm.Transformer(cfg, mesh=mesh)
+      cache = jax.tree.map(
+          jnp.zeros_like,
+          model.init(jax.random.PRNGKey(0), jnp.zeros((4, 1), jnp.int32),
+                     decode=True)["cache"])
+      logits, _ = model.apply(
+          {"params": state_l.params, "cache": cache}, long_prompt,
+          decode=True, mutable=["cache"])
+      return np.asarray(logits)
+
+    # logits, not tokens: blockwise softmax reorders sums (near-tied
+    # argmax flips would make token equality environment-fragile)
+    np.testing.assert_allclose(meshed_prefill_logits(cfg_f),
+                               meshed_prefill_logits(cfg_d),
+                               atol=1e-4, rtol=1e-4)
 
   @pytest.mark.parametrize("plen", [64, 128])
   def test_flash_prefill_matches_dense_decode(self, plen):

@@ -452,32 +452,30 @@ def t_ring_attention_pod():
 
 
 def t_serving_prefill_flash():
-  """Single-chip serving with a 128-token prompt: the fresh-cache prefill
-  runs through the GQA flash kernel inside the decode program's lax.cond
-  (dense fallback branch compiled alongside)."""
+  """Tensor-parallel serving with a 128-token prompt: the fresh-cache
+  prefill runs through the GQA flash kernel shard_mapped over the
+  data×tensor mesh, inside the decode program's lax.cond (dense fallback
+  branch compiled alongside)."""
   import jax
   import jax.numpy as jnp
   from flax.core import meta
   from tensorflowonspark_tpu.models import transformer as tfm
   from tensorflowonspark_tpu.parallel import mesh as mesh_lib
-  # standard axis names on ONE topology device (the logical rules map
-  # heads->tensor etc.; a bare ('one',) mesh can't host those specs), and
-  # mesh.size == 1 keeps the flash prefill path enabled
   mesh = mesh_lib.build_mesh(
-      mesh_lib.MeshSpec(data=1),
-      devices=list(_topology("v5e:2x2").devices)[:1])
+      mesh_lib.MeshSpec(data=-1, tensor=2),
+      devices=list(_topology("v5e:2x2").devices))
   cfg = tfm.TransformerConfig(
       vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
       d_model=128, d_ff=256, max_seq_len=192, remat=False,
       attention_impl="flash")
-  fn = tfm._kv_generate_fn(cfg, 2, 128, 8, 0.0, 0, mesh)
+  fn = tfm._kv_generate_fn(cfg, 4, 128, 8, 0.0, 0, mesh)
   fn = getattr(fn, "jitted", fn)
   model = tfm.Transformer(cfg, mesh=mesh)
   abs_params = jax.eval_shape(lambda: meta.unbox(model.init(
-      jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+      jax.random.PRNGKey(0), jnp.zeros((4, 1), jnp.int32),
       decode=True)["params"]))
   key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-  return fn, (abs_params, jax.ShapeDtypeStruct((2, 128), jnp.int32), key)
+  return fn, (abs_params, jax.ShapeDtypeStruct((4, 128), jnp.int32), key)
 
 
 def t_pipeline_gpipe():
