@@ -326,6 +326,43 @@ class TestTransformer:
                                prefill_logits(cfg_dense),
                                atol=1e-4, rtol=1e-4)
 
+  def test_int8_kv_cache_close_and_compact(self):
+    """kv_cache_dtype='int8': the cache leaves really are int8 (the
+    serving-memory/HBM claim), decode runs end-to-end, and prefill logits
+    stay within the ~0.4%-per-entry quantization envelope of the
+    full-precision cache."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    base = dict(vocab_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+                d_model=32, d_ff=64, max_seq_len=64, remat=False,
+                dtype=jnp.float32)
+    cfg8 = tfm.TransformerConfig(kv_cache_dtype="int8", **base)
+    cfgm = tfm.TransformerConfig(**base)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfgm, seq_len=16)
+    prompt = jnp.asarray(
+        np.random.RandomState(7).randint(0, 64, (2, 16)), jnp.int32)
+
+    cache8 = tfm.Transformer(cfg8).init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+        decode=True)["cache"]
+    dtypes = {np.dtype(leaf.dtype) for leaf in jax.tree.leaves(cache8)}
+    assert np.dtype(np.int8) in dtypes       # quantized values
+    assert np.dtype(np.float32) in dtypes    # scales
+
+    def prefill_logits(cfg):
+      model = tfm.Transformer(cfg)
+      cache = jax.tree.map(
+          jnp.zeros_like,
+          model.init(jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+                     decode=True)["cache"])
+      logits, _ = model.apply({"params": state.params, "cache": cache},
+                              prompt, decode=True, mutable=["cache"])
+      return np.asarray(logits)
+
+    np.testing.assert_allclose(prefill_logits(cfg8), prefill_logits(cfgm),
+                               atol=0.15, rtol=0.15)
+    out = tfm.greedy_generate_kv(state.params, cfg8, prompt, 6)
+    assert out.shape == (2, 22)
+
   def test_kv_cache_respects_max_len(self):
     from tensorflowonspark_tpu.models import transformer as tfm
     cfg = tfm.TransformerConfig(vocab_size=8, num_layers=1, num_heads=2,

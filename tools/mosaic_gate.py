@@ -451,6 +451,32 @@ def t_ring_attention_pod():
               _sh(1, 8192, 2, 128))
 
 
+def t_serving_decode_int8():
+  """Tensor-parallel decode with the int8 KV cache (quantize on write,
+  dequant fused into the einsum reads) — the serving-memory lever
+  compiled for TPU."""
+  import jax
+  import jax.numpy as jnp
+  from flax.core import meta
+  from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(data=-1, tensor=2),
+      devices=list(_topology("v5e:2x2").devices))
+  cfg = tfm.TransformerConfig(
+      vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+      d_model=128, d_ff=256, max_seq_len=64, remat=False,
+      kv_cache_dtype="int8")
+  fn = tfm._kv_generate_fn(cfg, 4, 16, 8, 0.0, 0, mesh)
+  fn = getattr(fn, "jitted", fn)
+  model = tfm.Transformer(cfg, mesh=mesh)
+  abs_params = jax.eval_shape(lambda: meta.unbox(model.init(
+      jax.random.PRNGKey(0), jnp.zeros((4, 1), jnp.int32),
+      decode=True)["params"]))
+  key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+  return fn, (abs_params, jax.ShapeDtypeStruct((4, 16), jnp.int32), key)
+
+
 def t_serving_prefill_flash():
   """Tensor-parallel serving with a 128-token prompt: the fresh-cache
   prefill runs through the GQA flash kernel shard_mapped over the
@@ -517,6 +543,7 @@ TARGETS = {
     "pipeline_1f1b": t_pipeline_1f1b,
     "pipeline_lm_flash": t_pipeline_lm_flash,
     "expert_a2a": t_expert_a2a,
+    "serving_decode_int8": t_serving_decode_int8,
     "serving_prefill_flash": t_serving_prefill_flash,
     "pipeline_gpipe": t_pipeline_gpipe,
     "train_step_pod": t_train_step_pod,

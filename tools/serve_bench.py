@@ -81,6 +81,10 @@ def main():
   for name, kw in (("mha", {}),
                    ("gqa%d" % kv_g, {"num_kv_heads": kv_g}),
                    ("mqa", {"num_kv_heads": 1}),
+                   # int8 cache halves the per-step cache reads again on
+                   # top of GQA's grouping (decode's HBM bound)
+                   ("gqa%d_kv8" % kv_g, {"num_kv_heads": kv_g,
+                                         "kv_cache_dtype": "int8"}),
                    # same cache layout as "mha" but prefill pinned to the
                    # dense einsum: the delta vs "mha" (flash prefill on
                    # chip via "auto") isolates the prefill fast path
