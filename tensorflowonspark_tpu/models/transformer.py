@@ -93,7 +93,9 @@ class TransformerConfig:
   # the cache every step, so halving its bytes (vs bf16) is a direct
   # decode-throughput lever at ~0.4% per-entry quantization error. The
   # flash prefill is unaffected (it attends the raw projections); the
-  # dense paths dequantize inside the fused einsum reads.
+  # dense paths apply the scales to k-indexed tensors (scores/probs), so
+  # no dequantized cache-sized copy exists in the program — asserted on
+  # compiled TPU HLO (tests/test_mosaic_gate.py).
   kv_cache_dtype: str = "model"
   # "gather": table lookup with the embed dim explicitly replicated first,
   # so SPMD slices the gather result instead of involuntarily rematerializing
@@ -426,26 +428,31 @@ class Attention(nn.Module):
 
     scale = 1.0 / (d ** 0.5)
 
-    def _cache_f32():
-      kf = cached_k.value.astype(jnp.float32)
-      vf = cached_v.value.astype(jnp.float32)
-      if quant:
-        # dequant fuses into the einsum reads — the HBM traffic stays int8
-        kf = kf * k_scale.value[..., None]
-        vf = vf * v_scale.value[..., None]
-      return kf, vf
-
     def _dense_attend(_):
       # q regrouped [b, seg, kv_head, group, d]: query head i = KV head
-      # i//g; attends the whole cache with the causal+unwritten mask
-      kf, vf = _cache_f32()
+      # i//g; attends the whole cache with the causal+unwritten mask.
+      # int8 cache: the scales apply to K-INDEXED tensors — scores
+      # (sum_d q·k8·s[k] = (sum_d q·k8)·s[k]) and probs (folding v's
+      # scale) — so no dequantized cache-sized f32 tensor exists in the
+      # program AT ALL; the dots consume the int8 values via a bare
+      # convert (the per-step HBM traffic is the int8 bytes by
+      # construction, not by hoping a broadcast-multiply fuses)
+      kf = cached_k.value.astype(jnp.float32)
+      vf = cached_v.value.astype(jnp.float32)
       qg = q.reshape(b, seg, hk, h // hk, d).astype(jnp.float32)
       scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf) * scale
+      if quant:
+        # [b, max, hk] -> [b, hk, 1, 1, max] over the scores' k dim
+        ks5 = k_scale.value.transpose(0, 2, 1)[:, :, None, None, :]
+        scores = scores * ks5
       q_pos = idx + jnp.arange(seg)[:, None]          # [seg, 1]
       k_pos = jnp.arange(cfg.max_seq_len)[None, :]    # [1, max]
       mask = (k_pos <= q_pos)[None, None, None]       # causal + unwritten
       scores = jnp.where(mask, scores, -1e30)
       probs = jax.nn.softmax(scores, axis=-1)
+      if quant:
+        vs5 = v_scale.value.transpose(0, 2, 1)[:, :, None, None, :]
+        probs = probs * vs5
       o = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
       return o.reshape(b, seg, h, d).astype(q.dtype)
 

@@ -67,6 +67,25 @@ def test_gate_red_on_mosaic_invalid_kernel():
     f.lower(x).compile()
 
 
+def test_int8_cache_never_materializes_f32(monkeypatch):
+  """The int8 KV cache's HBM claim, checked on COMPILED TPU HLO: scales
+  apply to k-indexed tensors (scores/probs), so the only cache-shaped
+  producers are bare converts fused into the dots — no top-level
+  (materialized) f32 buffer of the cache shape may exist, else decode
+  would write+reread a dequantized copy and invert the feature."""
+  import re
+  _topology_or_skip()
+  monkeypatch.setenv("TOS_PALLAS_INTERPRET", "0")
+  from tools.mosaic_gate import TARGETS
+  fn, args = TARGETS["serving_decode_int8"]()
+  hlo = fn.lower(*args).compile().as_text()
+  top_level = [l for l in hlo.splitlines() if not l.startswith("    ")]
+  # per-shard cache: [b=4, max_seq=64, hk/t in {1,2}, d=64]
+  bad = [l for l in top_level if re.search(r"f32\[4,64,[12],64\]", l)]
+  assert not bad, "materialized f32 cache copies:\n" + "\n".join(bad[:4])
+  assert re.search(r"s8\[4,64,[12],64\]", hlo)   # the cache IS int8
+
+
 def test_gate_full_train_step_compiles(monkeypatch):
   """The dryrun-config 8-chip fused training step (ring + GQA flash +
   ln_matmul_sharded + act fusion + remat) Mosaic-compiles on a v5e:2x4
