@@ -79,11 +79,12 @@ def test_int8_cache_never_materializes_f32(monkeypatch):
   from tools.mosaic_gate import TARGETS
   fn, args = TARGETS["serving_decode_int8"]()
   hlo = fn.lower(*args).compile().as_text()
-  top_level = [l for l in hlo.splitlines() if not l.startswith("    ")]
-  # per-shard cache: [b=4, max_seq=64, hk/t in {1,2}, d=64]
-  bad = [l for l in top_level if re.search(r"f32\[4,64,[12],64\]", l)]
-  assert not bad, "materialized f32 cache copies:\n" + "\n".join(bad[:4])
-  assert re.search(r"s8\[4,64,[12],64\]", hlo)   # the cache IS int8
+  # per-shard cache shape for the target's config: batch 4 over data=2,
+  # max_seq 64, kv_heads 2 over tensor=2, head_dim 128/4 = 32
+  cache_shape = "2,64,1,32"
+  bad = [l for l in hlo.splitlines() if "f32[%s]" % cache_shape in l]
+  assert not bad, "dequantized f32 cache tensors:\n" + "\n".join(bad[:4])
+  assert re.search(r"s8\[%s\]" % cache_shape, hlo)   # the cache IS int8
 
 
 def test_gate_full_train_step_compiles(monkeypatch):
