@@ -872,13 +872,7 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
   model = Transformer(cfg, mesh=mesh)
 
   def decode(params, prompt, rng):
-    # init runs the decode path on a dummy token (advancing the cursor and
-    # writing a key); zero the tree so decoding starts from a clean cache
-    cache = jax.tree.map(
-        jnp.zeros_like,
-        model.init(jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32),
-                   decode=True)["cache"])
-    variables = {"params": params, "cache": cache}
+    variables = {"params": params, "cache": _zero_cache(model, batch)}
     logits, mutated = model.apply(variables, prompt, decode=True,
                                   mutable=["cache"])
     rng, sub = jax.random.split(rng)
@@ -968,6 +962,149 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
                         int(top_k), mesh)(params,
                                           prompt.astype(jnp.int32), rng)
   return out[:b] if pad else out
+
+
+def _zero_cache(model, batch: int):
+  """A fresh all-zeros decode cache for ``model`` (init runs the decode
+  path on a dummy token; zeroing resets its cursor advance)."""
+  return jax.tree.map(
+      jnp.zeros_like,
+      model.init(jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32),
+                 decode=True)["cache"])
+
+
+def _set_cache_cursor(cache, value):
+  """Rewind every layer's decode cursor (the ``index`` cache leaves).
+
+  Speculative rollback needs nothing else: entries past the cursor are
+  never attended (the causal+unwritten mask) and the next write
+  overwrites them, so rejected drafts cost a cursor assignment, not a
+  cache restore."""
+  from jax.tree_util import tree_map_with_path
+
+  def f(path, leaf):
+    if path and getattr(path[-1], "key", None) == "index":
+      return jnp.asarray(value, leaf.dtype)
+    return leaf
+
+  return tree_map_with_path(f, cache)
+
+
+@functools.lru_cache(maxsize=4)
+def _spec_generate_fn(draft_cfg: TransformerConfig, cfg: TransformerConfig,
+                      batch: int, plen: int, num_steps: int, k: int,
+                      mesh=None):
+  """Cached jitted greedy speculative decode (see
+  :func:`speculative_generate_kv`). ``mesh`` (single-device) only binds
+  the jit to a device for AOT lowering — the deviceless gate's surface."""
+  draft = Transformer(draft_cfg)
+  target = Transformer(cfg)
+
+  def decode(draft_params, params, prompt):
+    cache_d = _zero_cache(draft, batch)
+    cache_t = _zero_cache(target, batch)
+    # prefill both; the TARGET's argmax after the prompt is token 1
+    logits_t, mut_t = target.apply({"params": params, "cache": cache_t},
+                                   prompt, decode=True, mutable=["cache"])
+    _, mut_d = draft.apply({"params": draft_params, "cache": cache_d},
+                           prompt, decode=True, mutable=["cache"])
+    cache_t, cache_d = mut_t["cache"], mut_d["cache"]
+    g1 = jnp.argmax(logits_t[:, -1], -1).astype(jnp.int32)
+
+    total = plen + num_steps + k + 1   # slack: a round may overshoot
+    buf = jnp.zeros((batch, total), jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt.astype(jnp.int32), (0, 0))
+    buf = lax.dynamic_update_slice(buf, g1[:, None], (0, plen))
+
+    def cond(carry):
+      return carry[1] < num_steps
+
+    def body(carry):
+      buf, n_gen, last, cache_t, cache_d = carry
+      # both cursors sit at plen + n_gen - 1 (tokens CONSUMED so far)
+
+      def dscan(c, _):
+        cache, tok = c
+        lg, mu = draft.apply({"params": draft_params, "cache": cache},
+                             tok[:, None], decode=True, mutable=["cache"])
+        nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+        return (mu["cache"], nxt), nxt
+
+      (cache_d, _), P = lax.scan(dscan, (cache_d, last), None, length=k)
+      P = P.T                                          # [b, k] proposals
+
+      # ONE target pass scores all k proposals: inputs [last, p1..p_{k-1}],
+      # logits[:, j] is the target's prediction AFTER input j
+      V = jnp.concatenate([last[:, None], P[:, :k - 1]], axis=1)
+      lg_t, mut_t = target.apply({"params": params, "cache": cache_t}, V,
+                                 decode=True, mutable=["cache"])
+      cache_t = mut_t["cache"]
+      T = jnp.argmax(lg_t, -1).astype(jnp.int32)       # [b, k]
+
+      # longest agreeing prefix; min over rows keeps the batch in
+      # lockstep (rows that accepted more get exactly those tokens back
+      # as the bonus — still the target's greedy output)
+      ok = (P == T).astype(jnp.int32)
+      m = jnp.min(jnp.sum(jnp.cumprod(ok, axis=1), axis=1))
+      bonus = lax.dynamic_index_in_dim(T, jnp.minimum(m, k - 1), 1,
+                                       keepdims=True)  # [b, 1]
+      emit = jnp.concatenate([P, jnp.zeros((batch, 1), jnp.int32)], axis=1)
+      emit = lax.dynamic_update_slice(emit, bonus, (0, jnp.minimum(m, k)))
+      buf = lax.dynamic_update_slice(buf, emit, (0, plen + n_gen))
+
+      adv = jnp.where(m < k, m + 1, k)       # accepted + bonus
+      new_last = jnp.where(m < k, bonus[:, 0], P[:, k - 1])
+      new_cursor = plen + n_gen + adv - 1
+      return (buf, n_gen + adv, new_last,
+              _set_cache_cursor(cache_t, new_cursor),
+              _set_cache_cursor(cache_d, new_cursor))
+
+    buf, _, _, _, _ = lax.while_loop(
+        cond, body, (buf, jnp.asarray(1, jnp.int32), g1, cache_t, cache_d))
+    return buf[:, :plen + num_steps]
+
+  if mesh is None:
+    return jax.jit(decode)
+  from tensorflowonspark_tpu.parallel import sharding as sh
+  r = sh.replicated(mesh)
+  return jax.jit(decode, in_shardings=(r, r, r), out_shardings=r)
+
+
+def speculative_generate_kv(draft_params, draft_cfg: TransformerConfig,
+                            params, cfg: TransformerConfig, prompt,
+                            num_steps: int, draft_k: int = 4):
+  """Greedy speculative decoding: a cheap DRAFT model proposes
+  ``draft_k`` tokens per round and the target verifies them in ONE
+  batched decode pass — the target runs ~num_steps/(accepted+1) forward
+  passes instead of num_steps, and the output is EXACTLY the target's
+  own greedy decode (greedy acceptance is lossless; pinned by test).
+
+  Rollback is free by design: rejected draft entries sit past the
+  rewound cache cursor, masked from attention and overwritten by the
+  next round (:func:`_set_cache_cursor`). Batched rows accept the
+  row-wise MINIMUM prefix each round (lockstep cursors); rows that
+  agreed further simply receive those same tokens via the bonus path.
+
+  Both configs must share a vocabulary; requires
+  ``prompt_len + num_steps + draft_k <= max_seq_len`` on both models
+  (a round's draft writes may transiently overshoot the kept output).
+  """
+  if draft_cfg.vocab_size != cfg.vocab_size:
+    raise ValueError("draft and target must share a vocabulary (%d vs %d)"
+                     % (draft_cfg.vocab_size, cfg.vocab_size))
+  if draft_k < 1:
+    raise ValueError("draft_k must be >= 1, got %d" % draft_k)
+  b, plen = prompt.shape
+  need = plen + num_steps + draft_k
+  for name, c in (("draft", draft_cfg), ("target", cfg)):
+    if need > c.max_seq_len:
+      raise ValueError(
+          "speculative decode needs %d cache slots (prompt %d + steps %d "
+          "+ draft_k %d) but the %s max_seq_len is %d"
+          % (need, plen, num_steps, draft_k, name, c.max_seq_len))
+  return _spec_generate_fn(draft_cfg, cfg, b, plen, num_steps,
+                           int(draft_k))(draft_params, params,
+                                         prompt.astype(jnp.int32))
 
 
 # per-process meshes for MeshSpec-carrying serving bundles (see

@@ -326,6 +326,32 @@ class TestTransformer:
                                prefill_logits(cfg_dense),
                                atol=1e-4, rtol=1e-4)
 
+  def test_speculative_decode_exactly_greedy(self):
+    """Greedy speculative decoding is LOSSLESS: whatever the draft
+    proposes, the emitted tokens are exactly the target's own greedy
+    decode — checked with (a) the target as its own draft (full
+    acceptance every round) and (b) an unrelated random draft (mostly
+    rejections, exercising the bonus-token and rollback paths)."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    base = dict(vocab_size=32, num_layers=2, num_heads=2, d_model=32,
+                d_ff=64, max_seq_len=64, remat=False, dtype=jnp.float32)
+    cfg = tfm.TransformerConfig(**base)
+    dcfg = tfm.TransformerConfig(**{**base, "num_layers": 1})
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=8)
+    draft_other = tfm.create_state(jax.random.PRNGKey(9), dcfg, seq_len=8)
+    prompt = jnp.asarray(
+        np.random.RandomState(2).randint(0, 32, (2, 8)), jnp.int32)
+    ref = np.asarray(tfm.greedy_generate_kv(state.params, cfg, prompt, 12))
+
+    self_spec = tfm.speculative_generate_kv(
+        state.params, cfg, state.params, cfg, prompt, 12, draft_k=4)
+    np.testing.assert_array_equal(np.asarray(self_spec), ref)
+
+    cross_spec = tfm.speculative_generate_kv(
+        draft_other.params, dcfg, state.params, cfg, prompt, 12,
+        draft_k=3)
+    np.testing.assert_array_equal(np.asarray(cross_spec), ref)
+
   def test_int8_kv_cache_close_and_compact(self):
     """kv_cache_dtype='int8': the cache leaves really are int8 (the
     serving-memory/HBM claim), decode runs end-to-end, and prefill logits

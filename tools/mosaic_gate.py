@@ -477,6 +477,33 @@ def t_serving_decode_int8():
   return fn, (abs_params, jax.ShapeDtypeStruct((4, 16), jnp.int32), key)
 
 
+def t_serving_speculative():
+  """Greedy speculative decode — draft scan + batched target verify +
+  cursor-rewind rollback inside a while_loop, two KV caches in the
+  carry — compiled for TPU on one topology device."""
+  import jax
+  import jax.numpy as jnp
+  from flax.core import meta
+  from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(data=1),
+      devices=list(_topology("v5e:2x2").devices)[:1])
+  base = dict(vocab_size=256, num_heads=4, num_kv_heads=2, d_model=128,
+              d_ff=256, max_seq_len=64, remat=False)
+  cfg = tfm.TransformerConfig(num_layers=2, **base)
+  dcfg = tfm.TransformerConfig(num_layers=1, **base)
+  fn = tfm._spec_generate_fn(dcfg, cfg, 2, 16, 16, 4, mesh)
+
+  def abs_params(c):
+    return jax.eval_shape(lambda: meta.unbox(tfm.Transformer(c).init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+        decode=True)["params"]))
+
+  return fn, (abs_params(dcfg), abs_params(cfg),
+              jax.ShapeDtypeStruct((2, 16), jnp.int32))
+
+
 def t_serving_prefill_flash():
   """Tensor-parallel serving with a 128-token prompt: the fresh-cache
   prefill runs through the GQA flash kernel shard_mapped over the
@@ -544,6 +571,7 @@ TARGETS = {
     "pipeline_lm_flash": t_pipeline_lm_flash,
     "expert_a2a": t_expert_a2a,
     "serving_decode_int8": t_serving_decode_int8,
+    "serving_speculative": t_serving_speculative,
     "serving_prefill_flash": t_serving_prefill_flash,
     "pipeline_gpipe": t_pipeline_gpipe,
     "train_step_pod": t_train_step_pod,
