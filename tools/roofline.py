@@ -159,6 +159,67 @@ def analyze(cfg, gen, hbm_gbps):
   }
 
 
+def serving_analyze(gen, hbm_gbps, batch, context, kv_heads, cache_bytes):
+  """Decode-step roofline: one token per sequence per step.
+
+  Traffic per step = ONE full weight read (shared across the batch —
+  the dominant term at small batch/context) + the per-sequence KV-cache
+  read (B × C × hk × d × 2 arrays; the term GQA divides by H/hk and
+  int8 halves vs bf16, plus its C×hk f32 scales). FLOPs per step =
+  2N per token + the attention dots (4·C·D per token per layer at full
+  query-head compute — grouping shrinks cache BYTES, not FLOPs).
+  """
+  from tensorflowonspark_tpu.utils import profiler
+  head_d = D // H
+  N = n_params(kv_heads)
+  weight_bytes = N * BF16                      # serving weights in bf16
+  cache_bytes_step = batch * context * kv_heads * head_d * 2 * cache_bytes
+  if cache_bytes < BF16:                       # int8: + per-token scales
+    cache_bytes_step += batch * context * kv_heads * 2 * F32
+  fl = batch * (2 * N + 4 * context * D * L)
+  peak = profiler.PEAK_BF16_FLOPS[gen]
+  t_comp = fl / peak
+  t_mem = (weight_bytes + cache_bytes_step) / (hbm_gbps * 1e9)
+  t = max(t_comp, t_mem)
+  # context where the cache read overtakes the weight read — below it,
+  # shrinking the cache cannot move the ceiling
+  c_star = weight_bytes / (batch * kv_heads * head_d * 2 * cache_bytes)
+  return {
+      "weight_mb_per_step": round(weight_bytes / 1e6, 1),
+      "cache_mb_per_step": round(cache_bytes_step / 1e6, 1),
+      "bound": "memory" if t_mem > t_comp else "compute",
+      "decode_tok_s_ceiling": round(batch / t, 1),
+      "context_crossover": int(c_star),
+  }
+
+
+SERVING_CONFIGS = [
+    ("mha_bf16", H, 2), ("gqa4_bf16", 4, 2), ("mqa_bf16", 1, 2),
+    ("mha_int8", H, 1), ("gqa4_int8", 4, 1), ("mqa_int8", 1, 1),
+]
+
+
+def serving_main(args, hbm):
+  rows = []
+  for name, kv, cb in SERVING_CONFIGS:
+    r = serving_analyze(args.gen, hbm, args.batch, args.context, kv, cb)
+    r["config"] = name
+    rows.append(r)
+    print(json.dumps(r))
+  sys.stderr.write(
+      "\nDecode ceilings @ batch=%d context=%d (%s): per-step traffic = "
+      "one weight read + the KV-cache read; below context~crossover the "
+      "weight read dominates and cache levers cannot move the ceiling\n"
+      "| config | weights MB | cache MB | bound | tok/s ceiling | "
+      "crossover C |\n|---|---|---|---|---|---|\n"
+      % (args.batch, args.context, args.gen))
+  for r in rows:
+    sys.stderr.write("| %s | %.0f | %.1f | %s | %.0f | %d |\n"
+                     % (r["config"], r["weight_mb_per_step"],
+                        r["cache_mb_per_step"], r["bound"],
+                        r["decode_tok_s_ceiling"], r["context_crossover"]))
+
+
 CONFIGS = [
     ("base", {}),
     ("lnmm_fuseqkv", {"ln_matmul_impl": "fused", "fuse_qkv": True}),
@@ -177,8 +238,15 @@ def main():
   ap = argparse.ArgumentParser()
   ap.add_argument("--gen", default="v5e", choices=sorted(HBM_GBPS))
   ap.add_argument("--hbm-gbps", type=float, default=None)
+  ap.add_argument("--serving", action="store_true",
+                  help="decode-step ceilings (weight read vs KV-cache "
+                       "read) instead of the training-step analysis")
+  ap.add_argument("--batch", type=int, default=8)
+  ap.add_argument("--context", type=int, default=2048)
   args = ap.parse_args()
   hbm = args.hbm_gbps or HBM_GBPS[args.gen]
+  if args.serving:
+    return serving_main(args, hbm)
 
   rows = []
   for name, cfg in CONFIGS:
