@@ -23,6 +23,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench as _bench  # noqa: E402 - bench model shape, one source
 
 
+def measure_speculative(batch, prompt_len, steps, k=4):
+  """Self-draft speculative decode (draft == target): acceptance is 100%,
+  so the rate isolates the MECHANISM's cost — k draft steps + one
+  k-token verify per k emitted tokens vs k sequential target steps. With
+  a real (cheaper) draft the chip-side speedup scales from here by
+  t_draft/t_target; with a self-draft the useful signal is how close the
+  verify pass is to one step (batched positions amortize the weight
+  read)."""
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+
+  cfg = tfm.TransformerConfig(
+      vocab_size=_bench.TFM_VOCAB, num_layers=_bench.TFM_LAYERS,
+      num_heads=_bench.TFM_HEADS, d_model=_bench.TFM_DMODEL,
+      d_ff=_bench.TFM_DFF, max_seq_len=prompt_len + steps + k,
+      remat=False)
+  state = tfm.create_state(jax.random.PRNGKey(0), cfg,
+                           seq_len=prompt_len + steps)
+  rng = np.random.RandomState(0)
+  prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt_len)),
+                       jnp.int32)
+
+  def run():
+    return tfm.speculative_generate_kv(state.params, cfg, state.params,
+                                       cfg, prompt, steps, draft_k=k)
+
+  jax.block_until_ready(run())
+  t0 = time.perf_counter()
+  jax.block_until_ready(run())
+  return batch * steps / (time.perf_counter() - t0)
+
+
 def measure(cfg_kwargs, batch, prompt_len, steps):
   import numpy as np
   import jax
@@ -96,6 +130,13 @@ def main():
     except Exception as e:  # noqa: BLE001 - record, keep measuring
       results[name] = {"error": str(e)[:200]}
     sys.stderr.write("serve %s: %r\n" % (name, results[name]))
+  try:
+    results["spec_self_k4"] = {
+        "decode_tok_s": round(
+            measure_speculative(args.batch, args.prompt, args.steps), 1)}
+  except Exception as e:  # noqa: BLE001
+    results["spec_self_k4"] = {"error": str(e)[:200]}
+  sys.stderr.write("serve spec_self_k4: %r\n" % (results["spec_self_k4"],))
   print(json.dumps({
       "metric": "kv_decode_tokens_per_sec",
       "batch": args.batch, "prompt": args.prompt, "steps": args.steps,
