@@ -352,6 +352,16 @@ class TestTransformer:
         draft_k=3)
     np.testing.assert_array_equal(np.asarray(cross_spec), ref)
 
+    # composes with the int8 cache: exactness is vs the int8-cache
+    # greedy (quantization shifts logits identically in both paths)
+    cfg8 = tfm.TransformerConfig(kv_cache_dtype="int8", **base)
+    ref8 = np.asarray(
+        tfm.greedy_generate_kv(state.params, cfg8, prompt, 12))
+    spec8 = tfm.speculative_generate_kv(
+        draft_other.params, dcfg, state.params, cfg8, prompt, 12,
+        draft_k=3)
+    np.testing.assert_array_equal(np.asarray(spec8), ref8)
+
   def test_int8_kv_cache_close_and_compact(self):
     """kv_cache_dtype='int8': the cache leaves really are int8 (the
     serving-memory/HBM claim), decode runs end-to-end, and prefill logits

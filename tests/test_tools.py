@@ -1,0 +1,35 @@
+"""Smoke tests for the analytic tools (no hardware, no heavy compute)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestServingRoofline:
+  def test_ceiling_ordering_and_crossover(self):
+    """Decode ceilings must rise monotonically as the cache shrinks
+    (mha -> gqa -> mqa, bf16 -> int8) and the context crossover must
+    scale inversely with per-step cache bytes."""
+    from tools import roofline as rl
+    rows = {name: rl.serving_analyze("v5e", 819.0, 8, 2048, kv, cb)
+            for name, kv, cb in rl.SERVING_CONFIGS}
+    assert (rows["mha_bf16"]["decode_tok_s_ceiling"]
+            < rows["gqa4_bf16"]["decode_tok_s_ceiling"]
+            < rows["mqa_bf16"]["decode_tok_s_ceiling"])
+    assert (rows["mha_bf16"]["decode_tok_s_ceiling"]
+            < rows["mha_int8"]["decode_tok_s_ceiling"])
+    # int8 halves per-entry cache bytes -> roughly doubles the crossover
+    ratio = (rows["mha_int8"]["context_crossover"]
+             / rows["mha_bf16"]["context_crossover"])
+    assert 1.8 < ratio < 2.2
+    # at long context the cache dominates and grouping wins big
+    long_mha = rl.serving_analyze("v5e", 819.0, 16, 32768, 12, 2)
+    long_gqa8 = rl.serving_analyze("v5e", 819.0, 16, 32768, 4, 1)
+    assert (long_gqa8["decode_tok_s_ceiling"]
+            > 2.5 * long_mha["decode_tok_s_ceiling"])
+
+  def test_training_analysis_still_runs(self):
+    from tools import roofline as rl
+    r = rl.analyze({}, "v5e", 819.0)
+    assert r["flops_per_step"] > 0 and 0 < r["mfu_serial"] <= 1
