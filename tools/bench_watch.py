@@ -180,8 +180,10 @@ def capture():
     except ValueError:
       results["feed"] = {"rc": rc, "raw": tail[:300]}
 
+  # round 5 grew serve_bench to six configs (+ the speculative row), each
+  # with two compile shapes — give the compiles room on first contact
   rc, tail = _run_step(
-      "serve", [sys.executable, "tools/serve_bench.py"], 900,
+      "serve", [sys.executable, "tools/serve_bench.py"], 1800,
       os.path.join(ART, "serve.json"))
   try:
     results["serve"] = json.loads(tail)
