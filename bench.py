@@ -414,9 +414,28 @@ def main():
       extra = {"transformer_error": str(e2)[:300],
                "transformer_fused_error": str(e)[:300]}
     _PARTIAL["extra"] = extra   # fallback numbers survive a watchdog fire
+  budget = int(os.environ.get("TOS_BENCH_TIMEOUT", "600"))
+  # the fused-kernel config (every Pallas lever on — deviceless-gate-
+  # proven to compile, SWEEP_COMPILE.json) measured alongside the base
+  # config when there's headroom: if the one chip window of the round is
+  # the driver's own bench run, the fusion question still gets answered
+  # by measurement instead of a blind default flip
+  if (_time.time() - t_start < budget - 300
+      and "transformer_tokens_per_sec" in extra):
+    try:
+      fused = _bench_transformer(ln_matmul_impl="fused", fuse_qkv=True,
+                                 act_matmul_impl="fused")
+      extra["transformer_allfused_tokens_per_sec"] = \
+          fused["transformer_tokens_per_sec"]
+      extra["transformer_allfused_mfu"] = fused["transformer_mfu"]
+      extra["transformer_best_config"] = (
+          "allfused" if fused["transformer_mfu"] > extra["transformer_mfu"]
+          else "base")
+      _PARTIAL["extra"] = extra
+    except Exception as e:  # noqa: BLE001 - optional extra measurement
+      extra["transformer_allfused_error"] = str(e)[:300]
   # optional extra metric — only if there's comfortable headroom before
   # the watchdog would fire and discard the numbers already in hand
-  budget = int(os.environ.get("TOS_BENCH_TIMEOUT", "600"))
   if _time.time() - t_start < budget - 240:
     try:
       extra.update(_bench_long_context())
