@@ -95,17 +95,32 @@ def _run_step(name, cmd, timeout_s, out_path, env_extra=None):
   return rc, out.strip().splitlines()[-1] if out.strip() else ""
 
 
+# every capture step shares one persistent XLA compilation cache: a claim
+# window that dies mid-step banks each executable as it finishes compiling,
+# and the next window resumes from the bank (round-5: a single ResNet-50
+# compile ate an entire ~10-minute window and the watchdog fired at 600s
+# with nothing to show)
+_CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": os.path.join(ART, "xla_cache"),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+}
+
+
 def capture():
   """Run the measurement stack. Returns the bench value (0.0 on failure)."""
   os.makedirs(ART, exist_ok=True)
   results = {}
 
   # chip just answered a probe: a short preflight is enough, and the main
-  # budget goes to measuring
+  # budget goes to measuring. The 1200s measurement watchdog (vs the 600s
+  # default) covers a cold-bank compile of both models through the tunnel.
   rc, tail = _run_step(
-      "bench", [sys.executable, "bench.py"], 1100,
+      "bench", [sys.executable, "bench.py"], 1700,
       os.path.join(ART, "bench.json"),
-      env_extra={"TOS_BENCH_PREFLIGHT_BUDGET": "300"})
+      env_extra=dict(_CACHE_ENV,
+                     TOS_BENCH_PREFLIGHT_BUDGET="300",
+                     TOS_BENCH_TIMEOUT="1200"))
   value = 0.0
   try:
     parsed = json.loads(tail)
@@ -124,8 +139,9 @@ def capture():
   rc, tail = _run_step(
       "sweep", [sys.executable, "bench.py"], 3900,
       os.path.join(ART, "sweep.json"),
-      env_extra={"TOS_BENCH_SWEEP": "1", "TOS_BENCH_TIMEOUT": "3600",
-                 "TOS_BENCH_PREFLIGHT_BUDGET": "300"})
+      env_extra=dict(_CACHE_ENV, TOS_BENCH_SWEEP="1",
+                     TOS_BENCH_TIMEOUT="3600",
+                     TOS_BENCH_PREFLIGHT_BUDGET="300"))
   try:
     results["sweep"] = json.loads(tail)
   except ValueError:
@@ -137,7 +153,7 @@ def capture():
   rc, tail = _run_step(
       "kernels", [sys.executable, "tools/tpu_validate.py",
                   "--json", kernels_path], 3600,
-      os.path.join(ART, "kernels.stdout"))
+      os.path.join(ART, "kernels.stdout"), env_extra=_CACHE_ENV)
   results["kernels_rc"] = rc
   try:
     with open(kernels_path) as f:
@@ -155,7 +171,7 @@ def capture():
 
   rc, tail = _run_step(
       "profile", [sys.executable, "tools/profile_step.py"], 1200,
-      os.path.join(ART, "profile.txt"))
+      os.path.join(ART, "profile.txt"), env_extra=_CACHE_ENV)
   results["profile_rc"] = rc
 
   # kernel tile auto-tuning, separate from the core matrix so a slow
@@ -167,14 +183,14 @@ def capture():
   rc, tail = _run_step(
       "blocks", [sys.executable, "tools/tpu_validate.py", "--sweep-only",
                  "--json", blocks_path], 2400,
-      os.path.join(ART, "blocks.stdout"))
+      os.path.join(ART, "blocks.stdout"), env_extra=_CACHE_ENV)
   results["blocks_rc"] = rc
 
   feed_bench = os.path.join(REPO, "tools", "feed_bench.py")
   if os.path.exists(feed_bench):
     rc, tail = _run_step(
         "feed", [sys.executable, feed_bench], 1200,
-        os.path.join(ART, "feed.json"))
+        os.path.join(ART, "feed.json"), env_extra=_CACHE_ENV)
     try:
       results["feed"] = json.loads(tail)
     except ValueError:
@@ -184,7 +200,7 @@ def capture():
   # with two compile shapes — give the compiles room on first contact
   rc, tail = _run_step(
       "serve", [sys.executable, "tools/serve_bench.py"], 1800,
-      os.path.join(ART, "serve.json"))
+      os.path.join(ART, "serve.json"), env_extra=_CACHE_ENV)
   try:
     results["serve"] = json.loads(tail)
   except ValueError:
@@ -207,11 +223,15 @@ def _append_notes(results, complete):
 
 def main():
   ap = argparse.ArgumentParser()
-  ap.add_argument("--interval", type=int, default=600,
-                  help="seconds between probes")
+  ap.add_argument("--interval", type=int, default=150,
+                  help="seconds between probes (round-5 finding: claim "
+                       "windows can be ~10 minutes long between multi-hour "
+                       "outages — a 600s cadence can sleep through one)")
   ap.add_argument("--probe-timeout", type=int, default=150,
-                  help="per-probe jax.devices() timeout (claim takes ~110s "
-                       "when the service is healthy)")
+                  help="per-probe jax.devices() timeout (healthy claims "
+                       "observed at 3-110s and occasionally longer — the "
+                       "timeout must cover the slow end or a live window "
+                       "gets logged as down)")
   ap.add_argument("--once", action="store_true")
   args = ap.parse_args()
 
