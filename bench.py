@@ -78,7 +78,7 @@ if os.environ.get("TOS_BENCH_SMOKE"):
   TFM_MEASURE = 3
 
 
-def _steps_per_sec(step_fn, state, args, k, label):
+def _steps_per_sec(step_fn, state, args, k, label, on_provisional=None):
   """Per-step time via a lax.scan-chained K-step dispatch.
 
   On the tunneled axon device, per-step host loops mis-measure in both
@@ -123,24 +123,36 @@ def _steps_per_sec(step_fn, state, args, k, label):
   t_exec = _time.time()
   _, loss = c1(state)
   first_loss = float(loss)   # full fetch = real sync
+  t_c1 = _time.time() - t_exec
+  if on_provisional is not None:
+    # the 1-step executable alone already yields a real (RPC-floor-
+    # dominated, so conservative) steps/sec — bank it NOW so a watchdog
+    # fire later in the measurement still reports throughput > 0
+    t_p = _time.time()
+    _, loss = c1(state)
+    float(loss)
+    dt_p = _time.time() - t_p
+    on_provisional(1.0 / max(dt_p, 1e-9))
+    sys.stderr.write("%s provisional dispatch %.1fs\n" % (label, dt_p))
+  t_ck = _time.time()
   _, loss = ck(state)
   float(loss)
-  sys.stderr.write("%s first dispatch (1+%d-step) %.1fs loss=%.3f\n"
-                   % (label, k, _time.time() - t_exec, first_loss))
+  sys.stderr.write("%s first dispatch (1-step %.1fs + %d-step %.1fs) "
+                   "loss=%.3f\n"
+                   % (label, t_c1, k, _time.time() - t_ck, first_loss))
   sys.stderr.flush()
-  multi = lambda st, kk: (c1 if kk == 1 else ck)(st)   # noqa: E731
 
-  def _timed(kk):
+  def _timed(c):
     t0 = _time.time()
-    _, loss = multi(state, kk)
+    _, loss = c(state)
     float(loss)
     return _time.time() - t0
 
   # best-of-2 each, and guard the difference: on the RPC-floor-dominated
   # tunnel dt_k - dt_1 can be noise; fall back to the plain K-run average
   # (a conservative under-estimate) rather than divide by <= 0
-  dt_k = min(_timed(k), _timed(k))
-  dt_1 = min(_timed(1), _timed(1))
+  dt_k = min(_timed(ck), _timed(ck))
+  dt_1 = min(_timed(c1), _timed(c1))
   if dt_k - dt_1 <= 0.2 * dt_k:
     return k / dt_k
   return (k - 1) / (dt_k - dt_1)
@@ -233,8 +245,19 @@ def _bench_resnet():
   images = jnp.asarray(rng.rand(BATCH, *IMAGE), jnp.float32)
   labels = jnp.asarray(rng.randint(0, 1000, BATCH), jnp.int32)
 
+  def _bank(sps):
+    # flag FIRST, value second: the watchdog timer thread may observe
+    # _PARTIAL between these writes, and provisional-without-flag would
+    # read as a fully-measured number (the reverse mislabel is harmless)
+    _PARTIAL["extra"] = dict(_PARTIAL["extra"] or {},
+                             resnet_value_provisional=True)
+    _PARTIAL["value"] = BATCH * sps
+    sys.stderr.write("resnet provisional %.1f img/s banked\n"
+                     % _PARTIAL["value"])
+
   steps_per_sec = _steps_per_sec(resnet.train_step, state,
-                                 (images, labels), MEASURE, "resnet")
+                                 (images, labels), MEASURE, "resnet",
+                                 on_provisional=_bank)
   return BATCH * steps_per_sec
 
 
@@ -452,6 +475,7 @@ def main():
 
   img_per_sec = _bench_resnet()
   _PARTIAL["value"] = img_per_sec
+  _PARTIAL["extra"] = None   # final resnet number; drop the provisional flag
   try:
     extra = _bench_transformer()
     _PARTIAL["extra"] = extra

@@ -33,3 +33,43 @@ class TestServingRoofline:
     from tools import roofline as rl
     r = rl.analyze({}, "v5e", 819.0)
     assert r["flops_per_step"] > 0 and 0 < r["mfu_serial"] <= 1
+
+
+class TestBenchWatchParse:
+  def test_complete_vs_provisional_vs_garbage(self):
+    """The watcher must only treat a bench result as a completed capture
+    when the value is nonzero AND not a watchdog-fire provisional — a
+    provisional RPC-floor number ending the standing watch would burn
+    the round's one capture on a dead claim."""
+    import json
+    from tools import bench_watch as bw
+    good = json.dumps({"value": 2327.5, "extra": {"transformer_mfu": 0.5}})
+    v, prov, parsed = bw.parse_bench_tail(good)
+    assert v == 2327.5 and not prov and parsed["value"] == 2327.5
+    flagged = json.dumps({"value": 91.0,
+                          "extra": {"resnet_value_provisional": True}})
+    v, prov, _ = bw.parse_bench_tail(flagged)
+    assert v == 91.0 and prov
+    noted = json.dumps({"value": 91.0, "note": "watchdog: device runtime "
+                                               "did not respond in time"})
+    v, prov, _ = bw.parse_bench_tail(noted)
+    assert v == 91.0 and prov
+    for garbage in ("", "not json", "[1,2]", json.dumps({"note": None})):
+      v, prov, parsed = bw.parse_bench_tail(garbage)
+      assert v == 0.0 and not prov
+
+  def test_cache_env_disable_switch(self, monkeypatch):
+    from tools import bench_watch as bw
+    monkeypatch.delenv("TOS_BENCH_CACHE_DIR", raising=False)
+    env = bw._cache_env()
+    assert env["JAX_COMPILATION_CACHE_DIR"].endswith("xla_cache")
+    monkeypatch.setenv("TOS_BENCH_CACHE_DIR", "/tmp/elsewhere")
+    assert bw._cache_env()["JAX_COMPILATION_CACHE_DIR"] == "/tmp/elsewhere"
+    monkeypatch.setenv("TOS_BENCH_CACHE_DIR", "")
+    assert bw._cache_env() == {}
+
+  def test_parse_non_numeric_value_is_garbage(self):
+    import json
+    from tools import bench_watch as bw
+    for tail in (json.dumps({"value": "err"}), json.dumps({"value": [9.0]})):
+      assert bw.parse_bench_tail(tail) == (0.0, False, None)

@@ -75,6 +75,13 @@ def probe(timeout_s):
 def _run_step(name, cmd, timeout_s, out_path, env_extra=None):
   """Run one capture step; tee stdout to out_path; return (rc, stdout_tail)."""
   env = dict(os.environ)
+  if os.environ.get("TOS_BENCH_CACHE_DIR") == "":
+    # disable switch: also strip any inherited cache env so no capture
+    # step can silently keep reading a corrupt bank
+    for var in ("JAX_COMPILATION_CACHE_DIR",
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"):
+      env.pop(var, None)
   if env_extra:
     env.update(env_extra)
   _log("capture step %s: %s (timeout %ds)" % (name, " ".join(cmd), timeout_s))
@@ -100,11 +107,39 @@ def _run_step(name, cmd, timeout_s, out_path, env_extra=None):
 # and the next window resumes from the bank (round-5: a single ResNet-50
 # compile ate an entire ~10-minute window and the watchdog fired at 600s
 # with nothing to show)
-_CACHE_ENV = {
-    "JAX_COMPILATION_CACHE_DIR": os.path.join(ART, "xla_cache"),
-    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
-    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
-}
+def _cache_env():
+  # TOS_BENCH_CACHE_DIR="" is the documented disable switch (bench.py
+  # honors it in-process); it must disable the bank for EVERY capture
+  # step, or a corrupt-bank triage run would silently keep reading it
+  override = os.environ.get("TOS_BENCH_CACHE_DIR")
+  if override == "":
+    return {}
+  return {
+      "JAX_COMPILATION_CACHE_DIR": override or os.path.join(ART,
+                                                            "xla_cache"),
+      "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+      "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+  }
+
+
+def parse_bench_tail(tail):
+  """(value, provisional, parsed_json_or_None) from bench.py's JSON line.
+
+  ``provisional`` marks a watchdog-fire result: the value is real but
+  RPC-floor-dominated (banked after one 1-step dispatch), so it must not
+  be treated as a completed capture.
+  """
+  try:
+    parsed = json.loads(tail)
+    if not isinstance(parsed, dict):
+      return 0.0, False, None
+    value = float(parsed.get("value", 0.0) or 0.0)
+    provisional = (bool((parsed.get("extra") or {})
+                        .get("resnet_value_provisional"))
+                   or "watchdog" in (parsed.get("note") or ""))
+  except (ValueError, TypeError):
+    return 0.0, False, None
+  return value, provisional, parsed
 
 
 def capture():
@@ -118,28 +153,27 @@ def capture():
   rc, tail = _run_step(
       "bench", [sys.executable, "bench.py"], 1700,
       os.path.join(ART, "bench.json"),
-      env_extra=dict(_CACHE_ENV,
+      env_extra=dict(_cache_env(),
                      TOS_BENCH_PREFLIGHT_BUDGET="300",
                      TOS_BENCH_TIMEOUT="1200"))
-  value = 0.0
-  try:
-    parsed = json.loads(tail)
-    value = float(parsed.get("value", 0.0))
-    results["bench"] = parsed
-  except (ValueError, AttributeError):
-    results["bench"] = {"rc": rc, "raw": tail[:300]}
-  _log("bench value=%.1f rc=%d" % (value, rc))
+  value, provisional, parsed = parse_bench_tail(tail)
+  results["bench"] = parsed if parsed is not None else {"rc": rc,
+                                                        "raw": tail[:300]}
+  _log("bench value=%.1f rc=%d%s"
+       % (value, rc, " (provisional)" if provisional else ""))
 
-  if value <= 0.0:
-    # chip answered the probe but dropped mid-bench — don't burn the rest
-    # of the stack on a dead claim; keep watching instead
+  if value <= 0.0 or provisional:
+    # chip answered the probe but dropped (or wedged) mid-bench — the
+    # provisional RPC-floor number is better than 0.0 in bench.json, but
+    # it must NOT end the standing watch or trigger the 3.5h capture
+    # stack against a dead claim; keep watching for a healthy window
     _append_notes(results, complete=False)
-    return value
+    return 0.0
 
   rc, tail = _run_step(
       "sweep", [sys.executable, "bench.py"], 3900,
       os.path.join(ART, "sweep.json"),
-      env_extra=dict(_CACHE_ENV, TOS_BENCH_SWEEP="1",
+      env_extra=dict(_cache_env(), TOS_BENCH_SWEEP="1",
                      TOS_BENCH_TIMEOUT="3600",
                      TOS_BENCH_PREFLIGHT_BUDGET="300"))
   try:
@@ -153,7 +187,7 @@ def capture():
   rc, tail = _run_step(
       "kernels", [sys.executable, "tools/tpu_validate.py",
                   "--json", kernels_path], 3600,
-      os.path.join(ART, "kernels.stdout"), env_extra=_CACHE_ENV)
+      os.path.join(ART, "kernels.stdout"), env_extra=_cache_env())
   results["kernels_rc"] = rc
   try:
     with open(kernels_path) as f:
@@ -171,7 +205,7 @@ def capture():
 
   rc, tail = _run_step(
       "profile", [sys.executable, "tools/profile_step.py"], 1200,
-      os.path.join(ART, "profile.txt"), env_extra=_CACHE_ENV)
+      os.path.join(ART, "profile.txt"), env_extra=_cache_env())
   results["profile_rc"] = rc
 
   # kernel tile auto-tuning, separate from the core matrix so a slow
@@ -183,14 +217,14 @@ def capture():
   rc, tail = _run_step(
       "blocks", [sys.executable, "tools/tpu_validate.py", "--sweep-only",
                  "--json", blocks_path], 2400,
-      os.path.join(ART, "blocks.stdout"), env_extra=_CACHE_ENV)
+      os.path.join(ART, "blocks.stdout"), env_extra=_cache_env())
   results["blocks_rc"] = rc
 
   feed_bench = os.path.join(REPO, "tools", "feed_bench.py")
   if os.path.exists(feed_bench):
     rc, tail = _run_step(
         "feed", [sys.executable, feed_bench], 1200,
-        os.path.join(ART, "feed.json"), env_extra=_CACHE_ENV)
+        os.path.join(ART, "feed.json"), env_extra=_cache_env())
     try:
       results["feed"] = json.loads(tail)
     except ValueError:
@@ -200,7 +234,7 @@ def capture():
   # with two compile shapes — give the compiles room on first contact
   rc, tail = _run_step(
       "serve", [sys.executable, "tools/serve_bench.py"], 1800,
-      os.path.join(ART, "serve.json"), env_extra=_CACHE_ENV)
+      os.path.join(ART, "serve.json"), env_extra=_cache_env())
   try:
     results["serve"] = json.loads(tail)
   except ValueError:
