@@ -14,3 +14,6 @@ from tensorflowonspark_tpu.data.example_codec import (  # noqa: F401
     encode_example, decode_example,
 )
 from tensorflowonspark_tpu.data.schema import parse_schema  # noqa: F401
+from tensorflowonspark_tpu.data.indexed import (  # noqa: F401
+    CheckpointableInput, IndexedTFRecordDataset, checkpointable_input,
+)

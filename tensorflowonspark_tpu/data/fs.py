@@ -68,6 +68,14 @@ def glob_files(pattern: str):
   return _glob.glob(_paths.strip_scheme(pattern))
 
 
+def file_size(path: str) -> int:
+  """Size in bytes (remote schemes ask the backend, no download)."""
+  if is_remote(path):
+    fs, fpath = _fsspec().core.url_to_fs(path)
+    return int(fs.size(fpath))
+  return os.path.getsize(_paths.strip_scheme(path))
+
+
 def exists(path: str) -> bool:
   if is_remote(path):
     fs, fpath = _fsspec().core.url_to_fs(path)
