@@ -146,6 +146,17 @@ class IndexedTFRecordDataset(object):
   def __len__(self) -> int:
     return int(self._starts[-1])
 
+  def fingerprint(self) -> str:
+    """Identity of the file layout (basenames + per-file record counts).
+    Rides in ``CheckpointableInput`` states so a resume against
+    re-sharded/regenerated data of coincidentally equal total length
+    fails loudly instead of silently remapping indices. Basenames, not
+    full paths: a dataset copied to another root still resumes."""
+    import hashlib
+    parts = ["%s:%d" % (os.path.basename(p), len(o))
+             for p, o in zip(self.paths, self._offsets)]
+    return hashlib.md5("|".join(parts).encode()).hexdigest()[:16]
+
   def _locate(self, index: int):
     if not 0 <= index < len(self):
       raise IndexError("record %d out of range [0, %d)" % (index, len(self)))
@@ -291,12 +302,15 @@ class CheckpointableInput(object):
     """A tiny JSON-safe dict. ``config`` rides along so a restore into a
     differently-configured iterator fails loudly instead of silently
     yielding a different stream."""
-    return {"position": self._pos,
-            "config": {"len": len(self.dataset), "seed": self.seed,
-                       "shard_index": self.shard_index,
-                       "num_shards": self.num_shards,
-                       "batch_size": self.batch_size,
-                       "shuffle": self.shuffle}}
+    cfg = {"len": len(self.dataset), "seed": self.seed,
+           "shard_index": self.shard_index,
+           "num_shards": self.num_shards,
+           "batch_size": self.batch_size,
+           "shuffle": self.shuffle}
+    fp = getattr(self.dataset, "fingerprint", None)
+    if fp is not None:
+      cfg["data_fingerprint"] = fp()
+    return {"position": self._pos, "config": cfg}
 
   def set_state(self, state: dict) -> None:
     cfg = state.get("config")

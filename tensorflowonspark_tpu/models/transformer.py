@@ -54,6 +54,14 @@ class TransformerConfig:
   # K/V are projected to this many heads and the per-layer KV cache stores
   # only them — a num_heads/num_kv_heads reduction in serving cache memory
   num_kv_heads: int = 0
+  # Sliding-window attention (Mistral convention: each position attends
+  # to its last `attention_window` positions, itself included; 0 = full
+  # causal). The flash kernels bound their block loops to the window, so
+  # attention FLOPs become O(seq·window); composed with ring attention,
+  # ring steps whose KV shard slid out of the window collapse to zero
+  # kernel-loop iterations. Training, prefill and KV-cache decode all
+  # honor it.
+  attention_window: int = 0
   # Project Q, K and V with ONE matmul (heads axis = num_heads + 2·kv_heads,
   # sliced after): one bigger MXU op instead of three smaller ones. Changes
   # the parameter tree ("qkv" instead of "q"/"k"/"v")
@@ -116,6 +124,9 @@ class TransformerConfig:
     if self.num_kv_heads < 0:
       raise ValueError("num_kv_heads must be >= 0, got %d"
                        % (self.num_kv_heads,))
+    if self.attention_window < 0:
+      raise ValueError("attention_window must be >= 0 (0 = full causal), "
+                       "got %d" % (self.attention_window,))
     if self.num_kv_heads and self.num_heads % self.num_kv_heads != 0:
       raise ValueError("num_kv_heads (%d) must divide num_heads (%d)"
                        % (self.num_kv_heads, self.num_heads))
@@ -338,18 +349,21 @@ class Attention(nn.Module):
       local_seq = q.shape[1] // max(1, seq_shards)
       out = ra.ring_attention(q, k, v, self.mesh, causal=True,
                               use_flash=_flash_eligible(cfg, local_seq),
-                              interpret=interp)
+                              interpret=interp,
+                              window=cfg.attention_window or None)
     else:
       if _flash_eligible(cfg, q.shape[1]):
         # the flash kernels consume grouped KV natively (grouped-aware
         # BlockSpec; cross-head dK/dV accumulation in the backward grid)
         from tensorflowonspark_tpu.ops import flash_attention
-        out = flash_attention(q, k, v, causal=True, interpret=interp)
+        out = flash_attention(q, k, v, causal=True, interpret=interp,
+                              window=cfg.attention_window or None)
       else:
         # the dense reference attends at full head count: broadcast each
         # KV head to its query group (XLA fuses the repeat)
         out = ra.full_attention(q, _expand_kv(k, cfg.num_heads),
-                                _expand_kv(v, cfg.num_heads), causal=True)
+                                _expand_kv(v, cfg.num_heads), causal=True,
+                                window=cfg.attention_window or None)
 
     return self._out_proj(out)
 
@@ -447,7 +461,12 @@ class Attention(nn.Module):
         scores = scores * ks5
       q_pos = idx + jnp.arange(seg)[:, None]          # [seg, 1]
       k_pos = jnp.arange(cfg.max_seq_len)[None, :]    # [1, max]
-      mask = (k_pos <= q_pos)[None, None, None]       # causal + unwritten
+      keep = k_pos <= q_pos                           # causal + unwritten
+      if cfg.attention_window:
+        # sliding window: cache entries older than the window are masked
+        # (they stay in the cache buffer; the mask is what bounds decode)
+        keep = jnp.logical_and(keep, k_pos > q_pos - cfg.attention_window)
+      mask = keep[None, None, None]
       scores = jnp.where(mask, scores, -1e30)
       probs = jax.nn.softmax(scores, axis=-1)
       if quant:
@@ -486,9 +505,10 @@ class Attention(nn.Module):
 
       def _flash_prefill(_):
         interp = ops.pallas_interpret()
+        win = cfg.attention_window or None
         if single:
-          return flash_attention(q, k, v, causal=True,
-                                 interpret=interp).astype(q.dtype)
+          return flash_attention(q, k, v, causal=True, interpret=interp,
+                                 window=win).astype(q.dtype)
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
         batch_axes = mesh_lib.data_axes(self.mesh) or None
@@ -497,7 +517,8 @@ class Attention(nn.Module):
         spec = P(batch_axes, None, t_ax, None)
         fn = shard_map(
             lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=True,
-                                               interpret=interp),
+                                               interpret=interp,
+                                               window=win),
             mesh=self.mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(q, k, v).astype(q.dtype)

@@ -51,12 +51,18 @@ NEG_INF = -1e30
 LANES = 8
 
 
-def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base=0, k_base=0):
+def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base=0, k_base=0,
+                   window=None):
   """Scaled scores for one (q-block, k-block) pair with causal masking.
 
   ``q_base``/``k_base`` are absolute position offsets (traced scalars are
   fine) so the same kernel works for ring-attention blocks where the KV
-  block comes from another sequence shard.
+  block comes from another sequence shard. ``window`` (sliding-window
+  attention, Mistral convention: each query attends to the ``window``
+  most recent positions including itself) additionally masks
+  ``k_pos <= q_pos - window``; the loop-bound helpers below skip blocks
+  the mask would zero entirely, so FLOPs scale with the window, not the
+  sequence.
   """
   s = q @ k.astype(jnp.float32).T
   if causal:
@@ -64,7 +70,10 @@ def _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base=0, k_base=0):
         jnp.int32, (blk_q, blk_k), 0)
     k_pos = k_base + ki * blk_k + lax.broadcasted_iota(
         jnp.int32, (blk_q, blk_k), 1)
-    s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    keep = k_pos <= q_pos
+    if window is not None:
+      keep = jnp.logical_and(keep, k_pos > q_pos - window)
+    s = jnp.where(keep, s, NEG_INF)
   return s
 
 
@@ -77,10 +86,24 @@ def _causal_k_hi(qi, q_base, k_base, blk_q, blk_k, n_kblocks):
   return jnp.clip((q_hi - k_base) // blk_k + 1, 0, n_kblocks)
 
 
+def _window_k_lo(qi, q_base, k_base, blk_q, blk_k, window, n_kblocks):
+  """First k-block with any position inside q-block ``qi``'s window —
+  the lower loop bound that makes sliding-window FLOPs O(window)."""
+  k_lo = q_base + qi * blk_q - (window - 1) - k_base   # min visible k pos
+  return jnp.clip(k_lo // blk_k, 0, n_kblocks)
+
+
 def _causal_q_lo(ki, q_base, k_base, blk_q, blk_k):
   """First q-block with any row at-or-past k-block ``ki``'s start."""
   k_lo = k_base + ki * blk_k - q_base       # min k position, q-relative
   return jnp.clip(k_lo // blk_q, 0, None)
+
+
+def _window_q_hi(ki, q_base, k_base, blk_q, blk_k, window, n_qblocks):
+  """Exclusive upper bound on q-blocks that can still see k-block ``ki``
+  under a sliding window (rows further ahead have slid past it)."""
+  q_hi = k_base + (ki + 1) * blk_k - 1 + (window - 1) - q_base
+  return jnp.clip(q_hi // blk_q + 1, 0, n_qblocks)
 
 
 def _pair_p_ds(s, lse, delta, do, v):
@@ -100,7 +123,7 @@ def _pair_p_ds(s, lse, delta, do, v):
 
 def _attn_fwd_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                      blk_q: int, blk_k: int, kv_len: int, causal: bool,
-                     scale: float):
+                     scale: float, window=None):
   qi = pl.program_id(1)
   q_base = qb_ref[0]
   k_base = kb_ref[0]
@@ -113,7 +136,8 @@ def _attn_fwd_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     # value has no Mosaic lowering
     k = k_ref[0, pl.ds(ki * blk_k, blk_k), :]
     v = v_ref[0, pl.ds(ki * blk_k, blk_k), :]
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base,
+                       window)
     m_blk = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_blk)
     m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
@@ -129,7 +153,9 @@ def _attn_fwd_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
   acc0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
   hi = _causal_k_hi(qi, q_base, k_base, blk_q, blk_k, n_kblocks) \
       if causal else n_kblocks
-  m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+  lo = _window_k_lo(qi, q_base, k_base, blk_q, blk_k, window, n_kblocks) \
+      if window is not None else 0
+  m, l, acc = lax.fori_loop(lo, hi, body, (m0, l0, acc0))
 
   l_safe = jnp.where(l == 0.0, 1.0, l)
   o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
@@ -139,7 +165,8 @@ def _attn_fwd_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _attn_bwd_dq_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                         delta_ref, dq_ref, *, blk_q: int, blk_k: int,
-                        kv_len: int, causal: bool, scale: float):
+                        kv_len: int, causal: bool, scale: float,
+                        window=None):
   """dQ for one q-block: dQ = scale · Σ_k [P ⊙ (dO·Vᵀ − Δ)] · K."""
   qi = pl.program_id(1)
   q_base = qb_ref[0]
@@ -153,21 +180,24 @@ def _attn_bwd_dq_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
   def body(ki, dq):
     k = k_ref[0, pl.ds(ki * blk_k, blk_k), :]
     v = v_ref[0, pl.ds(ki * blk_k, blk_k), :]
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base,
+                       window)
     _, ds = _pair_p_ds(s, lse, delta, do, v.astype(jnp.float32))
     return dq + ds @ k.astype(jnp.float32)
 
   dq0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
   hi = _causal_k_hi(qi, q_base, k_base, blk_q, blk_k, n_kblocks) \
       if causal else n_kblocks
-  dq = lax.fori_loop(0, hi, body, dq0)
+  lo = _window_k_lo(qi, q_base, k_base, blk_q, blk_k, window, n_kblocks) \
+      if window is not None else 0
+  dq = lax.fori_loop(lo, hi, body, dq0)
   dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          delta_ref, dk_ref, dv_ref, *, blk_q: int,
                          blk_k: int, q_len: int, causal: bool,
-                         scale: float):
+                         scale: float, window=None):
   """dK/dV for one k-block: dV = Σ_q Pᵀ·dO; dK = scale · Σ_q dSᵀ·Q."""
   ki = pl.program_id(1)
   q_base = qb_ref[0]
@@ -182,7 +212,8 @@ def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
     lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
     delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base,
+                       window)
     p, ds = _pair_p_ds(s, lse, delta, do, v)
     dv_new = dv + p.T @ do
     dk_new = dk + ds.T @ q
@@ -191,7 +222,9 @@ def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
   dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
   dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
   lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
-  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
+  hi = _window_q_hi(ki, q_base, k_base, blk_q, blk_k, window, n_qblocks) \
+      if window is not None else n_qblocks
+  dk, dv = lax.fori_loop(lo, hi, body, (dk0, dv0))
   dk_ref[0] = dk.astype(dk_ref.dtype)   # q was pre-scaled; dk absorbs it
   dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -199,7 +232,7 @@ def _attn_bwd_dkv_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _attn_bwd_fused_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
                            lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *,
                            blk_q: int, blk_k: int, q_len: int, causal: bool,
-                           scale: float):
+                           scale: float, window=None):
   """Single-pass backward: dK/dV for one k-block plus this k-block's dQ
   contributions, accumulated into a grid-resident full-sequence dQ output.
 
@@ -226,7 +259,8 @@ def _attn_bwd_fused_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
     do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
     lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
     delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base,
+                       window)
     p, ds = _pair_p_ds(s, lse, delta, do, v)
     dv_new = dv + p.T @ do
     dk_new = dk + ds.T @ q                          # q pre-scaled: absorbs it
@@ -237,7 +271,9 @@ def _attn_bwd_fused_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
   dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
   dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
   lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
-  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
+  hi = _window_q_hi(ki, q_base, k_base, blk_q, blk_k, window, n_qblocks) \
+      if window is not None else n_qblocks
+  dk, dv = lax.fori_loop(lo, hi, body, (dk0, dv0))
   dk_ref[0] = dk.astype(dk_ref.dtype)
   dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -245,7 +281,7 @@ def _attn_bwd_fused_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
 def _attn_bwd_dkv_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
                              lse_ref, delta_ref, dk_ref, dv_ref, *,
                              blk_q: int, blk_k: int, q_len: int,
-                             causal: bool, scale: float):
+                             causal: bool, scale: float, window=None):
   """Grouped-KV dK/dV: grid (b·kv_heads, n_kblocks, group).
 
   The group axis is INNERMOST, so each (blk_k, D) dK/dV block stays
@@ -270,14 +306,17 @@ def _attn_bwd_dkv_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
     do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
     lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
     delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base,
+                       window)
     p, ds = _pair_p_ds(s, lse, delta, do, v)
     return dk + ds.T @ q, dv + p.T @ do
 
   dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
   dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
   lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
-  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
+  hi = _window_q_hi(ki, q_base, k_base, blk_q, blk_k, window, n_qblocks) \
+      if window is not None else n_qblocks
+  dk, dv = lax.fori_loop(lo, hi, body, (dk0, dv0))
 
   @pl.when(qh == 0)
   def _assign():  # noqa: ANN202 - pallas region
@@ -293,7 +332,7 @@ def _attn_bwd_dkv_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
 def _attn_bwd_fused_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
                                lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *,
                                blk_q: int, blk_k: int, q_len: int,
-                               causal: bool, scale: float):
+                               causal: bool, scale: float, window=None):
   """Grouped-KV single-pass backward: grid (b·kv_heads, group, n_kblocks).
 
   dQ of the current query head accumulates across the innermost k-block
@@ -322,7 +361,8 @@ def _attn_bwd_fused_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
     do = do_ref[0, pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
     lse = lse_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
     delta = delta_ref[0, pl.ds(qi * blk_q, blk_q), 0:1]
-    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base)
+    s = _masked_scores(q, k, qi, ki, blk_q, blk_k, causal, q_base, k_base,
+                       window)
     p, ds = _pair_p_ds(s, lse, delta, do, v)
     dv_new = dv + p.T @ do
     dk_new = dk + ds.T @ q                          # q pre-scaled: absorbs it
@@ -333,7 +373,9 @@ def _attn_bwd_fused_gqa_kernel(qb_ref, kb_ref, q_ref, k_ref, v_ref, do_ref,
   dk0 = jnp.zeros((blk_k, k.shape[-1]), jnp.float32)
   dv0 = jnp.zeros((blk_k, v.shape[-1]), jnp.float32)
   lo = _causal_q_lo(ki, q_base, k_base, blk_q, blk_k) if causal else 0
-  dk, dv = lax.fori_loop(lo, n_qblocks, body, (dk0, dv0))
+  hi = _window_q_hi(ki, q_base, k_base, blk_q, blk_k, window, n_qblocks) \
+      if window is not None else n_qblocks
+  dk, dv = lax.fori_loop(lo, hi, body, (dk0, dv0))
 
   sl = pl.ds(ki * blk_k, blk_k)
 
@@ -431,9 +473,22 @@ def _q_row_map(h, hk, grp, qh_axis):
   return _map
 
 
+def _check_window(window, causal):
+  if window is None:
+    return None
+  window = int(window)
+  if window < 1:
+    raise ValueError("window must be >= 1, got %d" % window)
+  if not causal:
+    raise ValueError("sliding-window attention requires causal=True "
+                     "(the window is 'the last W positions')")
+  return window
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
-                                             "interpret"))
-def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret):
+                                             "interpret", "window"))
+def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret,
+              window=None):
   b, s_q, h, d = q.shape
   s_kv = k.shape[1]
   hk, g = _group(q, k)
@@ -443,7 +498,8 @@ def _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k, interpret):
   qb, kb = _base_arrays(q_base, kv_base)
 
   kernel = functools.partial(_attn_fwd_kernel, blk_q=blk_q, blk_k=blk_k,
-                             kv_len=s_kv, causal=causal, scale=scale)
+                             kv_len=s_kv, causal=causal, scale=scale,
+                             window=_check_window(window, causal))
   out, lse = pl.pallas_call(
       kernel,
       grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -503,9 +559,10 @@ def _resolve_bwd(bwd):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
-                                             "interpret", "bwd"))
+                                             "interpret", "bwd", "window"))
 def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
-              blk_k, interpret, bwd="fused"):
+              blk_k, interpret, bwd="fused", window=None):
+  window = _check_window(window, causal)
   b, s_q, h, d = q.shape
   s_kv = k.shape[1]
   hk, grp = _group(q, k)
@@ -543,7 +600,7 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
     dq, dk, dv = pl.pallas_call(
         functools.partial(_attn_bwd_fused_gqa_kernel, blk_q=blk_q,
                           blk_k=blk_k, q_len=s_q, causal=causal,
-                          scale=scale),
+                          scale=scale, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b * hk, grp, s_kv // blk_k),
@@ -579,7 +636,7 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
   if bwd == "fused":
     dq, dk, dv = pl.pallas_call(
         functools.partial(_attn_bwd_fused_kernel, blk_q=blk_q, blk_k=blk_k,
-                          q_len=s_q, causal=causal, scale=scale),
+                          q_len=s_q, causal=causal, scale=scale, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b * h, s_kv // blk_k),
@@ -609,7 +666,7 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
 
   dq = pl.pallas_call(
       functools.partial(_attn_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
-                        kv_len=s_kv, causal=causal, scale=scale),
+                        kv_len=s_kv, causal=causal, scale=scale, window=window),
       grid_spec=pltpu.PrefetchScalarGridSpec(
           num_scalar_prefetch=2,
           grid=(b * h, s_q // blk_q),
@@ -632,7 +689,7 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
     dk, dv = pl.pallas_call(
         functools.partial(_attn_bwd_dkv_gqa_kernel, blk_q=blk_q,
                           blk_k=blk_k, q_len=s_q, causal=causal,
-                          scale=scale),
+                          scale=scale, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b * hk, s_kv // blk_k, grp),
@@ -665,7 +722,7 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
 
   dk, dv = pl.pallas_call(
       functools.partial(_attn_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
-                        q_len=s_q, causal=causal, scale=scale),
+                        q_len=s_q, causal=causal, scale=scale, window=window),
       grid_spec=pltpu.PrefetchScalarGridSpec(
           num_scalar_prefetch=2,
           grid=(b * h, s_kv // blk_k),
@@ -698,36 +755,42 @@ def _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base, causal, blk_q,
 def flash_attention(q, k, v, causal: bool = True, blk_q: int = 256,
                     blk_k: int = 512, interpret: bool = False,
                     bwd: str = None, blk_bwd_q: int = None,
-                    blk_bwd_k: int = None):
+                    blk_bwd_k: int = None, window: int = None):
   """Fused (self-)attention with fused backward. q: [batch, seq, heads,
   head_dim]; k/v: same, or with heads/g KV heads (grouped-query
   attention — consumed unexpanded, see module docstring); seq must
   divide by the (clamped) block sizes. ``bwd``: 'fused' (single-pass
   dQ/dK/dV) or 'split' (two kernels); defaults to
   :func:`default_bwd_mode`. The backward uses its own block sizes
-  (``DEFAULT_BWD_BLOCKS`` per mode unless overridden)."""
+  (``DEFAULT_BWD_BLOCKS`` per mode unless overridden). ``window``
+  (requires causal) restricts each query to its last ``window``
+  positions (sliding-window attention); the kernels' block loops bound
+  to the window, so attention FLOPs become O(seq·window) instead of
+  O(seq²)."""
   return _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret,
-                    _resolve_bwd(bwd), blk_bwd_q, blk_bwd_k)
+                    _resolve_bwd(bwd), blk_bwd_q, blk_bwd_k, window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_vjp(q, k, v, causal, blk_q, blk_k, interpret, bwd, blk_bwd_q,
-               blk_bwd_k):
-  out, _ = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret)
+               blk_bwd_k, window):
+  out, _ = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret,
+                     window)
   return out
 
 
 def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, bwd, blk_bwd_q,
-               blk_bwd_k):
-  out, lse = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret)
+               blk_bwd_k, window):
+  out, lse = _fwd_impl(q, k, v, 0, 0, causal, blk_q, blk_k, interpret,
+                       window)
   return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, blk_q, blk_k, interpret, bwd, blk_bwd_q, blk_bwd_k,
-               residuals, g):
+               window, residuals, g):
   q, k, v, out, lse = residuals
   return _bwd_impl(q, k, v, out, lse, g, None, 0, 0, causal, blk_bwd_q,
-                   blk_bwd_k, interpret, bwd)
+                   blk_bwd_k, interpret, bwd, window)
 
 
 _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
@@ -739,7 +802,8 @@ _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
                           blk_q: int = 256, blk_k: int = 512,
                           interpret: bool = False, bwd: str = None,
-                          blk_bwd_q: int = None, blk_bwd_k: int = None):
+                          blk_bwd_q: int = None, blk_bwd_k: int = None,
+                          window: int = None):
   """Partial attention of local queries against ONE KV block.
 
   q: [B, Sq, H, D] at absolute positions ``q_base + arange(Sq)``;
@@ -748,33 +812,38 @@ def flash_attention_block(q, k, v, q_base, kv_base, causal: bool = True,
   inside shard_map they depend on ``lax.axis_index``). Returns
   (normalized partial output, logsumexp) — merge partials across blocks
   with :func:`merge_partials`. Differentiable in q/k/v (including through
-  the lse output).
+  the lse output). ``window`` composes with the ring: a KV block entirely
+  behind the window collapses to zero loop iterations (the bounds are
+  computed from the traced bases), so out-of-window ring steps cost only
+  the kernel launch and the merge.
   """
   return _flash_block_vjp(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
                           interpret, _resolve_bwd(bwd), blk_bwd_q,
-                          blk_bwd_k)
+                          blk_bwd_k, window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
 def _flash_block_vjp(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                     interpret, bwd, blk_bwd_q, blk_bwd_k):
+                     interpret, bwd, blk_bwd_q, blk_bwd_k, window):
   return _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                   interpret)
+                   interpret, window)
 
 
 def _flash_block_fwd(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                     interpret, bwd, blk_bwd_q, blk_bwd_k):
+                     interpret, bwd, blk_bwd_q, blk_bwd_k, window):
   out, lse = _fwd_impl(q, k, v, q_base, kv_base, causal, blk_q, blk_k,
-                       interpret)
+                       interpret, window)
   return (out, lse), (q, k, v, out, lse, q_base, kv_base)
 
 
 def _flash_block_bwd(causal, blk_q, blk_k, interpret, bwd, blk_bwd_q,
-                     blk_bwd_k, residuals, cotangents):
+                     blk_bwd_k, window, residuals, cotangents):
   q, k, v, out, lse, q_base, kv_base = residuals
   g, g_lse = cotangents
   dq, dk, dv = _bwd_impl(q, k, v, out, lse, g, g_lse, q_base, kv_base,
-                         causal, blk_bwd_q, blk_bwd_k, interpret, bwd)
+                         causal, blk_bwd_q, blk_bwd_k, interpret, bwd,
+                         window)
   zero_base = np.zeros((), jax.dtypes.float0)
   return dq, dk, dv, zero_base, zero_base
 

@@ -50,11 +50,14 @@ def expand_heads(kv, num_heads: int):
 _expand_heads = expand_heads
 
 
-def _block_attn(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
+def _block_attn(q, k, v, m, l, o, q_offset, kv_offset, causal, scale,
+                window=None):
   """One online-softmax accumulation step against a single KV block.
 
   q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; o: [B, Sq, H, D].
   Positions are global offsets so causal masking works across shards.
+  ``window``: sliding-window mask (last ``window`` positions, self
+  included) — same convention as ops.flash_attention.
   """
   qf = q.astype(jnp.float32)
   kf = k.astype(jnp.float32)
@@ -63,7 +66,10 @@ def _block_attn(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
   if causal:
     q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (q.shape[1], k.shape[1]), 0)
     k_pos = kv_offset + lax.broadcasted_iota(jnp.int32, (q.shape[1], k.shape[1]), 1)
-    mask = (k_pos <= q_pos)[None, None]
+    keep = k_pos <= q_pos
+    if window is not None:
+      keep = jnp.logical_and(keep, k_pos > q_pos - window)
+    mask = keep[None, None]
     scores = jnp.where(mask, scores, NEG_INF)
 
   m_block = jnp.max(scores, axis=-1)                      # [B,H,Sq]
@@ -79,7 +85,7 @@ def _block_attn(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
   return m_new, l_new, o_new
 
 
-def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
+def _ring_attn_local(q, k, v, axis_name: str, causal: bool, window=None):
   """shard_map body: full attention with KV blocks rotating around the ring."""
   n = lax.axis_size(axis_name)
   my = lax.axis_index(axis_name)
@@ -97,7 +103,7 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
     kv_offset = src * s_local
     m, l, o = _block_attn(q, _expand_heads(k_blk, h),
                           _expand_heads(v_blk, h), m, l, o, q_offset,
-                          kv_offset, causal, scale)
+                          kv_offset, causal, scale, window)
     # rotate kv to the next neighbor (ICI ring); last rotation is unused but
     # keeps the loop shape static for XLA
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -113,7 +119,7 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool):
 
 def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
                       blk_k: int, interpret: bool, blk_bwd_q=None,
-                      blk_bwd_k=None, bwd=None):
+                      blk_bwd_k=None, bwd=None, window=None):
   """shard_map body: ring attention with Pallas flash-attention blocks.
 
   Each ring step computes the partial attention of the local queries
@@ -145,7 +151,8 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
         q, k_blk, v_blk,
         my * s_local, src * s_local, causal=causal,
         blk_q=blk_q, blk_k=blk_k, interpret=interpret,
-        blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k, bwd=bwd)
+        blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k, bwd=bwd,
+        window=window)
     o, lse = merge_partials(o, lse, o_j.astype(jnp.float32), lse_j)
     perm = [(i, (i + 1) % n) for i in range(n)]
     k_blk = lax.ppermute(k_blk, axis_name, perm)
@@ -161,7 +168,8 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
                    batch_axes=None, use_flash: bool = False,
                    blk_q: int = 256, blk_k: int = 512,
                    interpret: bool = False, blk_bwd_q: int = None,
-                   blk_bwd_k: int = None, bwd: str = None):
+                   blk_bwd_k: int = None, bwd: str = None,
+                   window: int = None):
   """Exact full attention over a sequence sharded across ``axis_name``.
 
   Args:
@@ -197,26 +205,37 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
     v = _expand_heads(v, q.shape[2])
   spec = P(batch_axes or None, axis_name, mesh_lib.AXIS_TENSOR
            if mesh_lib.AXIS_TENSOR in mesh.axis_names else None, None)
+  if window is not None and not causal:
+    raise ValueError("sliding-window ring attention requires causal=True")
   if use_flash:
     fn = functools.partial(_ring_flash_local, axis_name=axis_name,
                            causal=causal, blk_q=blk_q, blk_k=blk_k,
                            blk_bwd_q=blk_bwd_q, blk_bwd_k=blk_bwd_k, bwd=bwd,
-                           interpret=interpret)
+                           interpret=interpret, window=window)
   else:
     fn = functools.partial(_ring_attn_local, axis_name=axis_name,
-                           causal=causal)
+                           causal=causal, window=window)
   return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)(q, k, v)
 
 
-def full_attention(q, k, v, causal: bool = True):
-  """Single-device reference implementation (for tests and small models)."""
+def full_attention(q, k, v, causal: bool = True, window: int = None):
+  """Single-device reference implementation (for tests and small models).
+  ``window`` masks like the flash kernels' sliding window (each query sees
+  its last ``window`` positions, self included) but materializes the
+  dense mask — O(s²) memory, reference only."""
+  if window is not None and not causal:
+    raise ValueError("sliding-window attention requires causal=True "
+                     "(same contract as ops.flash_attention)")
   b, s, h, d = q.shape
   scale = 1.0 / (d ** 0.5)
   scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                       k.astype(jnp.float32)) * scale
   if causal:
     mask = jnp.tril(jnp.ones((s, s), bool))
+    if window is not None:
+      mask = jnp.logical_and(mask, ~jnp.tril(jnp.ones((s, s), bool),
+                                             k=-window))
     scores = jnp.where(mask[None, None], scores, NEG_INF)
   probs = jax.nn.softmax(scores, axis=-1)
   out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))

@@ -560,3 +560,111 @@ class TestLNMatmul:
     prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
     out = tfm.greedy_generate(state_f.params, cfg_f, prompt, num_steps=4)
     assert out.shape == (1, 8)
+
+
+class TestSlidingWindow:
+  """Sliding-window attention (window = last W positions, self included):
+  the kernels must equal the dense windowed mask exactly while bounding
+  their block loops to the window (the O(seq·window) claim)."""
+
+  def _qkv(self, B=2, S=128, H=4, D=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+                 for _ in range(3))
+
+  @pytest.mark.parametrize("window", [1, 16, 40, 128, 500])
+  def test_forward_matches_dense_window(self, window):
+    q, k, v = self._qkv()
+    out = flash_attention(q, k, v, causal=True, blk_q=32, blk_k=32,
+                          interpret=True, window=window)
+    ref = ra.full_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+  @pytest.mark.parametrize("bwd", ["fused", "split"])
+  def test_grads_match_dense_window(self, bwd):
+    q, k, v = self._qkv()
+    t = jnp.asarray(np.random.RandomState(9).randn(*q.shape), jnp.float32)
+    ref = jax.grad(
+        lambda q, k, v: jnp.sum(t * ra.full_attention(
+            q, k, v, causal=True, window=40)), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(
+        lambda q, k, v: jnp.sum(t * flash_attention(
+            q, k, v, causal=True, blk_q=32, blk_k=32, blk_bwd_q=32,
+            blk_bwd_k=32, interpret=True, bwd=bwd,
+            window=40)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, ref):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 atol=2e-4, rtol=2e-4)
+
+  @pytest.mark.parametrize("bwd", ["fused", "split"])
+  def test_gqa_windowed_grads(self, bwd):
+    q, k, v = self._qkv()
+    kg, vg = k[:, :, :2, :], v[:, :, :2, :]
+    t = jnp.asarray(np.random.RandomState(9).randn(*q.shape), jnp.float32)
+    ref = jax.grad(
+        lambda q, kk, vv: jnp.sum(t * ra.full_attention(
+            q, ra.expand_heads(kk, 4), ra.expand_heads(vv, 4),
+            causal=True, window=24)), argnums=(0, 1, 2))(q, kg, vg)
+    got = jax.grad(
+        lambda q, kk, vv: jnp.sum(t * flash_attention(
+            q, kk, vv, causal=True, blk_q=32, blk_k=32, blk_bwd_q=32,
+            blk_bwd_k=32, interpret=True, bwd=bwd,
+            window=24)), argnums=(0, 1, 2))(q, kg, vg)
+    for a, b in zip(got, ref):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 atol=2e-4, rtol=2e-4)
+
+  def test_ring_block_partials_merge_across_window(self):
+    """Two sequence shards with the window straddling the boundary: the
+    merged block partials must equal the dense windowed reference (the
+    ring-attention composition path)."""
+    from tensorflowonspark_tpu.ops import (flash_attention_block,
+                                           merge_partials)
+    q, k, v = self._qkv()
+    half = 64
+    o1, l1 = flash_attention_block(q[:, half:], k[:, :half], v[:, :half],
+                                   half, 0, causal=True, blk_q=32,
+                                   blk_k=32, interpret=True, window=40)
+    o2, l2 = flash_attention_block(q[:, half:], k[:, half:], v[:, half:],
+                                   half, half, causal=True, blk_q=32,
+                                   blk_k=32, interpret=True, window=40)
+    merged, _ = merge_partials(o1, l1, o2, l2)
+    ref = ra.full_attention(q, k, v, causal=True, window=40)[:, half:]
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+  def test_out_of_window_block_is_fully_masked(self):
+    """A remote KV block entirely behind the window contributes nothing:
+    lse = NEG_INF everywhere, so merge_partials ignores it."""
+    from tensorflowonspark_tpu.ops import flash_attention_block
+    from tensorflowonspark_tpu.ops.flash_attention import NEG_INF
+    q, k, v = self._qkv(S=64)
+    # queries at absolute positions [1024, 1088); KV at [0, 64): with
+    # window 128 every pair is out of range
+    _, lse = flash_attention_block(q, k, v, 1024, 0, causal=True,
+                                   blk_q=32, blk_k=32, interpret=True,
+                                   window=128)
+    assert np.all(np.asarray(lse) <= NEG_INF)
+
+  def test_window_requires_causal(self):
+    q, k, v = self._qkv(S=32)
+    with pytest.raises(ValueError, match="causal"):
+      flash_attention(q, k, v, causal=False, interpret=True, window=8)
+
+  def test_loop_bounds_scale_with_window(self):
+    """The windowed kernel must do O(window), not O(seq), work: check the
+    block-loop bounds directly (lo..hi spans ≤ window/blk_k + 2 blocks)."""
+    from tensorflowonspark_tpu.ops.flash_attention import (_causal_k_hi,
+                                                           _window_k_lo)
+    blk_q = blk_k = 32
+    n_kblocks = 64   # seq 2048
+    window = 128
+    for qi in range(64):
+      hi = int(_causal_k_hi(qi, 0, 0, blk_q, blk_k, n_kblocks))
+      lo = int(_window_k_lo(qi, 0, 0, blk_q, blk_k, window, n_kblocks))
+      visited = hi - lo
+      assert visited <= window // blk_k + 2
+      # every visited block must contain at least one unmasked pair
+      assert lo * blk_k <= qi * blk_q                      # not past diag
+      assert (hi * blk_k) > qi * blk_q - window            # window reaches

@@ -487,3 +487,18 @@ class TestIndexedPipeline:
     with pytest.raises(IOError, match="truncated"):
       ds.record(2)
     ds.close()
+
+  def test_resume_rejects_different_data_layout(self, tmp_path):
+    """Equal record count but re-sharded files: the fingerprint in the
+    saved state must make resume fail loudly, not silently remap."""
+    from tensorflowonspark_tpu.data.indexed import checkpointable_input
+    a = checkpointable_input(self._write(tmp_path / "a", num_files=4,
+                                         rows_per=5),
+                             batch_size=3, schema=self.SCHEMA, seed=7)
+    snap = a.get_state()
+    assert "data_fingerprint" in snap["config"]
+    b = checkpointable_input(self._write(tmp_path / "b", num_files=2,
+                                         rows_per=10),
+                             batch_size=3, schema=self.SCHEMA, seed=7)
+    with pytest.raises(ValueError, match="different input config"):
+      b.set_state(snap)

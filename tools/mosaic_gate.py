@@ -86,7 +86,8 @@ def _sh(*shape, dtype=None):
 # --------------------------------------------------------------------------
 
 
-def _flash(causal=True, bwd="fused", gqa=False, grad=True, s=1024, d=128):
+def _flash(causal=True, bwd="fused", gqa=False, grad=True, s=1024, d=128,
+           window=None):
   import jax
   from tensorflowonspark_tpu.ops.flash_attention import flash_attention
   mesh = _mesh1()
@@ -94,11 +95,13 @@ def _flash(causal=True, bwd="fused", gqa=False, grad=True, s=1024, d=128):
   q, k, v = _sh(1, s, h, d), _sh(1, s, hk, d), _sh(1, s, hk, d)
   if grad:
     def loss(q, k, v):
-      return flash_attention(q, k, v, causal=causal, bwd=bwd).sum()
+      return flash_attention(q, k, v, causal=causal, bwd=bwd,
+                             window=window).sum()
     fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)),
                  in_shardings=(_repl(mesh),) * 3)
   else:
-    fn = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal),
+    fn = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                                 window=window),
                  in_shardings=(_repl(mesh),) * 3)
   return fn, (q, k, v)
 
@@ -131,6 +134,41 @@ def t_flash_short_seq_bwd():
   # s < default blocks: the _blocks clamp path (and the post-fallback
   # default re-resolution) must also survive Mosaic
   return _flash(bwd="fused", gqa=True, s=256, d=64)
+
+
+def t_flash_window_fused_bwd():
+  # sliding window (s=4096, W=1024): the windowed loop bounds (traced
+  # lo from _window_k_lo / hi from _window_q_hi) must lower — fori_loop
+  # with a traced lower bound is a different Mosaic path than 0..hi
+  return _flash(bwd="fused", s=4096, window=1024)
+
+
+def t_flash_window_gqa_split_bwd():
+  return _flash(bwd="split", gqa=True, s=4096, window=1024)
+
+
+def t_ring_attention_window():
+  """Windowed ring attention: 4-way sequence mesh at s=8192 with a
+  2048-window — ring steps whose KV shard is behind the window collapse
+  to zero kernel-loop iterations (the long-context sliding-window
+  production path)."""
+  import jax
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  from tensorflowonspark_tpu.parallel import ring_attention as ra
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  mesh = mesh_lib.build_mesh(
+      mesh_lib.MeshSpec(data=-1, sequence=4),
+      devices=list(_topology("v5e:2x2").devices))
+  spec = NamedSharding(mesh, P(None, mesh_lib.AXIS_SEQUENCE, None, None))
+
+  def loss(q, k, v):
+    return ra.ring_attention(q, k, v, mesh, causal=True, use_flash=True,
+                             interpret=False, window=2048).sum()
+
+  fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)),
+               in_shardings=(spec, spec, spec))
+  return fn, (_sh(1, 8192, 8, 64), _sh(1, 8192, 2, 64),
+              _sh(1, 8192, 2, 64))
 
 
 def t_ring_attention_gqa():
@@ -559,6 +597,9 @@ TARGETS = {
     "flash_gqa_split_bwd": t_flash_gqa_split_bwd,
     "flash_noncausal_fwd": t_flash_noncausal_fwd,
     "flash_short_seq_bwd": t_flash_short_seq_bwd,
+    "flash_window_fused_bwd": t_flash_window_fused_bwd,
+    "flash_window_gqa_split_bwd": t_flash_window_gqa_split_bwd,
+    "ring_attention_window": t_ring_attention_window,
     "ring_attention_gqa": t_ring_attention_gqa,
     "layer_norm": t_layer_norm,
     "ln_matmul": t_ln_matmul,
