@@ -473,6 +473,32 @@ def main():
     _sweep()
     return
 
+  # micro-capture mode (tools/micro_capture.py): claim windows on this
+  # image run ~2-5 minutes, far short of the full bench — TOS_BENCH_ONLY
+  # runs ONE model per subprocess so each window can complete something
+  only = os.environ.get("TOS_BENCH_ONLY", "")
+  if only == "resnet":
+    _emit(_bench_resnet(), extra=_PARTIAL["extra"])
+    return
+  if only == "transformer":
+    extra = _bench_transformer()
+    _PARTIAL["extra"] = extra
+    _emit(0.0, metric="transformer_tokens_per_sec",
+          unit="tokens/sec/chip", extra=extra)
+    return
+  if only == "transformer_allfused":
+    extra = _bench_transformer(ln_matmul_impl="fused", fuse_qkv=True,
+                               act_matmul_impl="fused")
+    _PARTIAL["extra"] = extra
+    _emit(0.0, metric="transformer_allfused_tokens_per_sec",
+          unit="tokens/sec/chip", extra=extra)
+    return
+  if only == "long_context":
+    extra = _bench_long_context()
+    _PARTIAL["extra"] = extra
+    _emit(0.0, metric="long_context", unit="tokens/sec/chip", extra=extra)
+    return
+
   img_per_sec = _bench_resnet()
   _PARTIAL["value"] = img_per_sec
   _PARTIAL["extra"] = None   # final resnet number; drop the provisional flag

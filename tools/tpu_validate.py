@@ -549,6 +549,23 @@ def sweep_blocks(results):
                         ms=round(t * 1e3, 3), blocks=list(blocks)))
 
 
+class _TeeResults(list):
+  """Write-through results list: each appended row also lands on disk
+  immediately (one JSON line), so a claim window that closes mid-matrix
+  keeps every row that finished instead of losing the whole run. Used by
+  the micro-capture queue (tools/micro_capture.py)."""
+
+  def __init__(self, path):
+    super().__init__()
+    self._path = path
+
+  def append(self, row):
+    super().append(row)
+    if self._path:
+      with open(self._path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
 def main(argv=None):
   ap = argparse.ArgumentParser()
   ap.add_argument("--quick", action="store_true")
@@ -559,6 +576,14 @@ def main(argv=None):
   ap.add_argument("--sweep-only", action="store_true",
                   help="run ONLY the block sweep (skip the validation "
                        "matrix — e.g. when a capture just ran it)")
+  ap.add_argument("--select", default=None,
+                  help="comma list of family[:shape_idx] items to run "
+                       "instead of the full matrix — one small subprocess "
+                       "per claim window (micro-capture mode). Families: "
+                       "flash_bf16, flash_f32, gqa, block, ln, lnmm, gelu")
+  ap.add_argument("--append-jsonl", default=None,
+                  help="append each result row to this file the moment it "
+                       "is produced (survives a mid-run chip drop)")
   args = ap.parse_args(argv)
 
   import jax
@@ -568,7 +593,7 @@ def main(argv=None):
     print("WARNING: not a TPU — results are for the %s backend"
           % dev.platform, file=sys.stderr)
 
-  results = []
+  results = _TeeResults(args.append_jsonl)
   if args.quick:
     flash_shapes = [(1, 512, 4, 64, True)]
     gqa_shapes = [(2, 1024, 8, 2, 64, True)]
@@ -599,7 +624,28 @@ def main(argv=None):
     actmm_shapes = [(4096, 3072, 768), (16384, 3072, 768),
                     (8192, 8192, 2048)]
 
-  if not args.sweep_only:
+  families = {
+      "flash_bf16": (flash_shapes, lambda sh: check_flash(results, sh,
+                                                          "bf16")),
+      "flash_f32": (flash_shapes, lambda sh: check_flash(results, sh,
+                                                         "f32")),
+      "gqa": (gqa_shapes, lambda sh: check_flash_gqa(results, sh)),
+      "block": (None, lambda sh: check_flash_block(results)),
+      "ln": (ln_shapes, lambda sh: check_layer_norm(results, sh)),
+      "lnmm": (lnmm_shapes, lambda sh: check_ln_matmul(results, sh)),
+      "gelu": (actmm_shapes, lambda sh: check_gelu_matmul(results, sh)),
+  }
+  if args.select:
+    for spec in args.select.split(","):
+      fam, _, idx = spec.strip().partition(":")
+      shapes, runner = families[fam]
+      if shapes is None:
+        runner(None)
+      elif idx:
+        runner([shapes[int(idx)]])
+      else:
+        runner(shapes)
+  elif not args.sweep_only:
     for dt in (("bf16",) if args.quick else ("bf16", "f32")):
       check_flash(results, flash_shapes, dt)
     check_flash_gqa(results, gqa_shapes)
@@ -607,7 +653,7 @@ def main(argv=None):
     check_layer_norm(results, ln_shapes)
     check_ln_matmul(results, lnmm_shapes)
     check_gelu_matmul(results, actmm_shapes)
-  if args.sweep_blocks or args.sweep_only:
+  if args.sweep_blocks or (args.sweep_only and not args.select):
     sweep_blocks(results)
 
   # pass/fail counts only the VALIDATION rows: sweep rows are timing
