@@ -69,30 +69,33 @@ def _items():
               "gqa:2", "flash_bf16:2", "flash_bf16:3", "flash_bf16:4",
               "lnmm:2", "gelu:2", "ln:0", "ln:2"):
     items.append(val(sel))
-  items.append(("bench_resnet", [PY, "bench.py"], 420,
+  items.append(("bench_resnet", [PY, "bench.py"], 450,
                 {"TOS_BENCH_ONLY": "resnet",
-                 "TOS_BENCH_TIMEOUT": "390",
-                 "TOS_BENCH_PREFLIGHT_BUDGET": "60"}))
-  items.append(("bench_transformer", [PY, "bench.py"], 420,
+                 "TOS_BENCH_TIMEOUT": "330",
+                 "TOS_BENCH_PREFLIGHT_BUDGET": "45"}))
+  items.append(("bench_transformer", [PY, "bench.py"], 450,
                 {"TOS_BENCH_ONLY": "transformer",
-                 "TOS_BENCH_TIMEOUT": "390",
-                 "TOS_BENCH_PREFLIGHT_BUDGET": "60"}))
-  items.append(("bench_allfused", [PY, "bench.py"], 420,
+                 "TOS_BENCH_TIMEOUT": "330",
+                 "TOS_BENCH_PREFLIGHT_BUDGET": "45"}))
+  items.append(("bench_allfused", [PY, "bench.py"], 450,
                 {"TOS_BENCH_ONLY": "transformer_allfused",
-                 "TOS_BENCH_TIMEOUT": "390",
-                 "TOS_BENCH_PREFLIGHT_BUDGET": "60"}))
+                 "TOS_BENCH_TIMEOUT": "330",
+                 "TOS_BENCH_PREFLIGHT_BUDGET": "45"}))
   for sel in ("flash_f32:1", "flash_f32:0"):
     items.append(val(sel))
-  items.append(("bench_long_context", [PY, "bench.py"], 420,
+  items.append(("bench_long_context", [PY, "bench.py"], 450,
                 {"TOS_BENCH_ONLY": "long_context",
-                 "TOS_BENCH_TIMEOUT": "390",
-                 "TOS_BENCH_PREFLIGHT_BUDGET": "60"}))
+                 "TOS_BENCH_TIMEOUT": "330",
+                 "TOS_BENCH_PREFLIGHT_BUDGET": "45"}))
   items.append(("blocks_sweep", [PY, "tools/tpu_validate.py",
                 "--sweep-only", "--append-jsonl",
                 os.path.join(MICRO, "blocks.jsonl"),
                 "--json", os.path.join(MICRO, "blocks.json")], 900, {}))
   items.append(("feed_bench", [PY, "tools/feed_bench.py"], 420, {}))
-  items.append(("serve_bench", [PY, "tools/serve_bench.py"], 900, {}))
+  for cfg in ("gqa4", "mha", "gqa4_kv8", "mqa", "mha_dense_prefill",
+              "spec_self_k4"):
+    items.append(("serve_" + cfg,
+                  [PY, "tools/serve_bench.py", "--configs", cfg], 330, {}))
   for sel in ("flash_f32:2", "flash_f32:3", "flash_f32:4"):
     items.append(val(sel))
   return items
@@ -125,10 +128,12 @@ def _save_state(st):
 
 
 def _cache_env():
-  if os.environ.get("TOS_BENCH_CACHE_DIR") == "":
+  override = os.environ.get("TOS_BENCH_CACHE_DIR")
+  if override == "":
     return {}
   return {
-      "JAX_COMPILATION_CACHE_DIR": os.path.join(ART, "xla_cache"),
+      "JAX_COMPILATION_CACHE_DIR": override or os.path.join(ART,
+                                                            "xla_cache"),
       "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
       "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
   }
@@ -145,7 +150,12 @@ def probe(timeout_s):
   if res.returncode != 0:
     return False, "rc=%d %s" % (res.returncode,
                                 res.stderr.strip()[-160:].replace("\n", "|"))
-  return True, res.stdout.strip()
+  out = res.stdout.strip()
+  # a CPU-fallback init must never count as a window: every row captured
+  # through it would pose as on-chip evidence
+  if not out.startswith("tpu"):
+    return False, "non-TPU backend answered: %s" % out
+  return True, out
 
 
 def run_item(name, argv, budget, env_extra, st):
@@ -155,12 +165,13 @@ def run_item(name, argv, budget, env_extra, st):
   log_path = os.path.join(MICRO, name + ".log")
   _log("item %s start (budget %ds)" % (name, budget))
   t0 = time.time()
+  timed_out = False
   try:
     res = subprocess.run(argv, timeout=budget, capture_output=True,
                          text=True, cwd=REPO, env=env)
     rc, out, err = res.returncode, res.stdout, res.stderr
   except subprocess.TimeoutExpired as e:
-    rc = -9
+    rc, timed_out = -9, True
     out = e.stdout if isinstance(e.stdout, str) else (
         (e.stdout or b"").decode(errors="replace"))
     err = "TIMEOUT after %ds" % budget
@@ -175,7 +186,7 @@ def run_item(name, argv, budget, env_extra, st):
   rec["last_rc"] = rc
   rec["last_ts"] = _now()
   rec["last_dt_s"] = round(dt, 1)
-  if rc == -9:
+  if timed_out:
     rec["timeouts"] += 1
     rec["status"] = "retry"
   elif rc == 0:
@@ -183,11 +194,21 @@ def run_item(name, argv, budget, env_extra, st):
     tail = (out or "").strip().splitlines()
     rec["tail"] = tail[-1][:400] if tail else ""
   else:
-    # a real (non-timeout) failure IS evidence — a Mosaic rejection to
-    # fix. Record it done-with-error; reset via --reset <item> after the
-    # fix lands.
-    rec["status"] = "error"
-    rec["tail"] = ((err or "").strip().splitlines() or [""])[-1][:400]
+    # a nonzero exit is only evidence (a Mosaic rejection to fix) if the
+    # chip is still up — the same window closing mid-item ALSO surfaces
+    # as a fast device-loss failure, which must stay retryable or one
+    # closed window cascades every queued item into permanent 'error'
+    ok, detail = probe(60)
+    if ok:
+      rec["status"] = "error"
+      rec["tail"] = ((err or "").strip().splitlines() or [""])[-1][:400]
+    else:
+      rec["timeouts"] += 1
+      # "retry_down": the post-failure probe already confirmed the window
+      # closed, so drain() must not burn another 60s re-probing
+      rec["status"] = "retry_down"
+      rec["tail"] = "failed as window closed (%s): %s" % (
+          detail[:80], ((err or "").strip().splitlines() or [""])[-1][:200])
   _save_state(st)
   _log("item %s rc=%d dt=%.1fs status=%s" % (name, rc, dt, rec["status"]))
   return rec["status"]
@@ -218,6 +239,8 @@ def drain(st, max_items=0):
       return n_done, False
     name, argv, budget, env_extra, _ = todo[0]
     status = run_item(name, argv, budget, env_extra, st)
+    if status == "retry_down":
+      return n_done, False   # run_item's probe already saw the window close
     if status == "retry":
       # window likely closed mid-item; cheap re-probe decides
       ok, detail = probe(60)

@@ -103,15 +103,29 @@ def main():
   ap.add_argument("--batch", type=int, default=8)
   ap.add_argument("--prompt", type=int, default=128)
   ap.add_argument("--steps", type=int, default=128)
+  ap.add_argument("--configs", default=None,
+                  help="comma list of config names to measure (default: "
+                       "all) — one config per subprocess fits a short "
+                       "claim window (tools/micro_capture.py)")
   args = ap.parse_args()
   if os.environ.get("TOS_BENCH_SMOKE"):
     args.batch, args.prompt, args.steps = 2, 16, 16
+  wanted = (set(c.strip() for c in args.configs.split(",") if c.strip())
+            if args.configs else None)
 
   # grouped config sized off the model's head count so the smoke shape
   # (4 heads) still exercises a genuinely grouped cache (kv < heads)
   h = _bench.TFM_HEADS
   kv_g = 4 if h % 4 == 0 and h > 4 else max(1, h // 2)
   results = {}
+  all_names = ["mha", "gqa%d" % kv_g, "mqa", "gqa%d_kv8" % kv_g,
+               "mha_dense_prefill", "spec_self_k4"]
+  if wanted is not None:
+    unknown = wanted - set(all_names)
+    if unknown:
+      sys.stderr.write("unknown --configs %s; valid: %s\n"
+                       % (sorted(unknown), all_names))
+      sys.exit(2)
   for name, kw in (("mha", {}),
                    ("gqa%d" % kv_g, {"num_kv_heads": kv_g}),
                    ("mqa", {"num_kv_heads": 1}),
@@ -123,6 +137,8 @@ def main():
                    # dense einsum: the delta vs "mha" (flash prefill on
                    # chip via "auto") isolates the prefill fast path
                    ("mha_dense_prefill", {"attention_impl": "dense"})):
+    if wanted is not None and name not in wanted:
+      continue
     try:
       tok_s, prefill_ms = measure(kw, args.batch, args.prompt, args.steps)
       results[name] = {"decode_tok_s": round(tok_s, 1),
@@ -130,13 +146,15 @@ def main():
     except Exception as e:  # noqa: BLE001 - record, keep measuring
       results[name] = {"error": str(e)[:200]}
     sys.stderr.write("serve %s: %r\n" % (name, results[name]))
-  try:
-    results["spec_self_k4"] = {
-        "decode_tok_s": round(
-            measure_speculative(args.batch, args.prompt, args.steps), 1)}
-  except Exception as e:  # noqa: BLE001
-    results["spec_self_k4"] = {"error": str(e)[:200]}
-  sys.stderr.write("serve spec_self_k4: %r\n" % (results["spec_self_k4"],))
+  if wanted is None or "spec_self_k4" in wanted:
+    try:
+      results["spec_self_k4"] = {
+          "decode_tok_s": round(
+              measure_speculative(args.batch, args.prompt, args.steps), 1)}
+    except Exception as e:  # noqa: BLE001
+      results["spec_self_k4"] = {"error": str(e)[:200]}
+    sys.stderr.write("serve spec_self_k4: %r\n"
+                     % (results["spec_self_k4"],))
   print(json.dumps({
       "metric": "kv_decode_tokens_per_sec",
       "batch": args.batch, "prompt": args.prompt, "steps": args.steps,

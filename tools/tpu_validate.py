@@ -638,7 +638,17 @@ def main(argv=None):
   if args.select:
     for spec in args.select.split(","):
       fam, _, idx = spec.strip().partition(":")
+      if fam not in families:
+        print("unknown --select family %r; valid: %s"
+              % (fam, sorted(families)), file=sys.stderr)
+        return 2
       shapes, runner = families[fam]
+      if idx and shapes is not None and not 0 <= int(idx) < len(shapes):
+        print("--select %s: shape index out of range (family has %d "
+              "shapes%s)" % (spec, len(shapes),
+                             "; note --quick shrinks the lists"
+                             if args.quick else ""), file=sys.stderr)
+        return 2
       if shapes is None:
         runner(None)
       elif idx:
