@@ -589,6 +589,30 @@ def t_pipeline_gpipe():
   return fn, (_sh(4, d, d, dtype=jnp.float32), _sh(16, d, dtype=jnp.float32))
 
 
+def t_resnet_bench():
+  """The headline bench computation itself (bench._bench_resnet: ResNet-50
+  train_step at batch 128 / 224x224) compiled against the 1-device
+  topology. Two jobs: prove the conv stack lowers, and pre-bank the
+  round's most expensive executable in the persistent XLA cache — on this
+  1-CPU image a cold ResNet-50 compile has eaten an entire claim window
+  (BENCH_WATCH.log 03:45), so warming it devicelessly converts window
+  time from compiling to measuring."""
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import resnet
+  mesh = _mesh1()
+  repl = _repl(mesh)
+  model = resnet.ResNet50(num_classes=1000)
+  abs_state = jax.eval_shape(
+      lambda: resnet.create_state(jax.random.PRNGKey(0), model,
+                                  image_shape=(224, 224, 3)))
+  fn = jax.jit(resnet.train_step, in_shardings=(repl, repl, repl),
+               out_shardings=repl)
+  images = jax.ShapeDtypeStruct((128, 224, 224, 3), jnp.float32)
+  labels = jax.ShapeDtypeStruct((128,), jnp.int32)
+  return fn, (abs_state, images, labels)
+
+
 TARGETS = {
     "flash_mha_fwd": t_flash_mha_fwd,
     "flash_mha_fused_bwd": t_flash_mha_fused_bwd,
@@ -617,6 +641,7 @@ TARGETS = {
     "pipeline_gpipe": t_pipeline_gpipe,
     "train_step_pod": t_train_step_pod,
     "ring_attention_pod": t_ring_attention_pod,
+    "resnet_bench": t_resnet_bench,
 }
 
 
