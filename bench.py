@@ -175,8 +175,10 @@ def _emit(value, unit="images/sec/chip", metric="resnet50_train_throughput",
   sys.stdout.flush()
 
 
-BANK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "bench_artifacts", "bench_bank.json")
+BANK_PATH = os.environ.get(
+    "TOS_BENCH_BANK_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_artifacts", "bench_bank.json"))
 
 
 def _read_bank():

@@ -1,0 +1,155 @@
+"""Micro-capture queue + bench bank: the round's claim-window machinery.
+
+These paths decide what BENCH_r05.json says if the driver's bench run
+lands in a claim-service outage, so they are pinned as carefully as the
+framework itself: bank provenance (never CPU/smoke numbers), staleness
+bounds, honest exit codes, and the queue's window-closed-vs-real-error
+discrimination.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import micro_capture  # noqa: E402
+
+
+# ---------------------------------------------------------------- queue
+
+def test_pending_skips_done_and_error_and_rotates_timeouts():
+  st = {
+      "smoke": {"status": "done"},
+      "kern_lnmm_1": {"status": "error"},
+      "kern_gelu_1": {"status": "retry", "timeouts": 2},
+      "kern_gqa_0": {"status": "retry_down", "timeouts": 0},
+  }
+  names = [it[0] for it in micro_capture.pending(st)]
+  assert "smoke" not in names
+  assert "kern_lnmm_1" not in names
+  # fewer timeouts sorts first; the 2-timeout item rotates behind
+  assert names.index("kern_gqa_0") < names.index("kern_gelu_1")
+  # everything not recorded is pending
+  assert "bench_resnet" in names
+
+
+def test_run_item_statuses(tmp_path, monkeypatch):
+  monkeypatch.setattr(micro_capture, "MICRO", str(tmp_path))
+  monkeypatch.setattr(micro_capture, "STATE",
+                      str(tmp_path / "state.json"))
+  monkeypatch.setattr(micro_capture, "LOG", str(tmp_path / "log"))
+
+  st = {}
+  ok = micro_capture.run_item(
+      "ok", [sys.executable, "-c", "print('fine')"], 30, {}, st)
+  assert ok == "done" and st["ok"]["tail"] == "fine"
+
+  # nonzero exit while the "chip" is still up -> permanent error
+  monkeypatch.setattr(micro_capture, "probe", lambda t: (True, "tpu 1"))
+  bad = micro_capture.run_item(
+      "bad", [sys.executable, "-c", "raise SystemExit(7)"], 30, {}, st)
+  assert bad == "error" and st["bad"]["last_rc"] == 7
+
+  # same exit with the window gone -> retryable, probe already consumed
+  monkeypatch.setattr(micro_capture, "probe", lambda t: (False, "down"))
+  lost = micro_capture.run_item(
+      "lost", [sys.executable, "-c", "raise SystemExit(7)"], 30, {}, st)
+  assert lost == "retry_down" and st["lost"]["timeouts"] == 1
+
+  # parent-timeout kill -> retry (drain decides with its own probe)
+  hung = micro_capture.run_item(
+      "hung", [sys.executable, "-c", "import time; time.sleep(60)"],
+      2, {}, st)
+  assert hung == "retry" and st["hung"]["timeouts"] == 1
+
+
+def test_aggregate_keeps_latest_row_per_kernel(tmp_path, monkeypatch,
+                                               capsys):
+  monkeypatch.setattr(micro_capture, "KERNELS_JSONL",
+                      str(tmp_path / "kernels.jsonl"))
+  monkeypatch.setattr(micro_capture, "REPO", str(tmp_path))
+  rows = [dict(kernel="a", ok=False, error="first try"),
+          dict(kernel="b", ok=True),
+          dict(kernel="a", ok=True)]   # later row supersedes
+  with open(tmp_path / "kernels.jsonl", "w") as f:
+    for r in rows:
+      f.write(json.dumps(r) + "\n")
+  assert micro_capture.aggregate() == 0
+  doc = json.load(open(tmp_path / "TPU_KERNELS.json"))
+  by = {r["kernel"]: r for r in doc["results"]}
+  assert len(doc["results"]) == 2 and by["a"]["ok"]
+
+
+def test_cache_env_honors_override_and_disable(monkeypatch):
+  monkeypatch.delenv("TOS_BENCH_CACHE_DIR", raising=False)
+  assert micro_capture._cache_env()["JAX_COMPILATION_CACHE_DIR"].endswith(
+      "xla_cache")
+  monkeypatch.setenv("TOS_BENCH_CACHE_DIR", "/elsewhere")
+  assert (micro_capture._cache_env()["JAX_COMPILATION_CACHE_DIR"]
+          == "/elsewhere")
+  monkeypatch.setenv("TOS_BENCH_CACHE_DIR", "")
+  assert micro_capture._cache_env() == {}
+
+
+# ------------------------------------------------------------ bench bank
+
+def _run_bench(tmp_path, bank=None, env_extra=None):
+  """Run bench.py with an unreachable device and a controlled bank."""
+  bank_path = tmp_path / "bench_bank.json"
+  if bank is not None:
+    bank_path.write_text(json.dumps(bank))
+  env = {k: v for k, v in os.environ.items()
+         if k != "PALLAS_AXON_POOL_IPS"}
+  env.update({"TOS_BENCH_PREFLIGHT_BUDGET": "10",
+              "TOS_BENCH_BANK_PATH": str(bank_path),
+              "JAX_PLATFORMS": "axon"})   # unregistered -> fails fast
+  env.update(env_extra or {})
+  res = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=120)
+  line = res.stdout.strip().splitlines()[-1] if res.stdout.strip() else ""
+  return res.returncode, (json.loads(line) if line else None)
+
+
+@pytest.fixture()
+def fresh_ts():
+  import datetime
+  return datetime.datetime.now().isoformat(timespec="seconds")
+
+
+def test_bank_fallback_emits_banked_value(tmp_path, fresh_ts):
+  rc, out = _run_bench(tmp_path, bank={
+      "value": 321.5, "value_captured": fresh_ts,
+      "extra": {"transformer_mfu": 0.5}})
+  assert rc == 0
+  assert out["value"] == 321.5
+  assert out["extra"]["banked_measurement"] is True
+  assert "REAL-CHIP" in out["note"]
+
+
+def test_stale_bank_is_refused(tmp_path):
+  rc, out = _run_bench(tmp_path, bank={
+      "value": 321.5, "value_captured": "2026-07-01T00:00:00"})
+  assert rc == 3
+  assert out["value"] == 0.0
+  assert "preflight failed" in out["note"]
+
+
+def test_extras_only_bank_keeps_failure_exit(tmp_path, fresh_ts):
+  rc, out = _run_bench(tmp_path, bank={
+      "extra": {"transformer_tokens_per_sec": 9},
+      "extra_captured": fresh_ts})
+  assert rc == 3
+  assert out["value"] == 0.0
+  assert out["extra"]["banked_measurement"] is True
+  assert out["extra"]["transformer_tokens_per_sec"] == 9
+
+
+def test_no_bank_plain_failure(tmp_path):
+  rc, out = _run_bench(tmp_path)
+  assert rc == 3
+  assert out["value"] == 0.0 and "extra" not in out
