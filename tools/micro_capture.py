@@ -280,6 +280,11 @@ def aggregate():
   for r in results:
     if not r.get("ok"):
       print("FAIL %s: %s" % (r.get("kernel"), r.get("error", "?")[:160]))
+  st = _load_state()
+  for name, rec in sorted(st.items()):
+    if name.startswith(("bench_", "serve_", "feed")) \
+        and rec.get("status") == "done":
+      print("%s: %s" % (name, rec.get("tail", "")[:240]))
   return 0
 
 
