@@ -136,7 +136,35 @@ def _cache_env():
   }
 
 
-def probe(timeout_s):
+def _relay_port_open(timeout_s=2.0):
+  """Fast pre-check: is the local claim relay even listening?
+
+  The 'claim service' behind the axon plugin is a loopback relay
+  (AXON_LOOPBACK_RELAY; jax.devices() rides 127.0.0.1:8083). During the
+  multi-hour outages the listener is GONE (connection refused), so a
+  millisecond TCP connect distinguishes 'down' from 'up-but-slow' without
+  burning a 120s jax probe — which in turn lets the watch cadence drop to
+  seconds. Env-overridable port list (TOS_AXON_PROBE_PORTS): require every
+  listed port to accept, default just the devices RPC port.
+  """
+  import socket
+  ports = [int(p) for p in os.environ.get("TOS_AXON_PROBE_PORTS",
+                                          "8083").split(",") if p]
+  for port in ports:
+    s = socket.socket()
+    s.settimeout(timeout_s)
+    try:
+      s.connect(("127.0.0.1", port))
+    except OSError:
+      return False
+    finally:
+      s.close()
+  return True
+
+
+def probe(timeout_s, skip_fast_check=False):
+  if not skip_fast_check and not _relay_port_open():
+    return False, "relay port closed (fast check)"
   code = ("import jax; ds = jax.devices(); "
           "print(ds[0].platform, len(ds))")
   try:
@@ -290,10 +318,11 @@ def aggregate():
 
 def main():
   ap = argparse.ArgumentParser()
-  ap.add_argument("--interval", type=int, default=45,
-                  help="seconds between probes while down — short: a "
-                       "window lasts minutes, and detection lag comes "
-                       "off the top of it")
+  ap.add_argument("--interval", type=int, default=10,
+                  help="seconds between probes while down — the fast "
+                       "TCP pre-check makes a down-probe nearly free, "
+                       "and detection lag comes straight off the top "
+                       "of a minutes-long window")
   ap.add_argument("--probe-timeout", type=int, default=120)
   ap.add_argument("--once", action="store_true")
   ap.add_argument("--status", action="store_true")
@@ -322,12 +351,23 @@ def main():
     return 0
 
   n = 0
+  fast_fails = 0
   _log("micro-capture start pid=%d interval=%ds" % (os.getpid(),
                                                     args.interval))
   while True:
     n += 1
     ok, detail = probe(args.probe_timeout)
-    _log("probe %d: %s — %s" % (n, "OK" if ok else "down", detail))
+    if not ok and detail.endswith("(fast check)"):
+      # at a 10s cadence the refused-connect probes would flood the log;
+      # keep transitions and a heartbeat every ~10 minutes
+      fast_fails += 1
+      if fast_fails == 1 or fast_fails % 60 == 0:
+        _log("probe %d: down — %s (x%d)" % (n, detail, fast_fails))
+    else:
+      if fast_fails:
+        _log("relay listener back after %d fast-fail probes" % fast_fails)
+      fast_fails = 0
+      _log("probe %d: %s — %s" % (n, "OK" if ok else "down", detail))
     if ok:
       n_done, empty = drain(st)
       _log("window closed after %d item(s)%s"
