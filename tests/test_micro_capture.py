@@ -96,6 +96,53 @@ def test_cache_env_honors_override_and_disable(monkeypatch):
   assert micro_capture._cache_env() == {}
 
 
+def test_drain_stops_on_window_close_and_completes_queue(monkeypatch):
+  calls = []
+
+  def fake_items():
+    return [("a", ["x"], 5, {}), ("b", ["x"], 5, {}), ("c", ["x"], 5, {})]
+
+  monkeypatch.setattr(micro_capture, "_items", fake_items)
+
+  # window closes during item b (probe-confirmed): drain returns without
+  # touching c
+  st = {}
+  outcomes = {"a": "done", "b": "retry_down"}
+
+  def fake_run(name, argv, budget, env_extra, state):
+    calls.append(name)
+    state[name] = {"status": outcomes.get(name, "done")}
+    return outcomes.get(name, "done")
+
+  monkeypatch.setattr(micro_capture, "run_item", fake_run)
+  n_done, empty = micro_capture.drain(st)
+  assert (n_done, empty) == (1, False) and calls == ["a", "b"]
+
+  # next window: b retries and succeeds, c runs -> queue complete
+  calls.clear()
+  outcomes["b"] = "done"
+  n_done, empty = micro_capture.drain(st)
+  assert empty and calls == ["b", "c"]
+
+  # a timeout with the probe still up keeps draining the next item
+  st2 = {}
+  outcomes2 = {"a": "retry"}
+
+  def fake_run2(name, argv, budget, env_extra, state):
+    calls.append(name)
+    state[name] = {"status": outcomes2.get(name, "done"),
+                   "timeouts": 1 if outcomes2.get(name) else 0}
+    return outcomes2.get(name, "done")
+
+  calls.clear()
+  monkeypatch.setattr(micro_capture, "run_item", fake_run2)
+  monkeypatch.setattr(micro_capture, "probe", lambda t: (True, "tpu 1"))
+  n_done, empty = micro_capture.drain(st2, max_items=2)
+  # a rotates behind b/c after its timeout but is still pending
+  assert n_done == 2 and calls == ["a", "b", "c"]
+  assert st2["a"]["status"] == "retry"
+
+
 # ------------------------------------------------------------ bench bank
 
 def _run_bench(tmp_path, bank=None, env_extra=None):
