@@ -96,7 +96,12 @@ def test_cache_env_honors_override_and_disable(monkeypatch):
   assert micro_capture._cache_env() == {}
 
 
-def test_drain_stops_on_window_close_and_completes_queue(monkeypatch):
+def test_drain_stops_on_window_close_and_completes_queue(monkeypatch,
+                                                         tmp_path):
+  # drain logs through _log: point it at a scratch file or the fake
+  # events ("probe OK") land in the REAL MICRO_CAPTURE.log and read as
+  # chip contact (this happened; the log was scrubbed)
+  monkeypatch.setattr(micro_capture, "LOG", str(tmp_path / "log"))
   calls = []
 
   def fake_items():
