@@ -372,6 +372,14 @@ def main():
       n_done, empty = drain(st)
       _log("window closed after %d item(s)%s"
            % (n_done, "; QUEUE COMPLETE" if empty else ""))
+      if n_done and os.path.exists(KERNELS_JSONL):
+        # fold fresh kernel rows into the canonical artifact right away:
+        # an unattended window must still leave TPU_KERNELS.json current
+        # (the driver commits uncommitted work at round end)
+        try:
+          aggregate()
+        except Exception as e:  # noqa: BLE001 - never kill the watch
+          _log("aggregate after window failed: %r" % (e,))
       if empty:
         return 0
     if args.once:
