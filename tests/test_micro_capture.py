@@ -102,6 +102,8 @@ def test_drain_stops_on_window_close_and_completes_queue(monkeypatch,
   # events ("probe OK") land in the REAL MICRO_CAPTURE.log and read as
   # chip contact (this happened; the log was scrubbed)
   monkeypatch.setattr(micro_capture, "LOG", str(tmp_path / "log"))
+  monkeypatch.setattr(micro_capture, "_foreign_bench_running",
+                      lambda: False)
   calls = []
 
   def fake_items():

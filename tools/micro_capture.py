@@ -183,6 +183,55 @@ def probe(timeout_s, skip_fast_check=False):
   return True, out
 
 
+def _foreign_bench_running():
+  """True when a bench.py process NOT descended from this watcher exists.
+
+  The driver's end-of-round `python bench.py` is the graded artifact; if
+  the relay comes back while both it and this watcher are alive, the
+  watcher claiming the single chip could starve the driver's one window.
+  The watcher's own bench items are bench.py children of this process —
+  exclude by walking ppids.
+  """
+  me = os.getpid()
+
+  def _ancestors(pid):
+    seen = []
+    for _ in range(16):
+      try:
+        with open("/proc/%d/stat" % pid) as f:
+          ppid = int(f.read().split(")")[-1].split()[1])
+      except (OSError, ValueError, IndexError):
+        return seen
+      seen.append(ppid)
+      if ppid <= 1:
+        return seen
+      pid = ppid
+    return seen
+
+  for pid_dir in os.listdir("/proc"):
+    if not pid_dir.isdigit():
+      continue
+    pid = int(pid_dir)
+    if pid == me:
+      continue
+    try:
+      with open("/proc/%d/cmdline" % pid, "rb") as f:
+        argv_toks = [t.decode(errors="replace")
+                     for t in f.read().split(b"\0") if t]
+    except OSError:
+      continue
+    # exact-argv match only: the driver harness's own cmdline CONTAINS
+    # the string "bench.py" inside prompt text, and the watcher's
+    # serve_/feed_bench children end with *_bench.py — neither is the
+    # driver's `python bench.py`
+    if (len(argv_toks) >= 2
+        and os.path.basename(argv_toks[0]).startswith("python")
+        and any(os.path.basename(t) == "bench.py" for t in argv_toks[1:3])):
+      if me not in _ancestors(pid):
+        return True
+  return False
+
+
 def run_item(name, argv, budget, env_extra, st):
   env = dict(os.environ)
   env.update(_cache_env())
@@ -256,6 +305,10 @@ def drain(st, max_items=0):
   """Run pending items while the window stays healthy."""
   n_done = 0
   while True:
+    if _foreign_bench_running():
+      _log("standing down: a foreign bench.py is running (driver's "
+           "graded window takes priority over the queue)")
+      return n_done, False
     todo = pending(st)
     if not todo:
       _log("queue empty — all items done or errored")
@@ -356,6 +409,11 @@ def main():
                                                     args.interval))
   while True:
     n += 1
+    if _relay_port_open() and _foreign_bench_running():
+      _log("probe skipped: relay up but a foreign bench.py is running — "
+           "not claiming against the driver's window")
+      time.sleep(args.interval)
+      continue
     ok, detail = probe(args.probe_timeout)
     if not ok and detail.endswith("(fast check)"):
       # at a 10s cadence the refused-connect probes would flood the log;
