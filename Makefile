@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint native bench dryrun mosaic-gate validate clean
+.PHONY: test lint native bench dryrun mosaic-gate validate clean chaos
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -17,6 +17,12 @@ lint:
 
 test: lint
 	$(PY) -m pytest tests/ -q
+
+# fault-injection suite only: kill/relaunch/resume/requeue recovery paths
+# driven by utils/chaos.py (the tests also run inside `make test` — they
+# are tier-1, not slow)
+chaos:
+	$(PY) -m pytest tests/ -q -m chaos
 
 native:
 	$(MAKE) -C native

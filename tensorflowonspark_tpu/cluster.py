@@ -23,13 +23,300 @@ import os
 import random
 import signal
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 from tensorflowonspark_tpu import node as node_mod
 from tensorflowonspark_tpu.control import feedhub, rendezvous
-from tensorflowonspark_tpu.engine.base import Engine
+from tensorflowonspark_tpu.engine.base import Engine, is_executor_lost
 
 logger = logging.getLogger(__name__)
+
+
+class ClusterSupervisor(object):
+  """Driver-side node babysitter: detect dead nodes, relaunch, requeue.
+
+  Two failure signals are watched:
+
+  - **liveness**: executors whose heartbeats stopped past the missed-beat
+    deadline (``rendezvous.Liveness`` — a SIGKILL, OOM kill, or TPU-pod
+    preemption stops the beats without any traceback);
+  - **engine**: node tasks that died WITH their executor (errors carrying
+    the ``ExecutorLost`` marker from ``engine.base``).
+
+  Application exceptions (a user fn raising) are NOT retried — they
+  propagate exactly as without supervision; restarting a deterministic
+  failure is futile and hides bugs. For restartable failures the recovery
+  sequence is:
+
+  1. back off (exponential with full jitter, capped at ``backoff_cap``;
+     the attempt budget is ``max_restarts`` per executor);
+  2. mark the dead node's hub ``dead`` and drain its undelivered feed
+     rows (``datafeed.drain_pending_rows``) so blocked feeders complete
+     and no delivered-but-unprocessed data is lost;
+  3. relaunch the node task via ``Engine.relaunch_task``, handing the
+     restart count to the new node (→ ``ctx.restart_count``; the user fn
+     resumes via ``CheckpointManager.restore_or``);
+  4. await re-registration, patch ``cluster_info`` in place (feed tasks
+     submitted afterwards see the new hub), and refeed the drained rows
+     through the engine feed path.
+
+  Recoveries run serially on the supervisor thread — deterministic, and
+  the backoff budget bounds total recovery time. ``wait_idle()`` lets
+  callers (tests, pre-shutdown hooks) block until no recovery is active.
+  """
+
+  def __init__(self, engine: Engine, server: rendezvous.Server,
+               node_job, cluster_meta: dict, cluster_info: List[dict],
+               engine_ids: Sequence[int], tf_status: dict,
+               max_restarts: int = 2, backoff: float = 0.5,
+               backoff_cap: float = 5.0):
+    self.engine = engine
+    self.server = server
+    self.node_job = node_job
+    self.cluster_meta = cluster_meta
+    self.cluster_info = cluster_info
+    self.tf_status = tf_status
+    self.max_restarts = max_restarts
+    self.backoff = backoff
+    self.backoff_cap = backoff_cap
+    self._eid_task = {eid: i for i, eid in enumerate(engine_ids)}
+    self._attempts: Dict[int, int] = {}
+    self._given_up: set = set()
+    #: executor_id -> completed restart count (observability)
+    self.restarts: Dict[int, int] = {}
+    #: recovery event log: dicts with executor_id / kind / t (monotonic)
+    self.events: List[dict] = []
+    self._stop = threading.Event()
+    self._idle = threading.Event()
+    self._idle.set()
+    self._thread: Optional[threading.Thread] = None
+
+  # -- lifecycle -------------------------------------------------------------
+
+  def start(self) -> "ClusterSupervisor":
+    self._thread = threading.Thread(target=self._loop, daemon=True,
+                                    name="cluster-supervisor")
+    self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=30)
+
+  def wait_idle(self, timeout: float = 60.0) -> bool:
+    """Block until no recovery is in flight AND no failure is pending
+    detection right now; True if idle within ``timeout``."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+      if self._idle.is_set() and not self._failed_executors():
+        return True
+      time.sleep(0.05)
+    return False
+
+  # -- detection -------------------------------------------------------------
+
+  def _failed_executors(self) -> List[int]:
+    if self.server.done.is_set():
+      # the rendezvous server stopped serving (streaming stop / shutdown):
+      # beats — including goodbyes — can no longer arrive, so silence is
+      # not death; report nothing (mirrors the _loop stand-down, and keeps
+      # wait_idle from stalling shutdown over phantom deaths)
+      return []
+    failed = set(self.server.liveness.dead())
+    for task_id, err in enumerate(self.node_job.errors):
+      if is_executor_lost(err):
+        for eid, t in self._eid_task.items():
+          if t == task_id:
+            failed.add(eid)
+    return sorted(e for e in failed
+                  if e in self._eid_task and e not in self._given_up)
+
+  def _loop(self) -> None:
+    interval = self.cluster_meta.get("heartbeat_interval") or 5.0
+    poll = max(0.05, min(1.0, interval / 4.0))
+    while not self._stop.wait(poll):
+      if self.server.done.is_set():
+        # the rendezvous server stopped serving (streaming stop signal /
+        # shutdown): heartbeats can no longer arrive, so silence is not
+        # death — stand down instead of relaunching healthy nodes
+        continue
+      for eid in self._failed_executors():
+        if self._stop.is_set():
+          return
+        self._idle.clear()
+        try:
+          self._recover(eid)
+        except Exception:  # noqa: BLE001 - supervisor must survive anything
+          logger.exception("recovery of executor %d failed", eid)
+        finally:
+          self._idle.set()
+
+  # -- recovery --------------------------------------------------------------
+
+  def _recover(self, eid: int) -> None:
+    attempt = self._attempts.get(eid, 0)
+    self.events.append({"executor_id": eid, "kind": "detected-dead",
+                        "attempt": attempt, "t": time.monotonic()})
+    try:
+      job_name, _ = node_mod._role_of(eid, self.cluster_meta["cluster_template"])
+    except ValueError:
+      job_name = "worker"
+    if job_name in node_mod.BACKGROUND_ROLES:
+      # ps/evaluator bring-up tasks park on the hub control queue for the
+      # cluster's whole life — a pinned relaunch could never schedule
+      # behind the (healthy) foreground owner, and the replacement would
+      # park on a fresh control queue shutdown never signals. Surface the
+      # death instead of restarting (parity: the reference reported ps
+      # failures at shutdown; supervised restart covers the JAX roles).
+      self._given_up.add(eid)
+      msg = ("%s node on executor %d died (background-role nodes are not "
+             "relaunched; failure will surface at shutdown)"
+             % (job_name, eid))
+      logger.error(msg)
+      self.events.append({"executor_id": eid, "kind": "skipped-background",
+                          "t": time.monotonic()})
+      if self.tf_status.get("error") is None:
+        self.tf_status["error"] = msg
+      return
+    if attempt >= self.max_restarts:
+      self._given_up.add(eid)
+      msg = ("executor %d declared dead after %d restart attempt(s); "
+             "restart budget (max_restarts=%d) exhausted"
+             % (eid, attempt, self.max_restarts))
+      logger.error(msg)
+      self.events.append({"executor_id": eid, "kind": "gave-up",
+                          "t": time.monotonic()})
+      # the node task may have completed OK long ago (ENGINE mode: the
+      # bring-up task returns before the background fn dies) — make sure
+      # shutdown still raises
+      if self.tf_status.get("error") is None:
+        self.tf_status["error"] = msg
+      return
+    self._attempts[eid] = attempt + 1
+    self.server.liveness.mark_restarting(eid)
+    # exponential backoff with full jitter, hard-capped: no recovery-path
+    # sleep ever exceeds backoff_cap
+    delay = min(self.backoff_cap, self.backoff * (2 ** attempt))
+    delay *= 0.5 + random.random()
+    if self._stop.wait(min(delay, self.backoff_cap)):
+      return
+
+    old_meta = next((n for n in self.cluster_info
+                     if n["executor_id"] == eid), None)
+    pending = self._quarantine_dead_hub(old_meta)
+
+    task_id = self._eid_task[eid]
+    if not self.node_job._completed[task_id]:
+      # the node task never finished — a hung user fn (liveness-dead but
+      # process alive) would keep its executor busy forever and a pinned
+      # relaunch could never schedule; kill the executor so the engine
+      # fails the attempt and recycles the slot first
+      if self.engine.preempt_task(self.node_job, task_id):
+        deadline = time.monotonic() + 10
+        while not self.node_job._completed[task_id] \
+            and time.monotonic() < deadline and not self._stop.is_set():
+          time.sleep(0.05)
+    logger.warning("relaunching node on executor %d (attempt %d/%d, "
+                   "%d feed row(s) requeued)", eid, attempt + 1,
+                   self.max_restarts, sum(map(len, pending.values())))
+    self.engine.relaunch_task(self.node_job, task_id,
+                              payload={"executor_id": eid,
+                                       "restart": attempt + 1})
+    self.events.append({"executor_id": eid, "kind": "relaunched",
+                        "attempt": attempt + 1, "t": time.monotonic()})
+
+    reregistered = self._await_reregistration(eid, attempt + 1)
+    if reregistered:
+      self.restarts[eid] = attempt + 1
+      self.events.append({"executor_id": eid, "kind": "recovered",
+                          "t": time.monotonic()})
+    else:
+      # liveness/ExecutorLost will re-fire and consume another attempt,
+      # or the task error (a non-restartable bring-up failure) propagates
+      logger.warning("executor %d did not re-register after relaunch", eid)
+    if pending:
+      # refeed regardless of the relaunch outcome: the rescued rows go to
+      # whichever LIVE worker picks up the feed task, so a slow relaunch
+      # must not drop them
+      self._refeed(pending)
+
+  def _quarantine_dead_hub(self, old_meta: Optional[dict]) -> Dict[str, List]:
+    """Mark the dead node's hub unusable and rescue undelivered feed rows.
+
+    The hub manager is a separate process and routinely survives its
+    node's death; marking it ``dead`` makes the relaunched node's reclaim
+    check (node.py) treat it as stale, and the drain releases feeders
+    blocked on ``queue.join``. Best-effort: an unreachable hub (true for
+    remote workers' loopback hubs) just means nothing to rescue.
+    """
+    if old_meta is None:
+      return {}
+    try:
+      hub = feedhub.connect(tuple(old_meta["hub_addr"]),
+                            self.cluster_meta["authkey"])
+      hub.set("state", "dead")
+    except Exception:  # noqa: BLE001 - hub died with the node
+      return {}
+    pending: Dict[str, List] = {}
+    if self.cluster_meta.get("input_mode") == InputMode.ENGINE:
+      from tensorflowonspark_tpu.datafeed import drain_pending_rows
+      # every DATA queue, not just the default: train/inference accept a
+      # custom qname and those rows (and their blocked feeders) need the
+      # drain just as much
+      for qname in self.cluster_meta.get("queues", ("input",)):
+        if qname in ("error", "output", "control"):
+          continue
+        try:
+          rows = drain_pending_rows(hub, qname)
+        except Exception:  # noqa: BLE001 - manager vanished mid-drain
+          logger.warning("draining queue %r of executor %d's dead hub "
+                         "failed", qname, old_meta["executor_id"])
+          continue
+        if rows:
+          pending[qname] = rows
+    return pending
+
+  def _await_reregistration(self, eid: int, generation: int,
+                            timeout: float = 120.0) -> bool:
+    """Poll the reservation table until the relaunched node registered its
+    restart ``generation``; patch cluster_info in place on success. (The
+    pid alone can't identify the new incarnation: an ENGINE-mode relaunch
+    runs in the same executor process as its predecessor.)"""
+    deadline = time.monotonic() + min(
+        timeout, self.cluster_meta.get("reservation_timeout", timeout))
+    while time.monotonic() < deadline and not self._stop.is_set():
+      for n in self.server.reservations.get():
+        if n["executor_id"] == eid and n.get("restart") == generation:
+          for meta in self.cluster_info:
+            if meta["executor_id"] == eid:
+              meta.update(n)
+          return True
+      # a relaunch that failed bring-up for an application reason (not an
+      # executor loss) will never register — stop waiting and let the
+      # task error propagate
+      err = self.node_job.errors[self._eid_task[eid]]
+      if err is not None and not is_executor_lost(err):
+        return False
+      time.sleep(0.05)
+    return False
+
+  def _refeed(self, pending: Dict[str, List]) -> None:
+    """Requeue rescued feed rows through the engine feed path — one feed
+    task per drained queue, back into the SAME qname: they land on
+    whichever live worker picks the task up (at-least-once delivery for
+    rows the dead worker never processed)."""
+    for qname, rows in pending.items():
+      fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
+                                  qname=qname)
+      try:
+        self.engine.foreach_partition([rows], fn).wait(timeout=120)
+        logger.info("requeued %d feed row(s) into %r from the dead node",
+                    len(rows), qname)
+      except Exception as e:  # noqa: BLE001 - best-effort; loss is logged
+        logger.error("requeueing %d rescued feed row(s) into %r failed: %s",
+                     len(rows), qname, e)
 
 
 class InputMode(object):
@@ -62,7 +349,7 @@ class TPUCluster(object):
   def __init__(self, engine: Engine, cluster_info: List[dict],
                cluster_meta: dict, server: rendezvous.Server,
                input_mode: int, node_job, tf_status: dict,
-               driver_ps_procs: Sequence = ()):
+               driver_ps_procs: Sequence = (), supervisor=None):
     self.engine = engine
     self.cluster_info = cluster_info
     self.cluster_meta = cluster_meta
@@ -72,6 +359,7 @@ class TPUCluster(object):
     self.tf_status = tf_status
     self.queues = cluster_meta["queues"]
     self.driver_ps_procs = list(driver_ps_procs)
+    self.supervisor = supervisor
 
   # -- data plane ------------------------------------------------------------
 
@@ -310,8 +598,29 @@ class TPUCluster(object):
         p.terminate()
 
     # wait for the node bring-up job itself (foreground workers return when
-    # the user fn finishes); propagate node errors
+    # the user fn finishes); propagate node errors. The supervisor stays
+    # live until the job settles: a node death racing shutdown un-completes
+    # the job while its recovery runs, so drain recoveries (budget-bounded)
+    # and re-wait until the job is stably done, THEN stand the supervisor
+    # down before errors are read.
     self.node_job.wait(raise_on_error=False)
+    if self.supervisor is not None:
+      while True:
+        settled = self.supervisor.wait_idle(timeout=120)
+        if not settled:
+          # a recovery is still in flight after the drain budget: stopping
+          # the supervisor now interrupts it (the restarted task's error
+          # slot was cleared), so record the situation rather than letting
+          # shutdown report success over an unrecovered death
+          if self.tf_status.get("error") is None:
+            self.tf_status["error"] = (
+                "shutdown proceeded while a node recovery was still in "
+                "flight (supervisor busy past the drain budget)")
+          break
+        if self.node_job.done():
+          break
+        self.node_job.wait(raise_on_error=False)
+      self.supervisor.stop()
     self.server.stop()
     err = self.node_job.first_error() or self.tf_status.get("error")
     if err:
@@ -375,7 +684,11 @@ def run(engine: Engine, main_fn, tf_args=None,
         eval_node: bool = False, release_port: bool = True,
         chips_per_node: int = 0, qmax: int = 1024,
         feed_transport: str = "auto",
-        shm_capacity: int = 64 * 1024 * 1024) -> TPUCluster:
+        shm_capacity: int = 64 * 1024 * 1024,
+        heartbeat_interval: Optional[float] = 5.0,
+        supervise: bool = True, max_restarts: int = 2,
+        restart_backoff: float = 0.5,
+        restart_backoff_cap: float = 5.0) -> TPUCluster:
   """Start a cluster and run ``main_fn(tf_args, ctx)`` on every node.
 
   Signature parity with the reference's ``TFCluster.run``
@@ -384,6 +697,16 @@ def run(engine: Engine, main_fn, tf_args=None,
   ``driver_ps_nodes`` hosts the ps nodes on the driver machine so every
   engine executor keeps its accelerator for workers (parity :229,298-316;
   FILES input mode only, like the reference).
+
+  Fault tolerance: every node heartbeats the rendezvous server every
+  ``heartbeat_interval`` seconds (None disables); a node silent for 2
+  intervals is declared dead. With ``supervise=True`` a driver-side
+  :class:`ClusterSupervisor` relaunches dead nodes (executor killed,
+  preempted, OOM — NOT application exceptions, which propagate as
+  always) up to ``max_restarts`` times per executor, with exponential
+  backoff between ``restart_backoff`` and ``restart_backoff_cap``
+  seconds. Relaunched nodes see ``ctx.restart_count > 0`` and should
+  resume via ``ctx.checkpoint_manager(d).restore_or(state)``.
   """
   num_executors = num_executors or engine.num_executors
   if feed_transport == "auto":
@@ -433,7 +756,13 @@ def run(engine: Engine, main_fn, tf_args=None,
     cluster_template["worker"] = executors[idx:]
   logger.info("cluster template: %s", cluster_template)
 
-  server = rendezvous.Server(num_executors)
+  # startup grace = the reservation window: a node is allowed to sit
+  # between REG and its first own beat for as long as cluster assembly may
+  # legitimately take (executor deaths in that window are still caught by
+  # the engine's ExecutorLost signal)
+  server = rendezvous.Server(num_executors,
+                             heartbeat_interval=heartbeat_interval,
+                             startup_grace=reservation_timeout)
   server_addr = server.start()
 
   cluster_meta = {
@@ -456,6 +785,7 @@ def run(engine: Engine, main_fn, tf_args=None,
       # The default "auto" resolved above: shm on colocated engines.
       "feed_transport": feed_transport,
       "shm_capacity": max(shm_capacity, 8 * 1024 * 1024),
+      "heartbeat_interval": heartbeat_interval,
   }
 
   # launch node bring-up asynchronously so that (a) feeding can start and
@@ -489,10 +819,14 @@ def run(engine: Engine, main_fn, tf_args=None,
     # poll: a single failed bring-up task must surface its traceback
     # immediately (aborting await_reservations), not after the surviving
     # tasks run out their reservation timeout; driver-hosted ps processes
-    # get the same treatment (a crashed child has a nonzero exitcode)
-    import time as _time
+    # get the same treatment (a crashed child has a nonzero exitcode).
+    # Executor-death errors (the ExecutorLost marker) belong to the
+    # supervisor when one is running — it relaunches instead of aborting,
+    # and sets tf_status itself when the restart budget runs out.
     while not node_job.done():
       err = node_job.first_error()
+      if supervise and is_executor_lost(err):
+        err = None
       for p in driver_ps_procs:
         if p.exitcode not in (None, 0):
           err = err or ("driver ps process %s exited with code %s during "
@@ -500,18 +834,32 @@ def run(engine: Engine, main_fn, tf_args=None,
       if err:
         tf_status["error"] = err
         return
-      _time.sleep(0.25)
+      time.sleep(0.25)
     err = node_job.first_error()
-    if err:
+    if err and not (supervise and is_executor_lost(err)):
       tf_status["error"] = err
 
   threading.Thread(target=_watch_job, daemon=True,
                    name="node-job-watcher").start()
 
+  # the supervisor starts BEFORE the reservation wait so executors dying
+  # during bring-up are already relaunched (cluster_info is patched in
+  # place as nodes register); only engine-hosted nodes are supervised —
+  # driver_ps processes live on the driver machine outside any engine slot
+  cluster_info: List[dict] = []
+  supervisor = None
+  if supervise:
+    supervisor = ClusterSupervisor(
+        engine, server, node_job, cluster_meta, cluster_info, engine_ids,
+        tf_status, max_restarts=max_restarts, backoff=restart_backoff,
+        backoff_cap=restart_backoff_cap).start()
+
   try:
-    cluster_info = server.await_reservations(
-        timeout=reservation_timeout, status=tf_status)
+    cluster_info.extend(server.await_reservations(
+        timeout=reservation_timeout, status=tf_status))
   except Exception:
+    if supervisor is not None:
+      supervisor.stop()
     server.stop()
     for p in driver_ps_procs:
       p.terminate()
@@ -519,6 +867,8 @@ def run(engine: Engine, main_fn, tf_args=None,
 
   # duplicate-node sanity check (parity: TFCluster.py:357-372)
   if server.reservations.duplicates:
+    if supervisor is not None:
+      supervisor.stop()
     server.stop()
     for p in driver_ps_procs:
       p.terminate()
@@ -530,4 +880,5 @@ def run(engine: Engine, main_fn, tf_args=None,
               [(n["executor_id"], n["job_name"], n["task_index"])
                for n in cluster_info])
   return TPUCluster(engine, cluster_info, cluster_meta, server, input_mode,
-                    node_job, tf_status, driver_ps_procs=driver_ps_procs)
+                    node_job, tf_status, driver_ps_procs=driver_ps_procs,
+                    supervisor=supervisor)

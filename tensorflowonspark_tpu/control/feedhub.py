@@ -20,6 +20,7 @@ markers as in-band items.
 
 import collections
 import logging
+import os
 import threading
 import time
 from multiprocessing.managers import BaseManager
@@ -184,6 +185,22 @@ def _kv_set(key: str, value) -> None:
     _kv[key] = value
 
 
+def _force_exit():
+  """Schedule hard exit of the manager SERVER process (returns first).
+
+  BaseManager has no remote shutdown for non-owners, but fault recovery
+  needs one: when a node is SIGKILLed its hub manager survives as an
+  orphan (daemonic children die with a clean parent exit, not a SIGKILL),
+  still answering with state 'running'. The supervisor/reclaim path calls
+  this after draining the dead node's queues so orphaned managers don't
+  accumulate across relaunches.
+  """
+  t = threading.Timer(0.2, os._exit, args=(0,))
+  t.daemon = True
+  t.start()
+  return True
+
+
 _QUEUE_METHODS = ["put", "put_many", "get", "get_many", "task_done", "join",
                   "qsize", "empty"]
 
@@ -196,6 +213,7 @@ FeedHubManager.register("get_queue", callable=_get_queue,
                         exposed=_QUEUE_METHODS)
 FeedHubManager.register("get", callable=_kv_get)
 FeedHubManager.register("set", callable=_kv_set)
+FeedHubManager.register("force_exit", callable=_force_exit)
 
 
 class FeedHub(object):
@@ -221,6 +239,12 @@ class FeedHub(object):
 
   def set(self, key: str, value) -> None:
     self._manager.set(key, value)
+
+  def force_exit(self) -> None:
+    """Hard-stop the hub SERVER process (see ``_force_exit``); usable by
+    any connected client, unlike ``shutdown`` which only the owner may
+    call. Best-effort: an already-dead server raises, callers catch."""
+    self._manager.force_exit()
 
   def shutdown(self) -> None:
     if self._owned:

@@ -17,7 +17,11 @@ doubles as the graceful-stop signal for streaming jobs. Differences, by design:
   — the TPU-native analog of synthesizing ``TF_CONFIG``.
 
 Message verbs (parity with reservation.py:130-146): ``REG``, ``QINFO`` (count
-registered), ``QUERY`` (done?), ``LIST`` (full reservation list), ``STOP``.
+registered), ``QUERY`` (done?), ``LIST`` (full reservation list), ``STOP``;
+plus the liveness verbs ``BEAT`` (per-executor heartbeat; a ``bye`` beat
+marks clean departure) and ``HEALTH`` (snapshot of the liveness table) —
+the reference had no liveness detection at all: a hung executor stalled the
+job until the 3-day shutdown watchdog fired (TFCluster.py:136-144).
 
 Env overrides (parity with reservation.py:25-26,190-206):
 ``TOS_TPU_SERVER_HOST`` pins the server bind/advertise host;
@@ -27,6 +31,7 @@ Env overrides (parity with reservation.py:25-26,190-206):
 
 import logging
 import os
+import random
 import select
 import socket
 import struct
@@ -35,6 +40,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 import msgpack
+
+from tensorflowonspark_tpu.utils import chaos
 
 logger = logging.getLogger(__name__)
 
@@ -66,6 +73,13 @@ class MessageSocket(object):
 
   def send(self, sock: socket.socket, msg: dict) -> None:
     payload = msgpack.packb(msg, use_bin_type=True)
+    if len(payload) > MAX_MESSAGE_BYTES:
+      # refuse before the wire: the receiver would drop the connection
+      # anyway, and the sender deserves a diagnosable error instead of a
+      # reconnect loop against a peer that keeps hanging up
+      raise ValueError(
+          "refusing to send oversized rendezvous message (%d bytes > %d)"
+          % (len(payload), MAX_MESSAGE_BYTES))
     sock.sendall(_HEADER.pack(len(payload)) + payload)
 
   @staticmethod
@@ -126,6 +140,217 @@ class Reservations(object):
       return max(0, self.required - len(self._table))
 
 
+class Liveness(object):
+  """Per-executor heartbeat table (server side).
+
+  States, derived from the age of the last beat at query time:
+
+  - ``unknown``    — never beat (node hasn't registered/started yet)
+  - ``live``       — beat within ``suspect_after`` intervals
+  - ``suspect``    — missed (at least) one beat deadline
+  - ``dead``       — silent for ``miss_limit`` intervals: the node's
+    process is presumed gone (SIGKILL, OOM, preemption) and the
+    supervisor may reclaim and relaunch it
+  - ``departed``   — sent a clean goodbye (``bye`` beat); never flagged
+  - ``restarting`` — a supervisor took ownership pending re-registration
+
+  A registration counts as the first beat, so a node that dies between
+  registering and its first heartbeat is still detected — but under the
+  longer ``startup_grace`` deadline, not the 2-interval one: between
+  registering and starting its own heartbeats a node legitimately blocks
+  in cluster assembly (waiting for the full roster), and that gap must
+  not read as death. Once a node's OWN first beat arrives, the strict
+  missed-beat deadline applies. With ``interval=None`` the table is
+  inert: no state ever becomes ``dead``.
+  """
+
+  def __init__(self, interval: Optional[float] = None,
+               miss_limit: float = 2.0, suspect_after: float = 1.25,
+               startup_grace: float = 30.0):
+    self.interval = float(interval) if interval else None
+    self.miss_limit = float(miss_limit)
+    self.suspect_after = float(suspect_after)
+    self.startup_grace = float(startup_grace)
+    self._lock = threading.Lock()
+    self._last: Dict[int, float] = {}
+    self._progress: Dict[int, object] = {}
+    self._departed: set = set()
+    self._restarting: set = set()
+    self._confirmed: set = set()   # sent a real beat (not just REG)
+
+  def beat(self, executor_id: int, departing: bool = False,
+           progress=None, registration: bool = False) -> None:
+    with self._lock:
+      self._last[executor_id] = time.monotonic()
+      if registration:
+        # a (re-)registration starts a new incarnation: it must confirm
+        # with its own first beat before the strict deadline applies, so a
+        # relaunched node gets the startup grace again
+        self._confirmed.discard(executor_id)
+      else:
+        self._confirmed.add(executor_id)
+      if progress is not None:
+        self._progress[executor_id] = progress
+      if departing:
+        self._departed.add(executor_id)
+      else:
+        self._departed.discard(executor_id)
+        self._restarting.discard(executor_id)
+
+  def mark_restarting(self, executor_id: int) -> None:
+    """Supervisor takes ownership: suppress dead-detection until the
+    relaunched node re-registers (which beats, clearing the flag)."""
+    with self._lock:
+      self._restarting.add(executor_id)
+
+  def state(self, executor_id: int) -> str:
+    with self._lock:
+      return self._state_locked(executor_id, time.monotonic())
+
+  def _state_locked(self, executor_id: int, now: float) -> str:
+    if executor_id in self._departed:
+      return "departed"
+    if executor_id in self._restarting:
+      return "restarting"
+    last = self._last.get(executor_id)
+    if last is None:
+      return "unknown"
+    if self.interval is None:
+      return "live"
+    age = now - last
+    if executor_id not in self._confirmed:
+      # registered but not yet heartbeating: bring-up blocks in cluster
+      # assembly, so only the (long) startup grace applies
+      grace = max(self.startup_grace, self.interval * self.miss_limit)
+      return "live" if age <= grace else "dead"
+    if age <= self.interval * self.suspect_after:
+      return "live"
+    if age <= self.interval * self.miss_limit:
+      return "suspect"
+    return "dead"
+
+  def dead(self) -> List[int]:
+    """Executor ids currently past the missed-beat deadline."""
+    with self._lock:
+      now = time.monotonic()
+      return sorted(e for e in self._last
+                    if self._state_locked(e, now) == "dead")
+
+  def snapshot(self) -> Dict[int, dict]:
+    """{executor_id: {"state", "age", "progress"}} for HEALTH queries."""
+    with self._lock:
+      now = time.monotonic()
+      return {e: {"state": self._state_locked(e, now),
+                  "age": now - self._last[e],
+                  "progress": self._progress.get(e)}
+              for e in self._last}
+
+
+class HeartbeatSender(object):
+  """Background thread beating ``BEAT`` every ``interval`` seconds.
+
+  Runs inside the process executing the user main fn, so a SIGKILL, OOM
+  kill or preemption stops the beats — exactly the signal the server's
+  :class:`Liveness` table (and the driver's ClusterSupervisor) uses to
+  declare the node dead. ``start()`` sends the first beat synchronously,
+  so even a process killed immediately afterwards was seen alive once.
+  On clean ``stop()`` a final ``bye`` beat marks the node departed so
+  completed nodes are never flagged dead. Delivery failures are retried
+  forever (throttled after ``max_failures`` consecutive misses) — a
+  transient control-plane glitch must not silence a healthy node.
+
+  ``set_progress`` attaches an application-level progress value (e.g. the
+  training step) to subsequent beats — surfaced via ``HEALTH`` for
+  observability and future stall detection.
+  """
+
+  def __init__(self, server_addr: Tuple[str, int], executor_id: int,
+               interval: float = 5.0, max_failures: int = 5):
+    self.server_addr = (server_addr[0], int(server_addr[1]))
+    self.executor_id = executor_id
+    self.interval = float(interval)
+    self.max_failures = max_failures
+    self._progress = None
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+    self._client: Optional["Client"] = None
+    self._failures = 0
+
+  def set_progress(self, value) -> None:
+    # numpy/jax scalars are not msgpack-serializable; coerce to builtins
+    # here so a beat can never die on the progress payload
+    if hasattr(value, "item"):
+      try:
+        value = value.item()
+      except Exception:  # noqa: BLE001 - non-scalar array etc.
+        value = str(value)
+    elif not isinstance(value, (int, float, str, bool, type(None))):
+      value = str(value)
+    self._progress = value
+
+  def _beat(self, bye: bool = False) -> bool:
+    try:
+      if self._client is None:
+        # short per-request deadline: a beat that cannot be delivered
+        # within ~2 intervals is useless anyway (capped so the bye beat at
+        # node exit never stalls shutdown against a stopped server)
+        self._client = Client(self.server_addr,
+                              timeout=max(0.5, min(2.0, 2 * self.interval)))
+      msg = {"type": "BEAT", "executor_id": self.executor_id}
+      if bye:
+        msg["bye"] = True
+      if self._progress is not None:
+        msg["progress"] = self._progress
+      self._client._request(msg)
+      self._failures = 0
+      return True
+    except Exception as e:  # noqa: BLE001 - the heartbeat thread must
+      # survive ANYTHING (a dead thread reads as node death to the
+      # supervisor); serialization surprises count as delivery failures
+      self._failures += 1
+      if self._failures == 1:
+        logger.warning("heartbeat delivery failing for executor %d: %s",
+                       self.executor_id, e)
+      if self._client is not None:
+        self._client.close()
+        self._client = None
+      return False
+
+  def _run(self) -> None:
+    while True:
+      # after max_failures consecutive failures, throttle — but NEVER
+      # stop, and never beyond 2×interval: the liveness deadline is 2
+      # intervals, so a healthy node must get back on the wire within one
+      # deadline of the server healing, or the supervisor would relaunch
+      # a live node over a transient network blip
+      delay = self.interval
+      if self._failures >= self.max_failures:
+        delay = 2 * self.interval
+        if self._failures == self.max_failures:
+          logger.warning("heartbeat delivery for executor %d failing "
+                         "persistently (%d consecutive); throttling beats",
+                         self.executor_id, self._failures)
+      if self._stop.wait(delay):
+        return
+      self._beat()
+
+  def start(self) -> "HeartbeatSender":
+    self._beat()                        # guarantee at least one beat
+    self._thread = threading.Thread(target=self._run, daemon=True,
+                                    name="heartbeat-%d" % self.executor_id)
+    self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=max(1.0, 2 * self.interval))
+    self._beat(bye=True)                # best-effort clean departure
+    if self._client is not None:
+      self._client.close()
+      self._client = None
+
+
 def _parse_port_spec(spec: str) -> List[int]:
   """``"9000"`` → [9000]; ``"9000-9003"`` → [9000..9003]."""
   if "-" in spec:
@@ -137,9 +362,12 @@ def _parse_port_spec(spec: str) -> List[int]:
 class Server(MessageSocket):
   """Driver-side rendezvous server (parity: reservation.py:100-231)."""
 
-  def __init__(self, count: int):
+  def __init__(self, count: int, heartbeat_interval: Optional[float] = None,
+               miss_limit: float = 2.0, startup_grace: float = 30.0):
     assert count > 0
     self.reservations = Reservations(count)
+    self.liveness = Liveness(heartbeat_interval, miss_limit=miss_limit,
+                             startup_grace=startup_grace)
     self.done = threading.Event()
     self._listener: Optional[socket.socket] = None
     self.addr: Optional[Tuple[str, int]] = None
@@ -248,12 +476,31 @@ class Server(MessageSocket):
         s.close()
       except OSError:
         pass
+    # close the listener the moment serving ends: late clients (heartbeat
+    # senders, stop requests) get instant ECONNREFUSED instead of a
+    # connection parked forever in a never-accepted backlog
+    if self._listener is not None:
+      try:
+        self._listener.close()
+      except OSError:
+        pass
 
   def _handle(self, sock: socket.socket, msg: dict) -> None:
     mtype = msg.get("type")
     if mtype == "REG":
       self.reservations.add(msg["data"])
+      # registration counts as the first beat (under the startup grace):
+      # a node that dies before its first heartbeat is still detected
+      if "executor_id" in msg["data"]:
+        self.liveness.beat(msg["data"]["executor_id"], registration=True)
       self.send(sock, {"type": "OK"})
+    elif mtype == "BEAT":
+      self.liveness.beat(msg["executor_id"], departing=msg.get("bye", False),
+                         progress=msg.get("progress"))
+      self.send(sock, {"type": "OK"})
+    elif mtype == "HEALTH":
+      snap = {str(k): v for k, v in self.liveness.snapshot().items()}
+      self.send(sock, {"type": "HEALTH", "data": snap})
     elif mtype == "QINFO":
       self.send(sock, {"type": "COUNT",
                        "registered": self.reservations.required -
@@ -319,39 +566,79 @@ class Server(MessageSocket):
 
 
 class Client(MessageSocket):
-  """Executor-side rendezvous client (parity: reservation.py:234-301)."""
+  """Executor-side rendezvous client (parity: reservation.py:234-301).
 
-  RETRIES = 3
+  The request/reconnect loop is BOUNDED: exponential backoff with full
+  jitter, capped per-sleep at ``backoff_cap`` and in total by ``timeout``
+  (a hard deadline per request). A server that stays unreachable yields a
+  clear :class:`ConnectionError` naming its address instead of an infinite
+  retry loop wedging the node.
+  """
 
-  def __init__(self, server_addr: Tuple[str, int]):
+  def __init__(self, server_addr: Tuple[str, int], timeout: float = 30.0,
+               backoff_base: float = 0.05, backoff_cap: float = 2.0):
     self.server_addr = (server_addr[0], int(server_addr[1]))
-    self._sock = self._connect()
+    self.timeout = float(timeout)
+    self.backoff_base = backoff_base
+    self.backoff_cap = backoff_cap
+    try:
+      self._sock: Optional[socket.socket] = self._connect()
+    except OSError:
+      # retried (with backoff, against the deadline) at the first request
+      self._sock = None
 
   def _connect(self) -> socket.socket:
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    # a per-operation socket deadline: a server that stopped serving (or a
+    # half-open connection) must surface as a retryable timeout, never as
+    # an unbounded recv() — request/reply exchanges here are all small and
+    # fast, so a generous cap costs nothing
+    s.settimeout(max(1.0, min(self.timeout, 10.0)))
     s.connect(self.server_addr)
     return s
 
   def _request(self, msg: dict) -> dict:
+    if chaos.enabled():
+      drop, delay = chaos.message_fault(msg.get("type"))
+      if delay:
+        time.sleep(delay)
+      if drop:
+        # lost on the (simulated) wire: the server never sees it; callers
+        # polling for state simply observe nothing changed
+        return {"type": "DROPPED", "dropped": True, "done": False}
+    deadline = time.monotonic() + self.timeout
+    attempt = 0
     last = None
-    for attempt in range(self.RETRIES):
+    while True:
       try:
+        if self._sock is None:
+          self._sock = self._connect()
         self.send(self._sock, msg)
         return self.receive(self._sock)
       except (ConnectionError, OSError) as e:
         last = e
-        logger.warning("rendezvous send failed (attempt %d): %s", attempt + 1, e)
-        try:
-          self._sock.close()
-        except OSError:
-          pass
-        time.sleep(0.5 * (attempt + 1))
-        try:
-          self._sock = self._connect()
-        except OSError as e2:
-          last = e2
-    raise ConnectionError("unable to reach rendezvous server at {}: {}".format(
-        self.server_addr, last))
+        if attempt == 0:
+          logger.warning("rendezvous request to %s failed (%s); retrying "
+                         "with backoff", self.server_addr, e)
+        if self._sock is not None:
+          try:
+            self._sock.close()
+          except OSError:
+            pass
+          self._sock = None
+        now = time.monotonic()
+        if now >= deadline:
+          raise ConnectionError(
+              "unable to reach rendezvous server at %s:%d after %d "
+              "attempt(s) over %.1fs: %s"
+              % (self.server_addr[0], self.server_addr[1], attempt + 1,
+                 self.timeout, last))
+        # exponential backoff with full jitter, capped per-sleep and
+        # clipped to the remaining deadline budget
+        delay = min(self.backoff_cap, self.backoff_base * (2 ** attempt))
+        delay *= 0.5 + random.random()
+        time.sleep(max(0.0, min(delay, deadline - now)))
+        attempt += 1
 
   def register(self, reservation: dict) -> None:
     self._request({"type": "REG", "data": reservation})
@@ -399,7 +686,10 @@ class Client(MessageSocket):
       logger.warning("rendezvous server already gone on STOP")
 
   def close(self) -> None:
+    if self._sock is None:
+      return
     try:
       self._sock.close()
     except OSError:
       pass
+    self._sock = None

@@ -31,22 +31,20 @@ _lib = None
 _lib_tried = False
 
 
-def _load():
-  global _lib, _lib_tried
-  if _lib_tried:
-    return _lib
-  _lib_tried = True
-  so = os.path.abspath(_SO_PATH)
-  if not os.path.exists(so) and os.path.exists(_SRC_PATH):
-    try:
-      subprocess.run(["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-                      "-o", so, os.path.abspath(_SRC_PATH)],
-                     check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError) as e:
-      logger.warning("shmring native build failed: %s", e)
-      return None
-  if not os.path.exists(so):
-    return None
+def _compile(so: str) -> bool:
+  try:
+    # -lrt: shm_open/shm_unlink live in librt on older glibc; linking it
+    # explicitly is harmless where they moved into libc
+    subprocess.run(["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                    "-o", so, os.path.abspath(_SRC_PATH), "-lrt"],
+                   check=True, capture_output=True, timeout=120)
+    return True
+  except (OSError, subprocess.SubprocessError) as e:
+    logger.warning("shmring native build failed: %s", e)
+    return False
+
+
+def _bind(so: str):
   lib = ctypes.CDLL(so)
   lib.tos_ring_create.restype = ctypes.c_void_p
   lib.tos_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
@@ -63,7 +61,41 @@ def _load():
   lib.tos_ring_pending.argtypes = [ctypes.c_void_p]
   lib.tos_ring_free.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                 ctypes.c_int]
-  _lib = lib
+  return lib
+
+
+def _load():
+  global _lib, _lib_tried
+  if _lib_tried:
+    return _lib
+  _lib_tried = True
+  so = os.path.abspath(_SO_PATH)
+  built = False
+  if not os.path.exists(so) and os.path.exists(_SRC_PATH):
+    if not _compile(so):
+      return None
+    built = True
+  if not os.path.exists(so):
+    return None
+  try:
+    _lib = _bind(so)
+  except (OSError, AttributeError) as e:
+    # a PREBUILT .so from a different image can fail to dlopen or miss
+    # symbols here (e.g. undefined shm_open when linked without -lrt).
+    # available() must gate cleanly — every node bring-up consults it, and
+    # leaking a loader error would abort whole-cluster startup over an
+    # optional fast path. Rebuild from source once, else fall back.
+    logger.warning("shmring native library failed to load (%s)%s", e,
+                   "; rebuilding from source" if os.path.exists(_SRC_PATH)
+                   else "; falling back to queue transport")
+    _lib = None
+    if not built and os.path.exists(_SRC_PATH) and _compile(so):
+      try:
+        _lib = _bind(so)
+      except (OSError, AttributeError) as e2:
+        logger.warning("rebuilt shmring library still fails to load (%s); "
+                       "falling back to queue transport", e2)
+        _lib = None
   return _lib
 
 
@@ -102,6 +134,17 @@ def release(key) -> None:
 def release_all() -> None:
   for key in list(_held):
     release(key)
+
+
+def unlink_stale(name: str) -> None:
+  """Best-effort unlink of a ring segment whose owner died without freeing
+  it (POSIX shm persists past process death). Used by relaunched nodes to
+  reap their dead predecessor's segment before creating a fresh,
+  generation-suffixed ring."""
+  try:
+    os.unlink(os.path.join("/dev/shm", name.lstrip("/")))
+  except OSError:
+    pass
 
 
 class RingClosed(Exception):

@@ -185,6 +185,40 @@ class DataFeed(object):
     return np.asarray(batch, dtype=dtype)
 
 
+def drain_pending_rows(hub, qname: str = "input", settle_rounds: int = 3,
+                       settle_timeout: float = 0.1) -> List:
+  """Pull every undelivered row out of a (presumed dead) node's feed queue.
+
+  Fault-recovery primitive: when a worker dies mid-feed, rows already
+  pushed into its hub queue would otherwise be lost — and the feeder tasks
+  blocked in ``queue.join()`` would wedge until their feed timeout. This
+  drains the queue, acking each batch with ``task_done`` so blocked
+  feeders complete, and returns the data rows for requeueing through the
+  engine feed path (``ClusterSupervisor`` refeeds them to live workers).
+
+  End-of-feed / partition markers are dropped, not returned: the requeued
+  rows ride a fresh feed round with its own markers. The drain keeps
+  sweeping until ``settle_rounds`` consecutive empty polls, catching a
+  feeder caught mid-``put_many``.
+
+  Only call this against a hub whose consumer is KNOWN dead — draining a
+  live node's queue steals its input.
+  """
+  queue = hub.get_queue(qname)
+  rows: List = []
+  empty = 0
+  while empty < settle_rounds:
+    got = queue.get_many(1024, block=True, timeout=settle_timeout)
+    if not got:
+      empty += 1
+      continue
+    empty = 0
+    queue.task_done(len(got))
+    rows.extend(r for r in got
+                if r is not None and not isinstance(r, Marker))
+  return rows
+
+
 def prefetch_to_device(batches, size: int = 2, device=None):
   """Overlap host→device staging with device compute.
 

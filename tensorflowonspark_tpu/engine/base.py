@@ -22,7 +22,19 @@ Scheduling semantics the cluster layer RELIES on (Spark parity):
 
 import abc
 import threading
+import time
 from typing import Callable, Iterable, List, Optional, Sequence
+
+#: Error-string prefix engines use when a task died WITH its executor
+#: (process killed / crashed without a traceback) — an infrastructure
+#: failure, as opposed to an application exception. The cluster-layer
+#: supervisor restarts the former and propagates the latter untouched.
+EXECUTOR_LOST = "ExecutorLost"
+
+
+def is_executor_lost(error: Optional[str]) -> bool:
+  """True when a task error marks an executor-death (restartable) failure."""
+  return bool(error) and error.startswith(EXECUTOR_LOST)
 
 
 class EngineJob(object):
@@ -32,15 +44,44 @@ class EngineJob(object):
     self.num_tasks = num_tasks
     self.results: List[object] = [None] * num_tasks
     self.errors: List[Optional[str]] = [None] * num_tasks
+    self._completed = [False] * num_tasks
+    #: per-task attempt counter, bumped by _task_restarted: completions
+    #: carry the attempt they belong to, so a stale report from a
+    #: superseded attempt (e.g. the executor-death monitor observing the
+    #: OLD process after a supervised relaunch was queued) cannot poison
+    #: the replacement attempt's bookkeeping
+    self._attempt = [0] * num_tasks
     self._done = 0
     self._cond = threading.Condition()
 
-  def _task_finished(self, task_id: int, result=None, error: Optional[str] = None):
+  def _task_finished(self, task_id: int, result=None,
+                     error: Optional[str] = None,
+                     attempt: Optional[int] = None):
     with self._cond:
+      if attempt is not None and attempt != self._attempt[task_id]:
+        return   # a superseded attempt reporting late: ignore
+      if self._completed[task_id]:
+        # late duplicate (e.g. a speculative attempt): first wins
+        return
+      self._completed[task_id] = True
       self.results[task_id] = result
       self.errors[task_id] = error
       self._done += 1
       self._cond.notify_all()
+
+  def _task_restarted(self, task_id: int) -> int:
+    """Reset one task's bookkeeping for a supervised relaunch: waiters go
+    back to blocking until the replacement attempt finishes. Returns the
+    new attempt number the replacement must report completions under."""
+    with self._cond:
+      self._attempt[task_id] += 1
+      if self._completed[task_id]:
+        self._completed[task_id] = False
+        self._done -= 1
+      self.results[task_id] = None
+      self.errors[task_id] = None
+      self._cond.notify_all()
+      return self._attempt[task_id]
 
   def done(self) -> bool:
     with self._cond:
@@ -54,9 +95,12 @@ class EngineJob(object):
       return None
 
   def wait(self, timeout: Optional[float] = None, raise_on_error: bool = True):
-    """Block until all tasks finish; raise the first task error by default."""
+    """Block until all tasks finish; raise the first task error by default.
+
+    Event-driven: waiters sleep on the condition variable and are woken by
+    task completions (no polling cadence; a ``timeout`` bounds the wait).
+    """
     with self._cond:
-      import time
       deadline = None if timeout is None else time.monotonic() + timeout
       while self._done < self.num_tasks:
         remaining = None if deadline is None else deadline - time.monotonic()
@@ -64,7 +108,7 @@ class EngineJob(object):
           raise TimeoutError(
               "engine job timed out with %d/%d tasks finished"
               % (self._done, self.num_tasks))
-        self._cond.wait(remaining if remaining is not None else 1.0)
+        self._cond.wait(remaining)
     if raise_on_error:
       err = self.first_error()
       if err:
@@ -121,6 +165,30 @@ class Engine(abc.ABC):
     executors; all tasks start together and get placement info. Parity:
     rdd.barrier().mapPartitions with BarrierTaskContext (TFParallel.py:43-56).
     Raises if num_tasks exceeds available executors."""
+
+  def preempt_task(self, job: EngineJob, task_id: int) -> bool:
+    """Forcibly stop a task that is still IN FLIGHT (fault recovery).
+
+    Used by the cluster supervisor before relaunching a node whose task
+    never completed — a hung user fn keeps its executor busy forever, and
+    a pinned relaunch could never schedule behind it. Returns True when
+    the task's executor was killed (the engine will fail the attempt and
+    recycle the slot); False when unsupported or the task is not running.
+    """
+    return False
+
+  def relaunch_task(self, job: EngineJob, task_id: int, payload=None):
+    """Re-run ONE task of a previously submitted job (fault recovery).
+
+    The cluster supervisor calls this to replace a node whose executor
+    died: the task's bookkeeping in ``job`` is reset (waiters block again
+    until the replacement finishes) and the stored fn re-runs with the
+    original payload — or ``payload`` when given (e.g. to hand the
+    relaunched node its restart count). Engines that cannot resubmit
+    individual tasks raise NotImplementedError.
+    """
+    raise NotImplementedError(
+        "%s does not support supervised task relaunch" % type(self).__name__)
 
   #: True when every executor runs on THIS host (LocalEngine) — enables
   #: same-host-only transports like the shared-memory feed ring

@@ -90,9 +90,9 @@ class SparkEngine(Engine):
         status, payload = pairs[i] if i < len(pairs) else \
             (_ERR, "task %d produced no result" % i)
         if status == _OK:
-          job._task_finished(i, result=payload)
+          job._task_finished(i, result=payload, attempt=0)
         else:
-          job._task_finished(i, error=payload)
+          job._task_finished(i, error=payload, attempt=0)
 
     threading.Thread(target=_run, daemon=True,
                      name="spark-engine-job").start()
@@ -107,7 +107,49 @@ class SparkEngine(Engine):
       raise ValueError("task_payloads has %d entries for %d tasks"
                        % (len(payloads), n))
     rdd = self.sc.parallelize(payloads, n)
-    return self._async_job(rdd.mapPartitions(_capture(fn)).collect, n)
+    job = self._async_job(rdd.mapPartitions(_capture(fn)).collect, n)
+    # retained for supervised relaunch (cluster.ClusterSupervisor): a dead
+    # node's bring-up task can be resubmitted as a fresh one-task job
+    job._relaunch_spec = (fn, payloads)
+    return job
+
+  def relaunch_task(self, job, task_id: int, payload=None):
+    """Resubmit ONE task of a run_on_executors job as a fresh single-task
+    Spark job, routing its result back into the original EngineJob slot.
+
+    Spark's own task retries cover transient in-job failures; this hook is
+    for the cluster supervisor's slower path — relaunching a node whose
+    executor was lost after the original job already recorded the loss.
+    """
+    spec = getattr(job, "_relaunch_spec", None)
+    if spec is None:
+      raise NotImplementedError(
+          "SparkEngine can only relaunch run_on_executors tasks")
+    fn, payloads = spec
+    p = payload if payload is not None else payloads[task_id]
+    attempt = job._task_restarted(task_id)
+    rdd = self.sc.parallelize([p], 1)
+
+    # UNcaptured: Spark cannot pin the replacement to a particular
+    # executor, and a node bring-up landing on an executor that already
+    # hosts a live node fails its reclaim check by design ("so the engine
+    # can retry it elsewhere", node.py). Letting the exception reach Spark
+    # makes spark.task.maxFailures reschedule the task on other executors
+    # until placement works; only the final failure ships back here.
+    def _run():
+      try:
+        out = rdd.mapPartitions(fn).collect()
+        status, result = _OK, out
+      except Exception:  # noqa: BLE001 - exhausted Spark-side retries
+        import traceback
+        status, result = _ERR, traceback.format_exc()
+      if status == _OK:
+        job._task_finished(task_id, result=result, attempt=attempt)
+      else:
+        job._task_finished(task_id, error=result, attempt=attempt)
+
+    threading.Thread(target=_run, daemon=True,
+                     name="spark-engine-relaunch-%d" % task_id).start()
 
   def foreach_partition(self, partitions, fn) -> EngineJob:
     rdd = self._as_rdd(partitions)

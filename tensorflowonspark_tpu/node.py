@@ -51,7 +51,8 @@ class TPUNodeContext(object):
   def __init__(self, executor_id=0, job_name="worker", task_index=0,
                cluster_spec=None, default_fs="file://", working_dir=".",
                hub=None, tmp_socket=None, coordinator_address=None,
-               process_id=0, num_processes=1, cluster_info=None):
+               process_id=0, num_processes=1, cluster_info=None,
+               restart_count=0, heartbeat=None):
     self.executor_id = executor_id
     self.worker_num = executor_id          # backwards-compat alias
     self.job_name = job_name
@@ -69,6 +70,11 @@ class TPUNodeContext(object):
     self.process_id = process_id
     self.num_processes = num_processes
     self.cluster_info = cluster_info or []
+    #: how many times the supervisor relaunched this node (0 = first
+    #: launch). A relaunched node should resume from its latest
+    #: checkpoint: ``state, start = ctx.checkpoint_manager(d).restore_or(state)``
+    self.restart_count = restart_count
+    self._heartbeat = heartbeat
 
   # -- convenience mirrors (parity: TFSparkNode.py:92-108) -------------------
 
@@ -96,6 +102,25 @@ class TPUNodeContext(object):
   @property
   def is_chief(self) -> bool:
     return is_chief(self.job_name, self.task_index, self.cluster_spec)
+
+  @property
+  def is_restart(self) -> bool:
+    """True when this node is a supervised relaunch of a dead predecessor."""
+    return self.restart_count > 0
+
+  def checkpoint_manager(self, directory: str, **kwargs):
+    """A :class:`utils.checkpoint.CheckpointManager` for this node — the
+    preemption-safe resume hook: ``state, start_step = mgr.restore_or(state)``
+    continues a relaunched node from its latest checkpoint (``start_step``
+    is 0 on a fresh launch)."""
+    from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
+    return CheckpointManager(directory, **kwargs)
+
+  def report_progress(self, value) -> None:
+    """Attach an application progress value (e.g. the training step) to
+    this node's heartbeats — visible driver-side via the HEALTH verb."""
+    if self._heartbeat is not None:
+      self._heartbeat.set_progress(value)
 
   def initialize_distributed(self) -> None:
     """Join the JAX process group (TPU analog of TF reading TF_CONFIG).
@@ -178,14 +203,17 @@ def _build_cluster_spec(cluster_info: List[dict]) -> Dict[str, List[str]]:
 def _find_tensorboard(search_path: Optional[str] = None):
   """Locate a TensorBoard entry point, or False.
 
-  Searches the python bin dir, PATH, sys.path and PYTHONPATH for the
+  Searches PATH, the python bin dir, sys.path and PYTHONPATH for the
   ``tensorboard`` executable, then for the module form ``tensorboard/main.py``
-  (parity: the reference's three-step search, TFSparkNode.py:310-322).
+  (parity: the reference's three-step search, TFSparkNode.py:310-322 —
+  reordered so an explicit PATH entry OVERRIDES the interpreter's bin dir,
+  the conventional Unix precedence; a container may carry a stub
+  ``tensorboard`` launcher next to python that shadows the real one).
   """
   if search_path is None:
     search_path = os.pathsep.join([
-        os.path.dirname(sys.executable),
         os.environ.get("PATH", ""),
+        os.path.dirname(sys.executable),
         os.pathsep.join(p for p in sys.path if p),
         os.environ.get("PYTHONPATH", ""),
     ])
@@ -218,17 +246,25 @@ def _spawn_tensorboard(log_dir: str) -> Optional[dict]:
 
 
 def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
-                       hub_addr, authkey: bytes):
+                       hub_addr, authkey: bytes, server_addr=None,
+                       heartbeat_interval=None):
   """Entry point of the background process running the user main fn.
 
   Reconnects to this executor's feed hub by address (the hub lives in a
   separate manager process), captures any exception into the ``error`` queue
   as a traceback (parity: TFSparkNode.py:423-429) and drives the hub state
-  machine to ``'stopped'``.
+  machine to ``'stopped'``. Heartbeats run HERE — in the process executing
+  the user fn — so a SIGKILL/OOM of this process stops the beats and the
+  driver's supervisor declares the node dead.
   """
   import cloudpickle
   hub = feedhub.connect(tuple(hub_addr), authkey)
-  ctx = TPUNodeContext(hub=hub, **ctx_kwargs)
+  sender = None
+  if server_addr and heartbeat_interval:
+    sender = rendezvous.HeartbeatSender(
+        tuple(server_addr), ctx_kwargs["executor_id"],
+        interval=heartbeat_interval).start()
+  ctx = TPUNodeContext(hub=hub, heartbeat=sender, **ctx_kwargs)
   try:
     fn = cloudpickle.loads(fn_bytes)
     fn(tf_args, ctx)
@@ -240,6 +276,8 @@ def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
     except Exception:  # noqa: BLE001
       pass
   finally:
+    if sender is not None:
+      sender.stop()
     try:
       hub.set("state", "stopped")
     except Exception:  # noqa: BLE001
@@ -253,8 +291,16 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
   fn_bytes = cloudpickle.dumps(main_fn)
 
   def _mapfn(iterator):
-    # 1. learn this task's executor id from its partition (parity :176-177)
-    executor_id = next(iter(iterator))
+    # 1. learn this task's executor id from its partition (parity :176-177).
+    # A supervised relaunch hands a dict payload carrying the restart count
+    # (cluster.ClusterSupervisor → Engine.relaunch_task).
+    payload = next(iter(iterator))
+    if isinstance(payload, dict):
+      executor_id = payload["executor_id"]
+      restart_count = int(payload.get("restart", 0))
+    else:
+      executor_id = payload
+      restart_count = 0
     meta = cluster_meta
     working_dir = os.getcwd()
     job_name, task_index = _role_of(executor_id, meta["cluster_template"])
@@ -269,6 +315,7 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
     # hub with a different key) is reclaimed, releasing the old manager.
     reclaimed = os.path.exists(os.path.join(working_dir, HUB_ADDR_FILE))
     if reclaimed:
+      old = None
       try:
         with open(os.path.join(working_dir, HUB_ADDR_FILE)) as f:
           host, port = f.read().strip().split(":")
@@ -279,6 +326,13 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
               "executor already runs a live node (hub state=%r); failing this "
               "task so the engine can retry it elsewhere" % state)
         logger.info("found stale hub (state=%r); reclaiming executor", state)
+        # a SIGKILLed predecessor leaves its hub manager as a live orphan
+        # (the supervisor marks it 'dead' after draining); reap it so
+        # managers don't pile up across relaunches
+        try:
+          old.force_exit()
+        except Exception:  # noqa: BLE001 - manager already gone
+          pass
       except RuntimeError:
         raise
       except Exception as e:  # noqa: BLE001 - dead/foreign hub -> reclaim
@@ -299,6 +353,16 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
       if shmring.available():
         ring_name = "/tos_feed_%x_%d" % (meta["id"] & 0xFFFFFFFF,
                                          executor_id)
+        if restart_count:
+          # generation-suffix the relaunched node's ring: co-host feeder
+          # processes cache opened rings by name (shmring.open_cached), so
+          # reusing the dead predecessor's name would hand them a stale
+          # mapping of an unlinked segment. Reap the old generations'
+          # segments while we're here.
+          shmring.unlink_stale(ring_name)
+          for gen in range(1, restart_count):
+            shmring.unlink_stale("%s_r%d" % (ring_name, gen))
+          ring_name = "%s_r%d" % (ring_name, restart_count)
         ring = shmring.ShmRing.create(ring_name,
                                       meta.get("shm_capacity",
                                                64 * 1024 * 1024))
@@ -345,6 +409,10 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
         # not a concurrent task — the rendezvous replaces instead of flagging
         # a duplicate (Reservations.add)
         "reclaimed": reclaimed,
+        # restart generation: lets the supervisor recognize THIS relaunch's
+        # registration (the pid alone is ambiguous — an ENGINE-mode relaunch
+        # reuses the executor process)
+        "restart": restart_count,
     }
     client.register(reservation)
     cluster_info = client.await_reservations(
@@ -381,7 +449,8 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
         cluster_spec=cluster_spec, default_fs=meta.get("default_fs", "file://"),
         working_dir=working_dir, coordinator_address=coordinator,
         process_id=process_id, num_processes=len(table),
-        cluster_info=cluster_info)
+        cluster_info=cluster_info, restart_count=restart_count)
+    hb_interval = meta.get("heartbeat_interval")
 
     # 9. release-port semantics (parity :400-405): by default the reserved
     # port is released before the user fn; with release_port=False user code
@@ -400,7 +469,8 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
       import multiprocessing as mp
       proc = mp.get_context("spawn").Process(
           target=_background_runner,
-          args=(fn_bytes, tf_args, ctx_kwargs, list(hub.addr), authkey),
+          args=(fn_bytes, tf_args, ctx_kwargs, list(hub.addr), authkey,
+                list(meta["server_addr"]), hb_interval),
           daemon=True, name="tos-node-%d" % executor_id)
       proc.start()
       hub.set("node_pid", proc.pid)
@@ -410,14 +480,32 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
           items = control.get_many(1, timeout=1.0)
           if items and items[0] is None:
             break
+        # flip the state off "running" FIRST — sidecar fns (e.g. the eval
+        # sidecar) poll it as their stop signal — then join the background
+        # process (bounded) so its work is durably done before 'stopped'
+        # is reported: the driver's stop used to race a fn still starting
+        hub.set("state", "terminating")
+        proc.join(timeout=60)
+        if proc.is_alive():
+          logger.warning("%s:%d background process still running at stop; "
+                         "terminating", job_name, task_index)
+          proc.terminate()
         hub.set("state", "stopped")
       return [executor_id]
     else:
-      # foreground execution (FILES mode workers, parity :459-463)
+      # foreground execution (FILES mode workers, parity :459-463); beats
+      # come from THIS process — the one the user fn runs in — so a
+      # kill/hang of the worker is what stops them
       if release_now:
         tmp_sock.close()
         tmp_sock = None
-      ctx = TPUNodeContext(hub=hub, tmp_socket=tmp_sock, **ctx_kwargs)
+      sender = None
+      if hb_interval:
+        sender = rendezvous.HeartbeatSender(
+            tuple(meta["server_addr"]), executor_id,
+            interval=hb_interval).start()
+      ctx = TPUNodeContext(hub=hub, tmp_socket=tmp_sock, heartbeat=sender,
+                           **ctx_kwargs)
       try:
         cloudpickle.loads(fn_bytes)(tf_args, ctx)
         hub.set("state", "stopped")
@@ -429,6 +517,9 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
         except Exception:  # noqa: BLE001
           pass
         raise
+      finally:
+        if sender is not None:
+          sender.stop()
       return [executor_id]
 
   return _mapfn
@@ -451,7 +542,20 @@ def driver_node_main(mapfn_bytes: bytes, executor_id: int,
 
 def _get_hub(cluster_info: List[dict], executor_id: int, authkey: bytes):
   """Locate the feed hub of the node that owns this executor working dir
-  (parity: TFSparkNode._get_manager, TFSparkNode.py:128-155)."""
+  (parity: TFSparkNode._get_manager, TFSparkNode.py:128-155).
+
+  The working dir's ``hub_addr`` file is authoritative: a supervised
+  relaunch starts a FRESH hub and rewrites the file, while ``cluster_info``
+  pickled into an already-submitted feed task still names the dead one.
+  Falls back to cluster_info when the file is missing/unreadable.
+  """
+  hub_file = os.path.join(os.getcwd(), HUB_ADDR_FILE)
+  try:
+    with open(hub_file) as f:
+      host, port = f.read().strip().split(":")
+    return feedhub.connect((host, int(port)), authkey)
+  except Exception:  # noqa: BLE001 - fall back to the reservation table
+    pass
   for n in cluster_info:
     if n["executor_id"] == executor_id:
       return feedhub.connect(tuple(n["hub_addr"]), authkey)
@@ -631,6 +735,8 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
 
   def _train(iterator):
     executor_id = hostinfo.read_executor_id(os.getcwd())
+    from tensorflowonspark_tpu.utils import chaos
+    chaos.stall_point("feeder", index=executor_id)
     hub = _get_hub(cluster_info, executor_id, authkey)
     state = hub.get("state")
     queue = input_channel(hub, qname)

@@ -1,0 +1,188 @@
+"""Deterministic fault injection for exercising the fault-tolerance runtime.
+
+On TPU pods, preemption and single-host failure are the common case, not the
+exception — so every recovery path (missed-beat detection, supervised node
+relaunch, checkpoint resume, feed requeue) must be exercisable by ordinary
+CPU tests. This module provides the injection points; the recovery machinery
+lives in ``control.rendezvous`` (liveness), ``cluster.ClusterSupervisor``
+(relaunch) and ``engine.local`` (executor respawn).
+
+Faults are armed via environment variables, so they flow naturally into
+engine executor processes (``LocalEngine(env=...)``, Spark executor env)
+and every child they spawn. All triggers are DETERMINISTIC: named injection
+points fire on exact invocation counts, never at random.
+
+Env vars (all optional; absent ⇒ every hook is a no-op):
+
+``TOS_CHAOS_KILL`` = ``"point[@index][#nth]"`` (comma-separated specs)
+    SIGKILL the calling process the nth time (default: 1st) the named
+    :func:`kill_point` fires with a matching index. Example:
+    ``"train-step@0#3"`` kills executor 0 the 3rd time it reaches the
+    ``train-step`` point — i.e. *kill node N at step S*. Exactly-once
+    across process restarts: a sentinel file in the working directory
+    records the fire, so a relaunched node sails past the same point.
+
+``TOS_CHAOS_STALL`` = ``"point[@index]:seconds"`` (comma-separated)
+    Sleep at the named :func:`stall_point` (first matching call per
+    process) — e.g. ``"feeder@1:3"`` stalls executor 1's feed task.
+
+``TOS_CHAOS_RV_DROP`` = ``"VERB:count"`` (comma-separated)
+    Client-side rendezvous fault: silently drop the first ``count``
+    messages of the given verb before they hit the wire — e.g.
+    ``"BEAT:3"`` makes the server miss three heartbeats.
+
+``TOS_CHAOS_RV_DELAY`` = ``"VERB:seconds[:count]"`` (comma-separated)
+    Client-side rendezvous fault: delay messages of the given verb by
+    ``seconds`` before sending (first ``count`` messages; default: all).
+"""
+
+import logging
+import os
+import re
+import signal
+import threading
+import time
+from typing import Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_KILL = "TOS_CHAOS_KILL"
+ENV_STALL = "TOS_CHAOS_STALL"
+ENV_RV_DROP = "TOS_CHAOS_RV_DROP"
+ENV_RV_DELAY = "TOS_CHAOS_RV_DELAY"
+
+# per-process invocation counters, keyed by (point, index)
+_counts = {}
+_stalled = set()
+_rv_counts = {}
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+  """True when any chaos env var is armed (cheap fast-path guard)."""
+  return any(os.environ.get(k) for k in
+             (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY))
+
+
+def reset() -> None:
+  """Forget per-process counters (test isolation helper)."""
+  with _lock:
+    _counts.clear()
+    _stalled.clear()
+    _rv_counts.clear()
+
+
+def _parse_point_spec(spec: str):
+  """``"name[@index][#nth]"`` → (name, index_or_None, nth)."""
+  nth = 1
+  if "#" in spec:
+    spec, n = spec.rsplit("#", 1)
+    nth = int(n)
+  index = None
+  if "@" in spec:
+    spec, i = spec.rsplit("@", 1)
+    index = int(i)
+  return spec, index, nth
+
+
+def _sentinel_path(name: str, index) -> str:
+  safe = re.sub(r"[^A-Za-z0-9_.-]", "_", "%s_%s" % (name, index))
+  return os.path.join(os.getcwd(), ".tos_chaos_fired_%s" % safe)
+
+
+def kill_point(name: str, index: Optional[int] = None) -> None:
+  """Deterministic crash site: SIGKILL this process when armed.
+
+  Call sites name a point (e.g. ``"train-step"``) and pass their identity
+  (executor id) as ``index``; the ``TOS_CHAOS_KILL`` spec decides whether
+  and on which invocation the kill fires. SIGKILL — not an exception — so
+  the process dies exactly the way a preempted/OOM-killed host does: no
+  traceback, no cleanup, heartbeats just stop.
+  """
+  spec_env = os.environ.get(ENV_KILL)
+  if not spec_env:
+    return
+  with _lock:
+    count = _counts[(name, index)] = _counts.get((name, index), 0) + 1
+  for spec in spec_env.split(","):
+    sname, sindex, nth = _parse_point_spec(spec.strip())
+    if sname != name or (sindex is not None and sindex != index):
+      continue
+    if count != nth:
+      continue
+    sentinel = _sentinel_path(name, index)
+    if os.path.exists(sentinel):
+      return  # already fired in a previous incarnation of this node
+    with open(sentinel, "w") as f:
+      f.write("fired at count %d pid %d\n" % (count, os.getpid()))
+      f.flush()
+      os.fsync(f.fileno())
+    logger.warning("chaos: SIGKILL at point %r index %r (invocation %d)",
+                   name, index, count)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def stall_point(name: str, index: Optional[int] = None) -> float:
+  """Deterministic stall site: sleep when armed (first matching call per
+  process). Returns the seconds slept (0.0 when disarmed)."""
+  spec_env = os.environ.get(ENV_STALL)
+  if not spec_env:
+    return 0.0
+  for spec in spec_env.split(","):
+    spec = spec.strip()
+    if ":" not in spec:
+      continue
+    target, secs = spec.rsplit(":", 1)
+    sname, sindex, _ = _parse_point_spec(target)
+    if sname != name or (sindex is not None and sindex != index):
+      continue
+    key = (name, index, "stall")
+    with _lock:
+      if key in _stalled:
+        return 0.0
+      _stalled.add(key)
+    duration = float(secs)
+    logger.warning("chaos: stalling %.2fs at point %r index %r",
+                   duration, name, index)
+    time.sleep(duration)
+    return duration
+  return 0.0
+
+
+def message_fault(verb) -> Tuple[bool, float]:
+  """(drop, delay_seconds) for a rendezvous message of the given verb.
+
+  Consulted by ``rendezvous.Client`` before each send. A dropped message
+  never reaches the wire — the receiver simply never sees it, exactly like
+  a lost datagram — and the client proceeds as if it were sent.
+  """
+  drop_env = os.environ.get(ENV_RV_DROP)
+  delay_env = os.environ.get(ENV_RV_DELAY)
+  if not drop_env and not delay_env:
+    return False, 0.0
+  drop = False
+  delay = 0.0
+  if drop_env:
+    for spec in drop_env.split(","):
+      if ":" not in spec:
+        continue
+      sverb, count = spec.strip().split(":", 1)
+      if sverb != verb:
+        continue
+      with _lock:
+        seen = _rv_counts[(verb, "drop")] = \
+            _rv_counts.get((verb, "drop"), 0) + 1
+      if seen <= int(count):
+        drop = True
+  if delay_env:
+    for spec in delay_env.split(","):
+      parts = spec.strip().split(":")
+      if len(parts) < 2 or parts[0] != verb:
+        continue
+      limit = int(parts[2]) if len(parts) > 2 else None
+      with _lock:
+        seen = _rv_counts[(verb, "delay")] = \
+            _rv_counts.get((verb, "delay"), 0) + 1
+      if limit is None or seen <= limit:
+        delay = float(parts[1])
+  return drop, delay

@@ -144,21 +144,45 @@ class CheckpointManager(object):
     With ``data_iterator`` (anything exposing ``set_state``, e.g.
     ``CheckpointableInput``), a checkpointed input-pipeline state is
     pushed into it so the stream resumes mid-epoch.
+
+    Preemption-safe: this is the resume entry point for a node relaunched
+    after a SIGKILL/preemption (the supervisor hands the restart count to
+    the user fn via ``ctx.restart_count``). A checkpoint left unreadable
+    by a kill mid-save — orbax commits atomically, but storage layers lie
+    — is skipped with a warning, falling back to the newest step that
+    restores cleanly rather than wedging the relaunched node forever.
     """
     step = self._mgr.latest_step()
-    if step is None:
-      return state, 0
-    logger.info("resuming from checkpoint step %d", step)
-    if data_iterator is None:
-      return self.restore(state), step + 1
-    state, data = self.restore(state, with_data=True)
-    if data is not None:
-      data_iterator.set_state(data)
-    else:
-      logger.warning("checkpoint step %d has no input-pipeline state; "
-                     "the data iterator starts from its current position",
-                     step)
-    return state, step + 1
+    last_error = None
+    while step is not None:
+      logger.info("resuming from checkpoint step %d", step)
+      try:
+        if data_iterator is None:
+          return self.restore(state, step=step), step + 1
+        restored, data = self.restore(state, step=step, with_data=True)
+        if data is not None:
+          data_iterator.set_state(data)
+        else:
+          logger.warning("checkpoint step %d has no input-pipeline state; "
+                         "the data iterator starts from its current position",
+                         step)
+        return restored, step + 1
+      except Exception as e:  # noqa: BLE001 - torn/corrupt checkpoint
+        logger.warning("checkpoint step %d unreadable (%s: %s); trying the "
+                       "previous step", step, type(e).__name__, e)
+        last_error = e
+        older = [s for s in self._mgr.all_steps() if s < step]
+        step = max(older) if older else None
+    if last_error is not None:
+      # EVERY step failed to restore: that is a systemic problem (template
+      # mismatch, storage outage, bad credentials), not a torn checkpoint
+      # — silently retraining from step 0 would discard real progress
+      raise last_error
+    return state, 0
+
+  def all_steps(self):
+    """Every step with a checkpoint in this directory (ascending)."""
+    return sorted(self._mgr.all_steps())
 
   def wait(self) -> None:
     """Block until async saves land (call before process exit)."""

@@ -24,9 +24,15 @@ def export_model(state, export_dir: str, is_chief: bool) -> str:
 
   Returns the directory actually written to.
   """
+  import jax
+  import numpy as np
   import orbax.checkpoint as ocp
   from tensorflowonspark_tpu.utils import paths
 
+  # numpy SCALAR leaves (np.float32(3.0) — e.g. optimizer counts) are
+  # rejected by current orbax; 0-d ndarrays round-trip identically
+  state = jax.tree_util.tree_map(
+      lambda x: np.asarray(x) if isinstance(x, np.generic) else x, state)
   target = export_dir if is_chief else tempfile.mkdtemp(prefix="nonchief_export_")
   ckptr = ocp.StandardCheckpointer()
   ckptr.save(paths.for_io(paths.join(target, "model")), state, force=True)

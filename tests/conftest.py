@@ -21,3 +21,14 @@ from tensorflowonspark_tpu.utils.platform_env import force_cpu_platform
 force_cpu_platform(8)
 # keep subprocesses (LocalEngine executors) on CPU too
 os.environ.setdefault("TOS_TPU_TEST_MODE", "1")
+
+
+def pytest_configure(config):
+  config.addinivalue_line(
+      "markers",
+      "chaos: fault-injection recovery tests (utils.chaos). Part of the "
+      "tier-1 'not slow' selection — keep per-test deadlines tight (<10s); "
+      "run alone via `make chaos`.")
+  config.addinivalue_line(
+      "markers", "slow: long-running tests excluded from the tier-1 "
+      "selection (`-m 'not slow'`).")

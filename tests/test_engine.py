@@ -210,6 +210,59 @@ class TestLocalEngine:
     with pytest.raises(ValueError, match="executors"):
       local_engine.run_on_executors(_slot_and_pid, num_tasks=3)
 
+  def test_dead_executor_fails_task_and_respawns(self):
+    """SIGKILLing an executor mid-task marks the task with the
+    ExecutorLost prefix and respawns the slot; relaunch_task then re-runs
+    the task successfully on the fresh process."""
+    import signal
+    from tensorflowonspark_tpu.engine.base import is_executor_lost
+
+    e = LocalEngine(num_executors=2)
+    try:
+      victim_pid = e._procs[0].pid
+      job = e.run_on_executors(_sleep_then_slot, num_tasks=1)
+      time.sleep(0.3)                     # task is mid-sleep on slot 0
+      os.kill(victim_pid, signal.SIGKILL)
+      with pytest.raises(RuntimeError, match="ExecutorLost"):
+        job.wait(timeout=30)
+      assert is_executor_lost(job.errors[0])
+
+      # the slot was respawned: relaunching the task succeeds
+      e.relaunch_task(job, 0)
+      results = job.wait(timeout=30)
+      assert results[0] == "0"
+      assert job.first_error() is None
+      assert e._procs[0].pid != victim_pid
+    finally:
+      e.stop()
+
+  def test_idle_dead_executor_respawned(self):
+    """An executor killed while idle is respawned and keeps serving."""
+    import signal
+    e = LocalEngine(num_executors=2)
+    try:
+      results = e.run_on_executors(_slot_and_pid).wait(timeout=30)
+      old_pids = {r[2] for r in results}
+      victim_pid = e._procs[1].pid
+      os.kill(victim_pid, signal.SIGKILL)
+      deadline = time.time() + 10
+      while e._procs[1].pid == victim_pid and time.time() < deadline:
+        time.sleep(0.05)            # until the monitor swapped the slot
+      results = e.run_on_executors(_slot_and_pid).wait(timeout=30)
+      assert sorted(r[1] for r in results) == ["0", "1"]
+      assert len({r[2] for r in results} - old_pids) == 1
+    finally:
+      e.stop()
+
+  def test_relaunch_task_replaces_payload(self, local_engine):
+    job = local_engine.run_on_executors(_slot_and_pid, num_tasks=2,
+                                        task_payloads=["a", "b"])
+    job.wait(timeout=30)
+    local_engine.relaunch_task(job, 1, payload={"replacement": True})
+    assert not job.done()               # bookkeeping was reset
+    results = job.wait(timeout=30)
+    assert results[1][0] == [{"replacement": True}]
+
   def test_finished_jobs_evicted(self, local_engine):
     """The engine must not pin every job's results forever — the lazy map
     path's bounded-memory contract depends on eviction."""
@@ -258,6 +311,21 @@ class TestSparkEngineSpecific:
       spark_engine.map_partitions([[1, 2], [3, 4]], _square_sum,
                                   timeout=30)
     assert not caplog.records
+
+  def test_relaunch_task_resubmits_single_task(self, spark_engine):
+    """SparkEngine.relaunch_task re-runs one run_on_executors task as a
+    fresh single-task job and routes the result into the original slot."""
+    job = spark_engine.run_on_executors(_slot_and_pid, num_tasks=2)
+    job.wait(timeout=30)
+    spark_engine.relaunch_task(job, 0, payload="again")
+    results = job.wait(timeout=30)
+    assert results[0][0] == ["again"]
+
+  def test_relaunch_unsupported_for_data_jobs(self, spark_engine):
+    job = spark_engine.foreach_partition([[1]], _square_sum)
+    job.wait(timeout=30)
+    with pytest.raises(NotImplementedError):
+      spark_engine.relaunch_task(job, 0)
 
   def test_barrier_timeout_enforced(self, spark_engine):
     def _slow_barrier_fn(it, ctx):

@@ -64,6 +64,23 @@ class TestReservations:
     assert not r.duplicates
     assert r.get()[0]["pid"] == 200
 
+  def test_reclaimed_flag_on_other_host_still_flagged(self):
+    """The reclaimed escape hatch proves a SAME-HOST retry observed the
+    dead predecessor's hub; a different host claiming the slot cannot have
+    done that and stays a duplicate."""
+    r = Reservations(2)
+    r.add(_meta(0, host="h0", pid=100))
+    r.add(_meta(0, host="h1", pid=200, reclaimed=True))
+    assert len(r.duplicates) == 1
+
+  def test_same_process_resend_replaces_silently(self):
+    """A lost-reply retry from the SAME process is idempotent."""
+    r = Reservations(2)
+    r.add(_meta(0, host="h0", pid=100))
+    r.add(_meta(0, host="h0", pid=100, port=4242))
+    assert not r.duplicates
+    assert r.get()[0]["port"] == 4242
+
 
 class TestServerClient:
   def test_register_and_await(self):
@@ -254,6 +271,153 @@ class TestServerRobustness:
       c.close()
     finally:
       s.stop()
+
+  def test_oversized_message_refused_client_side(self):
+    """A client never puts an oversized message on the wire: send()
+    raises immediately (no reconnect loop against a server that would
+    just keep hanging up)."""
+    s = Server(1)
+    addr = s.start()
+    try:
+      c = Client(addr, timeout=2)
+      with pytest.raises(ValueError, match="oversized"):
+        c.register(_meta(0, blob=b"x" * (rendezvous.MAX_MESSAGE_BYTES + 1)))
+      # the connection is still usable for sane messages
+      c.register(_meta(0))
+      assert s.await_reservations(timeout=5)
+      c.close()
+    finally:
+      s.stop()
+
+  def test_oversized_forged_frame_rejected_server_side(self):
+    """A peer FORGING an oversized length header (bypassing the client's
+    send guard) is dropped by the server without harming other clients —
+    the receiving-side half of the MAX_MESSAGE_BYTES contract."""
+    import socket
+    import struct
+    s = Server(1)
+    addr = s.start()
+    try:
+      g = socket.create_connection(("127.0.0.1", addr[1]))
+      g.sendall(struct.pack(">I", rendezvous.MAX_MESSAGE_BYTES + 1))
+      g.sendall(b"payload-start")
+      time.sleep(0.2)
+      # the forger's connection is dead: the server sends nothing back
+      g.settimeout(2)
+      assert g.recv(1) == b""
+      g.close()
+      c = Client(("127.0.0.1", addr[1]))
+      c.register(_meta(0))
+      assert s.await_reservations(timeout=5)
+      c.close()
+    finally:
+      s.stop()
+
+  def test_oversized_reply_drops_client_connection(self):
+    """MessageSocket.receive refuses an oversized frame from the SERVER
+    side of the conversation too: the client surfaces ConnectionError
+    after its bounded retries instead of buffering 4GiB."""
+    import socket as socket_mod
+    import struct
+    import threading as threading_mod
+
+    lst = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    port = lst.getsockname()[1]
+    stop = threading_mod.Event()
+
+    def evil_server():
+      while not stop.is_set():
+        try:
+          lst.settimeout(0.5)
+          conn, _ = lst.accept()
+        except OSError:
+          continue
+        conn.recv(65536)
+        conn.sendall(struct.pack(">I", 0xFFFFFFF0))   # ~4GiB "reply"
+        conn.close()
+
+    t = threading_mod.Thread(target=evil_server, daemon=True)
+    t.start()
+    try:
+      c = Client(("127.0.0.1", port), timeout=1.5)
+      t0 = time.time()
+      with pytest.raises(ConnectionError, match="127.0.0.1"):
+        c.register(_meta(0))
+      assert time.time() - t0 < 10
+      c.close()
+    finally:
+      stop.set()
+      t.join(timeout=5)
+      lst.close()
+
+
+class TestClientReconnectBound:
+  def test_unreachable_server_raises_with_deadline_and_address(self):
+    """The reconnect loop is bounded: a dead server yields ConnectionError
+    naming host:port within ~timeout, not an infinite retry loop."""
+    import socket
+    # grab (and immediately release) a port so nothing listens on it
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    c = Client(("127.0.0.1", port), timeout=0.8)
+    t0 = time.time()
+    with pytest.raises(ConnectionError,
+                       match="127.0.0.1:%d" % port):
+      c.register(_meta(0))
+    elapsed = time.time() - t0
+    assert elapsed < 6, "reconnect loop overshot its deadline: %.1fs" % elapsed
+
+  def test_backoff_sleeps_capped(self):
+    """No single recovery sleep exceeds backoff_cap (+jitter)."""
+    import socket
+    from unittest import mock as umock
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    sleeps = []
+    real_sleep = time.sleep
+    with umock.patch.object(rendezvous.time, "sleep",
+                            side_effect=lambda d: (sleeps.append(d),
+                                                   real_sleep(min(d, 0.01)))):
+      c = Client(("127.0.0.1", port), timeout=1.0, backoff_base=0.05,
+                 backoff_cap=0.2)
+      with pytest.raises(ConnectionError):
+        c.register(_meta(0))
+    assert sleeps, "bounded retry loop never backed off"
+    assert max(sleeps) <= 0.2 * 1.5 + 1e-6   # cap × max jitter factor
+
+  def test_server_restart_within_deadline_recovers(self):
+    """A request issued while the server is briefly down succeeds once it
+    returns within the deadline (the reconnect loop's whole purpose)."""
+    import threading as threading_mod
+    from tensorflowonspark_tpu.utils.hostinfo import get_free_port
+    port = get_free_port()
+    with mock.patch.dict("os.environ",
+                         {rendezvous.ENV_SERVER_PORT: str(port)}):
+      c = Client(("127.0.0.1", port), timeout=15)
+
+      s_holder = {}
+
+      def start_late():
+        time.sleep(0.5)
+        s = Server(1)
+        s.start()
+        s_holder["s"] = s
+
+      t = threading_mod.Thread(target=start_late)
+      t.start()
+      try:
+        c.register(_meta(0))      # retries until the server appears
+        assert s_holder["s"].await_reservations(timeout=5)
+        c.close()
+      finally:
+        t.join()
+        s_holder["s"].stop()
 
 
 class TestEnvOverrides:
