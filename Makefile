@@ -4,18 +4,32 @@
 
 PY ?= python
 
-.PHONY: test lint native bench dryrun mosaic-gate validate clean chaos
+.PHONY: test lint analyze check native bench dryrun mosaic-gate validate \
+	clean chaos
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
 validate: test dryrun mosaic-gate
 
 # stdlib-only lint gate (this image has no ruff/pycodestyle/mypy and no
-# network); scope parity with the reference's tox pycodestyle/pylint envs
+# network); scope parity with the reference's tox pycodestyle/pylint envs.
+# tools/lint.py is a shim over `python -m tools.analyze --style`.
 lint:
 	$(PY) tools/lint.py
 
-test: lint
+# tosa: the distributed-runtime static analysis suite (TOS001-TOS008 rule
+# passes + the style pass) — see docs/ANALYSIS.md. Exit 0 means every
+# finding is fixed, suppressed inline, or baselined with a reason.
+analyze:
+	$(PY) -m tools.analyze --all
+
+# fast pre-commit gate: static analysis + style + the fast test subset
+# (`--changed` variant for iteration: `python -m tools.analyze --changed`)
+check: analyze
+	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
+	  tests/test_misc.py -q
+
+test: analyze
 	$(PY) -m pytest tests/ -q
 
 # fault-injection suite only: kill/relaunch/resume/requeue recovery paths

@@ -147,6 +147,13 @@ class QueueFull(Exception):
     super().__init__("queue full (admitted=%d)" % admitted)
     self.admitted = admitted
 
+  def __reduce__(self):
+    # BaseManager proxies pickle server-side exceptions back to the caller;
+    # the default Exception reduction replays __init__ with the formatted
+    # message string, which "%d" rejects — clients then saw a bare
+    # TypeError instead of QueueFull (and lost the admitted count)
+    return (QueueFull, (self.admitted,))
+
 
 class QueueEmpty(Exception):
   pass

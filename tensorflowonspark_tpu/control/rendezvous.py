@@ -589,12 +589,19 @@ class Client(MessageSocket):
 
   def _connect(self) -> socket.socket:
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    # a per-operation socket deadline: a server that stopped serving (or a
-    # half-open connection) must surface as a retryable timeout, never as
-    # an unbounded recv() — request/reply exchanges here are all small and
-    # fast, so a generous cap costs nothing
-    s.settimeout(max(1.0, min(self.timeout, 10.0)))
-    s.connect(self.server_addr)
+    try:
+      # a per-operation socket deadline: a server that stopped serving (or
+      # a half-open connection) must surface as a retryable timeout, never
+      # as an unbounded recv() — request/reply exchanges here are all small
+      # and fast, so a generous cap costs nothing
+      s.settimeout(max(1.0, min(self.timeout, 10.0)))
+      s.connect(self.server_addr)
+    except BaseException:
+      # the reconnect loop retries for the whole deadline budget; each
+      # failed attempt must release its socket or the retries pile up fds
+      # in a long-lived executor process (TOS006)
+      s.close()
+      raise
     return s
 
   def _request(self, msg: dict) -> dict:

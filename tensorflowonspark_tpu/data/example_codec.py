@@ -66,7 +66,6 @@ def _write_len_delimited(out: bytearray, field: int, payload: bytes) -> None:
 
 
 def _encode_feature(values: FeatureValue) -> bytes:
-  inner = bytearray()
   if not values:
     # empty feature: a BytesList message with zero entries
     out = bytearray()

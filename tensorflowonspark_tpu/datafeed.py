@@ -132,9 +132,32 @@ class DataFeed(object):
     """True once the end-of-feed marker was consumed (parity :303-305)."""
     return self.done_feeding
 
-  def batch_results(self, results: Sequence) -> None:
-    """Push a batch of inference results (parity :307-318)."""
-    self._queue_out.put_many(list(results), block=True)
+  def batch_results(self, results: Sequence,
+                    timeout: Optional[float] = None) -> None:
+    """Push a batch of inference results (parity :307-318).
+
+    Bounded (TOS001): the push blocks at most ``timeout`` seconds
+    (default: this feed's ``liveness_timeout``). An unbounded put here
+    wedged the node forever when the inference collector died — the
+    worker kept its executor busy and a pinned relaunch could never
+    schedule behind it (the PR 1 slot-deadlock class).
+    """
+    timeout = timeout if timeout is not None else self.liveness_timeout
+    try:
+      self._queue_out.put_many(list(results), block=True, timeout=timeout)
+    except Exception as e:  # noqa: BLE001 - recast ONLY the queue-full
+      # timeout (which may arrive as a proxy-re-raised feedhub.QueueFull)
+      if type(e).__name__ != "QueueFull":
+        raise
+      admitted = getattr(e, "admitted", 0)
+      err = FeedStalledError(
+          "output queue still full after %.0fs pushing %d result(s) (%d "
+          "already enqueued — skip them on retry) — the inference collector "
+          "is presumed dead" % (timeout or 0, len(results), admitted))
+      # a timed-out put_many may have enqueued a prefix; callers that retry
+      # must resume at results[admitted:] or they double-deliver
+      err.admitted = admitted
+      raise err from e
 
   def terminate(self) -> None:
     """Request early termination: mark the hub terminating and drain the

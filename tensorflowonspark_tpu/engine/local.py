@@ -34,12 +34,16 @@ logger = logging.getLogger(__name__)
 
 _STOP = "__stop_executor__"
 
+#: exported into every executor process; consumed by pipeline transforms
+#: for host-local chip placement (env registry: tools.analyze TOS008)
+ENV_EXECUTOR_SLOT = "TOS_EXECUTOR_SLOT"
+
 
 def _executor_main(slot: int, workdir: str, task_q, result_q, env: Dict[str, str]):
   """Executor process entry point: run one task at a time, forever."""
   os.chdir(workdir)
   os.environ.update(env)
-  os.environ["TOS_EXECUTOR_SLOT"] = str(slot)
+  os.environ[ENV_EXECUTOR_SLOT] = str(slot)
   while True:
     item = task_q.get()
     if item == _STOP:

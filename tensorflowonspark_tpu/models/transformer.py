@@ -509,7 +509,7 @@ class Attention(nn.Module):
         if single:
           return flash_attention(q, k, v, causal=True, interpret=interp,
                                  window=win).astype(q.dtype)
-        from jax import shard_map
+        from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
         from jax.sharding import PartitionSpec as P
         batch_axes = mesh_lib.data_axes(self.mesh) or None
         t_ax = mesh_lib.AXIS_TENSOR \

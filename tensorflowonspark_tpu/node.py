@@ -39,6 +39,9 @@ BACKGROUND_ROLES = ("ps", "evaluator")     # roles parked on a control queue
 
 HUB_ADDR_FILE = "hub_addr"
 
+#: pins the per-node coordinator/collectives port (env registry: TOS008)
+ENV_NODE_PORT = "TOS_TPU_NODE_PORT"
+
 
 class TPUNodeContext(object):
   """Per-node metadata handed to the user main fn as ``ctx``.
@@ -273,8 +276,14 @@ def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
     logger.error("background main fn failed:\n%s", tb)
     try:
       hub.get_queue("error").put(tb)
-    except Exception:  # noqa: BLE001
-      pass
+    except Exception:  # noqa: BLE001 - error queue unreachable: fall back
+      # so the failure still reaches the driver instead of vanishing with
+      # this process (TOS004 — traceback propagation is the contract)
+      try:
+        hub.set("last_error", tb)   # the kv store may outlive queue breakage
+      except Exception:  # noqa: BLE001 - hub manager fully gone; the
+        # executor's inherited stderr is the last channel that still works
+        os.write(2, ("background main fn failed:\n%s" % tb).encode())
   finally:
     if sender is not None:
       sender.stop()
@@ -379,70 +388,84 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
     # (parity with TF GRPC port reservation, :344-352); env pin supported
     tmp_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     tmp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    tmp_sock.bind(("", int(os.environ.get("TOS_TPU_NODE_PORT", "0"))))
+    tmp_sock.bind(("", int(os.environ.get(ENV_NODE_PORT, "0"))))
     port = tmp_sock.getsockname()[1]
 
-    # 6. TensorBoard on chief / worker:0 (parity :292-329)
-    tb_info = None
-    if meta.get("tensorboard") and is_chief(job_name, task_index,
-                                            meta["cluster_template"]):
-      log_dir = meta.get("log_dir") or os.path.join(working_dir, "tensorboard")
-      os.makedirs(paths.strip_scheme(log_dir), exist_ok=True)
-      tb_info = _spawn_tensorboard(paths.strip_scheme(log_dir))
-      if tb_info:
-        hub.set("tb_pid", tb_info["pid"])
-        hub.set("tb_url", tb_info["url"])
+    # Steps 6-8 run with the reserved port open in a PERSISTENT executor
+    # process: a bring-up failure (TB spawn error, reservation timeout,
+    # chip-allocation error) must release the socket or every supervised
+    # retry leaks one fd into the executor (TOS006).
+    try:
+      # 6. TensorBoard on chief / worker:0 (parity :292-329)
+      tb_info = None
+      if meta.get("tensorboard") and is_chief(job_name, task_index,
+                                              meta["cluster_template"]):
+        log_dir = meta.get("log_dir") or os.path.join(working_dir,
+                                                      "tensorboard")
+        os.makedirs(paths.strip_scheme(log_dir), exist_ok=True)
+        tb_info = _spawn_tensorboard(paths.strip_scheme(log_dir))
+        if tb_info:
+          hub.set("tb_pid", tb_info["pid"])
+          hub.set("tb_url", tb_info["url"])
 
-    # 7. register and wait for the whole cluster (parity :332-370)
-    host = hostinfo.get_ip_address()
-    client = rendezvous.Client(tuple(meta["server_addr"]))
-    reservation = {
-        "executor_id": executor_id,
-        "host": host,
-        "job_name": job_name,
-        "task_index": task_index,
-        "port": port,
-        "hub_addr": list(hub.addr),
-        "pid": os.getpid(),
-        "tb_url": tb_info["url"] if tb_info else None,
-        # a reclaimed stale hub proves this is a retry of a dead predecessor,
-        # not a concurrent task — the rendezvous replaces instead of flagging
-        # a duplicate (Reservations.add)
-        "reclaimed": reclaimed,
-        # restart generation: lets the supervisor recognize THIS relaunch's
-        # registration (the pid alone is ambiguous — an ENGINE-mode relaunch
-        # reuses the executor process)
-        "restart": restart_count,
-    }
-    client.register(reservation)
-    cluster_info = client.await_reservations(
-        timeout=meta.get("reservation_timeout", 600))
-    client.close()
+      # 7. register and wait for the whole cluster (parity :332-370)
+      host = hostinfo.get_ip_address()
+      client = rendezvous.Client(tuple(meta["server_addr"]))
+      reservation = {
+          "executor_id": executor_id,
+          "host": host,
+          "job_name": job_name,
+          "task_index": task_index,
+          "port": port,
+          "hub_addr": list(hub.addr),
+          "pid": os.getpid(),
+          "tb_url": tb_info["url"] if tb_info else None,
+          # a reclaimed stale hub proves this is a retry of a dead
+          # predecessor, not a concurrent task — the rendezvous replaces
+          # instead of flagging a duplicate (Reservations.add)
+          "reclaimed": reclaimed,
+          # restart generation: lets the supervisor recognize THIS
+          # relaunch's registration (the pid alone is ambiguous — an
+          # ENGINE-mode relaunch reuses the executor process)
+          "restart": restart_count,
+      }
+      try:
+        client.register(reservation)
+        cluster_info = client.await_reservations(
+            timeout=meta.get("reservation_timeout", 600))
+      finally:
+        # a reservation timeout is the COMMON bring-up failure; without
+        # this the persistent executor leaks one connected client fd per
+        # supervised retry (TOS006)
+        client.close()
 
-    # 7.5 TPU chip allocation (replaces nvidia-smi GPU allocation,
-    # parity :179-239). Runs AFTER reservation so the host-local worker
-    # index comes from the actual host population in cluster_info (parity
-    # with the reference's cluster-spec-derived local index, :386-388) —
-    # executor ids are NOT contiguous per host, so id % workers_per_host
-    # would double-claim chips.
-    num_chips = meta.get("chips_per_node", 0)
-    if num_chips and not os.environ.get("TOS_TPU_TEST_MODE"):
-      topo = tpu_info.get_topology()
-      if topo is not None:
-        cohosted = sorted(n["executor_id"] for n in cluster_info
-                          if n["host"] == host)
-        local_index = cohosted.index(executor_id)
-        workers_per_host = max(1, topo.chips_per_host // num_chips)
-        tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
-            num_chips, local_index, workers_per_host,
-            generation=topo.generation))
+      # 7.5 TPU chip allocation (replaces nvidia-smi GPU allocation,
+      # parity :179-239). Runs AFTER reservation so the host-local worker
+      # index comes from the actual host population in cluster_info (parity
+      # with the reference's cluster-spec-derived local index, :386-388) —
+      # executor ids are NOT contiguous per host, so id % workers_per_host
+      # would double-claim chips.
+      num_chips = meta.get("chips_per_node", 0)
+      if num_chips and not os.environ.get(tpu_info.ENV_TEST_MODE):
+        topo = tpu_info.get_topology()
+        if topo is not None:
+          cohosted = sorted(n["executor_id"] for n in cluster_info
+                            if n["host"] == host)
+          local_index = cohosted.index(executor_id)
+          workers_per_host = max(1, topo.chips_per_host // num_chips)
+          tpu_info.apply_chip_env(tpu_info.chip_env_for_worker(
+              num_chips, local_index, workers_per_host,
+              generation=topo.generation))
 
-    # 8. synthesize the cluster spec + JAX process coordinates (the TPU
-    # analog of exporting TF_CONFIG, parity :373-384)
-    cluster_spec = _build_cluster_spec(cluster_info)
-    table, coordinator = _jax_process_table(cluster_info)
-    process_id = next((i for i, n in enumerate(table)
-                       if n["executor_id"] == executor_id), -1)
+      # 8. synthesize the cluster spec + JAX process coordinates (the TPU
+      # analog of exporting TF_CONFIG, parity :373-384)
+      cluster_spec = _build_cluster_spec(cluster_info)
+      table, coordinator = _jax_process_table(cluster_info)
+      process_id = next((i for i, n in enumerate(table)
+                         if n["executor_id"] == executor_id), -1)
+    except BaseException:
+      tmp_sock.close()
+      raise
 
     ctx_kwargs = dict(
         executor_id=executor_id, job_name=job_name, task_index=task_index,
@@ -899,6 +922,13 @@ def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
     if errs:
       eq.put_many(errs)
       raise RuntimeError("worker error:\n%s" % "\n".join(str(e) for e in errs))
+    # the background runner's fallback channel: a traceback it could not
+    # enqueue (error queue unreachable at crash time) lands in the kv store
+    last_error = hub.get("last_error")
+    if last_error:
+      raise RuntimeError("worker error (recovered from the hub kv store — "
+                         "the error queue was unreachable when the node "
+                         "crashed):\n%s" % last_error)
     return [executor_id]
 
   return _shutdown

@@ -8,6 +8,11 @@ few ops XLA cannot fuse optimally are written in Pallas:
   ring attention's sequence parallelism.
 """
 
+#: env registry (tools.analyze TOS008): "0"/"1" force real/interpret
+#: Pallas execution; unset/"auto" = interpret off-TPU, real kernels on TPU
+ENV_PALLAS_INTERPRET = "TOS_PALLAS_INTERPRET"
+
+
 def pallas_interpret() -> bool:
   """Whether Pallas kernels should run in interpret (emulation) mode.
 
@@ -20,7 +25,7 @@ def pallas_interpret() -> bool:
   interpret everywhere (debugging on-chip numerics).
   """
   import os
-  v = os.environ.get("TOS_PALLAS_INTERPRET", "auto").lower()
+  v = os.environ.get(ENV_PALLAS_INTERPRET, "auto").lower()
   if v in ("0", "false"):
     return False
   if v in ("1", "true"):
@@ -40,7 +45,7 @@ def pallas_kernels_enabled() -> bool:
   which is the flag's on-chip numerics-debugging purpose.
   """
   import os
-  if os.environ.get("TOS_PALLAS_INTERPRET", "").lower() in ("0", "false"):
+  if os.environ.get(ENV_PALLAS_INTERPRET, "").lower() in ("0", "false"):
     return True
   import jax
   return jax.default_backend() == "tpu"

@@ -142,7 +142,7 @@ def gelu_matmul_sharded(x, W, mesh, blk_rows: int = 128,
   over tensor (replicated if indivisible); W: [F, N] sharded on F the
   same way; output [batch, seq, N] with N unsharded.
   """
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
   from jax import lax
   from jax.sharding import PartitionSpec as P
   from tensorflowonspark_tpu.parallel import mesh as mesh_lib

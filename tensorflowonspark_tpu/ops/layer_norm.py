@@ -85,7 +85,7 @@ def layer_norm_sharded(x, weight, mesh, eps: float = 1e-6,
   x: [batch, seq, hidden] with batch sharded over the data(+fsdp) axes and
   seq optionally over the sequence axis; weight replicated.
   """
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
   from jax.sharding import PartitionSpec as P
   from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 

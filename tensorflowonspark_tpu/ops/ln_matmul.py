@@ -159,7 +159,7 @@ def ln_matmul_sharded(x, w_ln, W, mesh, eps: float = 1e-6,
   psums dW / dw_ln over the row (data/sequence) axes, matching the
   dense AD (asserted in tests/test_ops.py).
   """
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
   from jax.sharding import PartitionSpec as P
   from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 

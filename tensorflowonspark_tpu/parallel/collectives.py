@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tensorflowonspark_tpu.utils import compat
+
 
 def psum_mean(x, axis_name: str):
   """All-reduce average over a mesh axis (gradient sync primitive)."""
@@ -33,7 +35,7 @@ def reduce_scatter(x, axis_name: str, axis: int = 0):
 
 def ring_permute(x, axis_name: str, shift: int = 1):
   """Rotate shards around the mesh-axis ring (neighbor exchange on ICI)."""
-  n = lax.axis_size(axis_name)
+  n = compat.jax_axis_size(axis_name)
   perm = [(i, (i + shift) % n) for i in range(n)]
   return lax.ppermute(x, axis_name, perm)
 
@@ -65,7 +67,8 @@ def hierarchical_all_reduce(x, ici_axis: str, dcn_axis: str,
   shard = lax.psum(shard, dcn_axis)
   out = lax.all_gather(shard, ici_axis, axis=scatter_axis, tiled=True)
   if mean:
-    out = out / (lax.axis_size(ici_axis) * lax.axis_size(dcn_axis))
+    out = out / (compat.jax_axis_size(ici_axis) *
+                 compat.jax_axis_size(dcn_axis))
   return out
 
 
@@ -138,6 +141,6 @@ def all_processes_agree(flag: bool) -> bool:
 def shard_map_fn(fn: Callable, mesh, in_specs, out_specs,
                  check_vma: bool = False):
   """Thin wrapper over jax.shard_map bound to a mesh."""
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
   return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_vma=check_vma)

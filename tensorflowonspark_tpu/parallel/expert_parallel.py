@@ -131,7 +131,7 @@ def _moe_local(x, dispatch, combine, w_up, w_down):
 def moe_ffn(params, x, mesh, top_k: int = 1, routing=None):
   """Expert-sharded MoE FFN. x: [tokens, d_model] (shard tokens over the
   data axes as usual); expert weights sharded over the expert axis."""
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
 
   dispatch, combine = routing if routing is not None \
       else _route(params, x, top_k)                    # [T, E] replicated
@@ -194,7 +194,7 @@ def moe_ffn_a2a(params, x, mesh, capacity_factor: float = 2.0,
   semantics; with top-k > 1 a token's surviving experts keep their
   renormalized weights).
   """
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
 
   num_experts = params["w_gate"].shape[-1]
   batch_axes = mesh_lib.data_axes(mesh)

@@ -41,6 +41,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+from tensorflowonspark_tpu.utils import compat
 
 
 def _split_microbatches(arr, num_microbatches: int, mesh):
@@ -63,7 +64,7 @@ def _pipeline_local(stage_params, x_micro, stage_fn: Callable,
   """shard_map body. stage_params: this device's stage (leading axis
   squeezed); x_micro: [n_micro, micro_batch, ...] (replicated along the
   pipeline axis)."""
-  n_stages = lax.axis_size(axis_name)
+  n_stages = compat.jax_axis_size(axis_name)
   idx = lax.axis_index(axis_name)
   n_micro = x_micro.shape[0]
   total_steps = n_micro + n_stages - 1
@@ -114,7 +115,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh,
 
   Returns [batch, ...] outputs.
   """
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
 
   x_micro = _split_microbatches(x, num_microbatches, mesh)
 
@@ -233,7 +234,7 @@ def _1f1b_lm_local(outer_params, stage_params, tok_arr, tgt_arr,
   step, a few percent of the activation ppermute's bytes at transformer
   widths.
   """
-  S = lax.axis_size(axis_name)
+  S = compat.jax_axis_size(axis_name)
   s = lax.axis_index(axis_name)
   if scattered:
     tok_local, tgt_local = tok_arr[0], tgt_arr[0]   # [L, micro_b, ...]
@@ -409,7 +410,7 @@ def pipeline_lm_train_step(embed_fn: Callable, stage_fn: Callable,
 
   Returns ``(loss, outer_grads, stage_grads)``.
   """
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
 
   tok_micro = _split_microbatches(tokens, num_microbatches, mesh)
   tgt_micro = _split_microbatches(targets, num_microbatches, mesh)

@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+from tensorflowonspark_tpu.utils import compat
 
 NEG_INF = -1e30
 
@@ -87,7 +88,7 @@ def _block_attn(q, k, v, m, l, o, q_offset, kv_offset, causal, scale,
 
 def _ring_attn_local(q, k, v, axis_name: str, causal: bool, window=None):
   """shard_map body: full attention with KV blocks rotating around the ring."""
-  n = lax.axis_size(axis_name)
+  n = compat.jax_axis_size(axis_name)
   my = lax.axis_index(axis_name)
   b, s_local, h, d = q.shape
   scale = 1.0 / (d ** 0.5)
@@ -131,7 +132,7 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool, blk_q: int,
   from tensorflowonspark_tpu.ops.flash_attention import (
       NEG_INF as _NEG_INF, flash_attention_block, merge_partials)
 
-  n = lax.axis_size(axis_name)
+  n = compat.jax_axis_size(axis_name)
   my = lax.axis_index(axis_name)
   b, s_local, h, d = q.shape
 
@@ -193,7 +194,7 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
 
   Returns attention output with the same sharding as ``q``.
   """
-  from jax import shard_map
+  from tensorflowonspark_tpu.utils.compat import jax_shard_map as shard_map
 
   batch_axes = batch_axes if batch_axes is not None else \
       mesh_lib.data_axes(mesh)

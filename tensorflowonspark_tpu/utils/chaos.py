@@ -57,19 +57,98 @@ _stalled = set()
 _rv_counts = {}
 _lock = threading.Lock()
 
+_KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY)
+_ENV_PREFIX = "TOS_CHAOS_"
+#: cache of the last validated env signature (validation is consulted from
+#: hot paths like the rendezvous client's per-request chaos check)
+_validated = None
+#: first-consult guard: hooks fast-path on their OWN env var, so with only
+#: a typo'd TOS_CHAOS_* name set every hook would return before reaching
+#: check_config — scanned once per process (reset() re-arms)
+_first_consult_done = False
+
+
+def _first_consult():
+  global _first_consult_done
+  if _first_consult_done:
+    return
+  _first_consult_done = True
+  if any(k.startswith(_ENV_PREFIX) for k in os.environ):
+    check_config()
+
+
+def check_config() -> None:
+  """Validate every armed fault schedule; raise ValueError on bad config.
+
+  A chaos run with a typo'd knob used to be a silent no-op twice over: an
+  unknown ``TOS_CHAOS_*`` name was never read, and a malformed spec value
+  was skipped by the parser (``"BEAT;3"`` simply never matched) — the test
+  then 'passed' without injecting anything. Every hook entry point calls
+  this, so fault schedules are asserted the first time chaos is consulted
+  in a process (and again whenever the env signature changes).
+  """
+  global _validated
+  sig = tuple(os.environ.get(k) for k in _KNOWN_ENV) + tuple(
+      sorted(k for k in os.environ if k.startswith(_ENV_PREFIX)))
+  if sig == _validated:
+    return
+  unknown = sorted(k for k in os.environ
+                   if k.startswith(_ENV_PREFIX) and k not in _KNOWN_ENV)
+  if unknown:
+    raise ValueError(
+        "unknown chaos env var(s) %s — known knobs: %s (a typo'd name "
+        "would silently inject nothing)" % (unknown, list(_KNOWN_ENV)))
+  for spec in _split_specs(os.environ.get(ENV_KILL)):
+    try:
+      _parse_point_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed kill spec %r (want "
+                       "'point[@index][#nth]')" % (ENV_KILL, spec))
+  for spec in _split_specs(os.environ.get(ENV_STALL)):
+    try:
+      _parse_stall_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed stall spec %r (want "
+                       "'point[@index]:seconds')" % (ENV_STALL, spec))
+  for spec in _split_specs(os.environ.get(ENV_RV_DROP)):
+    try:
+      _parse_drop_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed drop spec %r (want 'VERB:count')"
+                       % (ENV_RV_DROP, spec))
+  for spec in _split_specs(os.environ.get(ENV_RV_DELAY)):
+    try:
+      _parse_delay_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed delay spec %r (want "
+                       "'VERB:seconds[:count]')" % (ENV_RV_DELAY, spec))
+  _validated = sig
+
+
+def _split_specs(env_value):
+  if not env_value:
+    return []
+  return [s.strip() for s in env_value.split(",") if s.strip()]
+
 
 def enabled() -> bool:
   """True when any chaos env var is armed (cheap fast-path guard)."""
-  return any(os.environ.get(k) for k in
-             (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY))
+  _first_consult()
+  armed = any(os.environ.get(k) for k in _KNOWN_ENV)
+  if armed:
+    check_config()
+  return armed
 
 
 def reset() -> None:
   """Forget per-process counters (test isolation helper)."""
+  global _validated, _first_consult_done
   with _lock:
     _counts.clear()
     _stalled.clear()
     _rv_counts.clear()
+    _validated = None
+    _first_consult_done = False
 
 
 def _parse_point_spec(spec: str):
@@ -83,6 +162,35 @@ def _parse_point_spec(spec: str):
     spec, i = spec.rsplit("@", 1)
     index = int(i)
   return spec, index, nth
+
+
+# One parse function per knob grammar, shared by check_config AND the hooks
+# — a validator that re-implemented the grammar could accept a spec the hook
+# then silently never matched (the no-op class this module exists to kill).
+
+def _parse_stall_spec(spec: str):
+  """``"point[@index]:seconds"`` → ((name, index, nth), seconds)."""
+  if ":" not in spec:
+    raise ValueError(spec)
+  target, secs = spec.rsplit(":", 1)
+  return _parse_point_spec(target), float(secs)
+
+
+def _parse_drop_spec(spec: str):
+  """``"VERB:count"`` → (verb, count)."""
+  parts = spec.split(":")
+  if len(parts) != 2 or not parts[0]:
+    raise ValueError(spec)
+  return parts[0], int(parts[1])
+
+
+def _parse_delay_spec(spec: str):
+  """``"VERB:seconds[:count]"`` → (verb, seconds, count_or_None)."""
+  parts = spec.split(":")
+  if len(parts) not in (2, 3) or not parts[0]:
+    raise ValueError(spec)
+  return (parts[0], float(parts[1]),
+          int(parts[2]) if len(parts) == 3 else None)
 
 
 def _sentinel_path(name: str, index) -> str:
@@ -99,9 +207,11 @@ def kill_point(name: str, index: Optional[int] = None) -> None:
   the process dies exactly the way a preempted/OOM-killed host does: no
   traceback, no cleanup, heartbeats just stop.
   """
+  _first_consult()
   spec_env = os.environ.get(ENV_KILL)
   if not spec_env:
     return
+  check_config()
   with _lock:
     count = _counts[(name, index)] = _counts.get((name, index), 0) + 1
   for spec in spec_env.split(","):
@@ -125,15 +235,13 @@ def kill_point(name: str, index: Optional[int] = None) -> None:
 def stall_point(name: str, index: Optional[int] = None) -> float:
   """Deterministic stall site: sleep when armed (first matching call per
   process). Returns the seconds slept (0.0 when disarmed)."""
+  _first_consult()
   spec_env = os.environ.get(ENV_STALL)
   if not spec_env:
     return 0.0
+  check_config()
   for spec in spec_env.split(","):
-    spec = spec.strip()
-    if ":" not in spec:
-      continue
-    target, secs = spec.rsplit(":", 1)
-    sname, sindex, _ = _parse_point_spec(target)
+    (sname, sindex, _), duration = _parse_stall_spec(spec.strip())
     if sname != name or (sindex is not None and sindex != index):
       continue
     key = (name, index, "stall")
@@ -141,7 +249,6 @@ def stall_point(name: str, index: Optional[int] = None) -> float:
       if key in _stalled:
         return 0.0
       _stalled.add(key)
-    duration = float(secs)
     logger.warning("chaos: stalling %.2fs at point %r index %r",
                    duration, name, index)
     time.sleep(duration)
@@ -156,33 +263,32 @@ def message_fault(verb) -> Tuple[bool, float]:
   never reaches the wire — the receiver simply never sees it, exactly like
   a lost datagram — and the client proceeds as if it were sent.
   """
+  _first_consult()
   drop_env = os.environ.get(ENV_RV_DROP)
   delay_env = os.environ.get(ENV_RV_DELAY)
   if not drop_env and not delay_env:
     return False, 0.0
+  check_config()
   drop = False
   delay = 0.0
   if drop_env:
     for spec in drop_env.split(","):
-      if ":" not in spec:
-        continue
-      sverb, count = spec.strip().split(":", 1)
+      sverb, count = _parse_drop_spec(spec.strip())
       if sverb != verb:
         continue
       with _lock:
         seen = _rv_counts[(verb, "drop")] = \
             _rv_counts.get((verb, "drop"), 0) + 1
-      if seen <= int(count):
+      if seen <= count:
         drop = True
   if delay_env:
     for spec in delay_env.split(","):
-      parts = spec.strip().split(":")
-      if len(parts) < 2 or parts[0] != verb:
+      dverb, secs, limit = _parse_delay_spec(spec.strip())
+      if dverb != verb:
         continue
-      limit = int(parts[2]) if len(parts) > 2 else None
       with _lock:
         seen = _rv_counts[(verb, "delay")] = \
             _rv_counts.get((verb, "delay"), 0) + 1
       if limit is None or seen <= limit:
-        delay = float(parts[1])
+        delay = secs
   return drop, delay

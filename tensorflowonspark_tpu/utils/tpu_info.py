@@ -29,6 +29,14 @@ from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
+#: env registry (tools.analyze TOS008) — chip-allocation knobs consumed
+#: across node.py / pipeline.py / utils.hostinfo:
+#: skip all chip claiming (CPU test runs against fake topologies)
+ENV_TEST_MODE = "TOS_TPU_TEST_MODE"
+#: sentinel exported once a process has claimed its chip share, so a later
+#: task on the same executor process does not double-claim
+ENV_CHIP_ENV_APPLIED = "TOS_CHIP_ENV_APPLIED"
+
 # Accelerator type → (chips/host, name_cores/chip, jax_devices/chip).
 # The accelerator-type suffix counts TensorCores on v2/v3/v4/v5p (2 cores per
 # chip) and chips on v5e/v6e (1 core per chip). v4+ chips are megacore: JAX

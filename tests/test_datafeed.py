@@ -119,6 +119,29 @@ class TestLiveness:
     out = hub.get_queue("output")
     assert out.get_many(5) == [10, 20, 30]
 
+  def test_queue_full_pickle_roundtrip(self):
+    # BaseManager proxies pickle server-side exceptions back to callers;
+    # without __reduce__ the reconstruction replayed __init__ with the
+    # formatted message and clients got a TypeError instead of QueueFull
+    import pickle
+    e = pickle.loads(pickle.dumps(feedhub.QueueFull(3)))
+    assert isinstance(e, feedhub.QueueFull)
+    assert e.admitted == 3
+
+  def test_batch_results_stalled_collector_raises(self):
+    from tensorflowonspark_tpu.datafeed import FeedStalledError
+    h = feedhub.start(b"k", ["input", "output", "error"], qmax=4)
+    try:
+      feed = DataFeed(h, train_mode=False)
+      feed.batch_results([1, 2, 3])            # fits (3 of 4)
+      with pytest.raises(FeedStalledError) as ei:
+        feed.batch_results([4, 5], timeout=0.5)   # admits 1, then full
+      # the admitted prefix reached the queue; retries must skip it
+      assert ei.value.admitted == 1
+      assert h.get_queue("output").get_many(10) == [1, 2, 3, 4]
+    finally:
+      h.shutdown()
+
   def test_terminate_drains_and_flags(self, hub):
     q = hub.get_queue("input")
     q.put_many(list(range(500)))

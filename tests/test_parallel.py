@@ -722,7 +722,6 @@ class TestShardedTrainStep:
 
     step = SH.make_train_step(loss_fn, mesh, sharding,
                               batch_extra_axes=(M.AXIS_SEQUENCE,))
-    rng = np.random.RandomState(0)
     # a learnable pattern: token ids follow a fixed cycle
     base = np.tile(np.arange(seq) % 16, (4, 1)).astype("int32")
     tokens = SH.shard_batch(jnp.asarray(base), mesh,

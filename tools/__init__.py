@@ -1,0 +1,1 @@
+"""Repo tooling (bench/validate/analyze). Kept importable for tools.analyze."""

@@ -1,0 +1,62 @@
+"""tosa: distributed-runtime-aware static analysis for this repo.
+
+``python -m tools.analyze``          — TOS rule passes over the package
+``python -m tools.analyze --style``  — style pass (the former tools/lint.py)
+``python -m tools.analyze --all``    — both (what ``make analyze`` runs)
+
+See docs/ANALYSIS.md for the rule catalogue, the incidents each rule
+encodes, and the baseline/suppression policy.
+"""
+
+from typing import Dict, List, Optional
+
+from tools.analyze import baseline as baseline_mod
+from tools.analyze.engine import RepoModel, collect_files
+from tools.analyze.rules import Finding, run_rules
+
+__all__ = ["run_analysis", "RepoModel", "Finding"]
+
+
+def run_analysis(paths: List[str], baseline_path: Optional[str] = None,
+                 only_files: Optional[List[str]] = None,
+                 sources: Optional[Dict[str, str]] = None) -> dict:
+  """Run the TOS rule passes; returns a result dict.
+
+  ``paths``: roots to parse (the whole set feeds the call graph, so
+  reachability is computed repo-wide even with ``only_files``).
+  ``only_files``: restrict REPORTED findings to these files.
+  ``sources``: pre-loaded {path: source} (tests inject fixtures here).
+  """
+  files = sources if sources is not None else collect_files(paths)
+  model = RepoModel(files)
+  findings = run_rules(model)
+  for path, lineno, msg in model.parse_errors:
+    findings.append(Finding("TOS000", path, lineno, "<module>",
+                            "syntax", msg))
+  if only_files is not None:
+    wanted = set(only_files)
+    findings = [f for f in findings if f.path in wanted]
+
+  findings, suppressed = baseline_mod.apply_suppressions(findings, files)
+  baselined: List[Finding] = []
+  stale: List[dict] = []
+  all_findings = list(findings)
+  if baseline_path:
+    entries = baseline_mod.load_baseline(baseline_path)
+    findings, baselined, stale = baseline_mod.apply_baseline(findings,
+                                                             entries)
+    if only_files is not None:
+      # a partial run cannot see every finding, so absent matches for
+      # entries outside the slice are not staleness
+      wanted = set(only_files)
+      stale = [e for e in stale if e["path"] in wanted]
+  return {
+      "findings": findings,
+      "all_findings": all_findings,
+      "baselined": baselined,
+      "suppressed": suppressed,
+      "stale": stale,
+      "files": len(files),
+      "reachable_count": len(model.reachable()),
+      "model": model,
+  }

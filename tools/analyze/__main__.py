@@ -1,0 +1,128 @@
+"""CLI: ``python -m tools.analyze [--style|--all] [--json] [--changed] ...``
+
+Exit codes: 0 = clean (all findings baselined/suppressed), 1 = findings,
+2 = usage / configuration error.
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+
+from tools.analyze import run_analysis
+from tools.analyze import style as style_mod
+from tools.analyze.baseline import DEFAULT_BASELINE, write_baseline
+
+TOS_DEFAULT_PATHS = ["tensorflowonspark_tpu"]
+
+
+def _changed_files():
+  """Tracked-but-modified + staged + untracked .py files (fast iteration)."""
+  # -uall: without it git collapses a brand-new package to one
+  # "?? dir/" line and every file inside it would escape the gate
+  out = subprocess.run(["git", "status", "--porcelain", "-uall"],
+                       capture_output=True, text=True, timeout=30)
+  files = []
+  for line in out.stdout.splitlines():
+    path = line[3:].split(" -> ")[-1].strip()
+    if path.endswith(".py"):
+      files.append(path)
+  return files
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(
+      prog="python -m tools.analyze",
+      description="Distributed-runtime static analysis (TOS rules) + style.")
+  ap.add_argument("paths", nargs="*",
+                  help="files/dirs to analyze (default: the package)")
+  ap.add_argument("--style", action="store_true",
+                  help="run only the style pass (the former tools/lint.py)")
+  ap.add_argument("--all", action="store_true",
+                  help="run the TOS rules AND the style pass")
+  ap.add_argument("--json", action="store_true", dest="as_json",
+                  help="emit findings as JSON")
+  ap.add_argument("--changed", action="store_true",
+                  help="analyze only files reported changed by git")
+  ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                  help="baseline file (default: tools/analyze/baseline.json)")
+  ap.add_argument("--no-baseline", action="store_true",
+                  help="ignore the baseline (report everything)")
+  ap.add_argument("--write-baseline", action="store_true",
+                  help="rewrite the baseline from current findings and exit")
+  ap.add_argument("--quiet", action="store_true",
+                  help="suppress the per-finding lines (summary only)")
+  args = ap.parse_args(argv)
+
+  if args.write_baseline and args.changed:
+    ap.error("--write-baseline with --changed would truncate the baseline "
+             "to findings from changed files only; run it over the full "
+             "target instead")
+
+  changed = _changed_files() if args.changed else None
+  if args.changed and not changed:
+    print("analyze: no changed .py files")
+    return 0
+
+  rc = 0
+  payload = {}
+
+  if not args.style:   # TOS rules (default, or part of --all)
+    paths = args.paths or TOS_DEFAULT_PATHS
+    result = run_analysis(
+        paths=paths,
+        baseline_path=None if args.no_baseline else args.baseline,
+        only_files=changed)
+    if args.write_baseline:
+      write_baseline(result["all_findings"], args.baseline)
+      print("analyze: wrote %d baseline entries to %s (fill in the reason "
+            "fields)" % (len(result["all_findings"]), args.baseline))
+      return 0
+    payload["tos"] = {
+        "findings": [vars(f) for f in result["findings"]],
+        "baselined": len(result["baselined"]),
+        "suppressed": len(result["suppressed"]),
+        "stale_baseline": result["stale"],
+        "files": result["files"],
+        "executor_reachable": result["reachable_count"],
+    }
+    if not args.as_json:
+      for f in result["findings"]:
+        if not args.quiet:
+          print("%s:%d: %s [%s] %s" % (f.path, f.line, f.rule, f.symbol,
+                                       f.msg))
+      for e in result["stale"]:
+        print("analyze: STALE baseline entry (fixed? remove it): "
+              "%(rule)s %(path)s %(symbol)s %(detail)s" % e)
+      print("analyze: %d file(s), %d executor-reachable fn(s), %d finding(s) "
+            "(%d baselined, %d suppressed, %d stale baseline entr%s)"
+            % (result["files"], result["reachable_count"],
+               len(result["findings"]), len(result["baselined"]),
+               len(result["suppressed"]), len(result["stale"]),
+               "y" if len(result["stale"]) == 1 else "ies"))
+    if result["findings"] or result["stale"]:
+      rc = 1
+
+  if args.style or args.all:
+    style_paths = args.paths or None
+    if changed is not None:
+      style_paths = changed
+    files, findings = style_mod.run_style(style_paths)
+    payload["style"] = {"findings": [{"path": p, "line": ln, "msg": m}
+                                     for p, ln, m in findings],
+                        "files": len(files)}
+    if not args.as_json:
+      for path, lineno, msg in findings:
+        if not args.quiet:
+          print("%s:%d: %s" % (path, lineno, msg))
+      print("lint: %d file(s), %d finding(s)" % (len(files), len(findings)))
+    if findings:
+      rc = 1
+
+  if args.as_json:
+    print(json.dumps(payload, indent=2))
+  return rc
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -1,0 +1,95 @@
+"""Findings baseline + inline suppressions.
+
+Two suppression mechanisms, both requiring a reason:
+
+- ``# tosa: ignore[TOS001]`` (comma-separated rules) on the finding's line
+  suppresses it at the site — preferred for point exemptions, because the
+  justification lives next to the code. Anything after the closing bracket
+  is the reason; by convention write one.
+- ``tools/analyze/baseline.json`` entries park known findings so the gate
+  can turn on before every legacy issue is fixed. Every entry MUST carry a
+  non-empty ``reason``; the loader refuses a baseline without one (an
+  unexplained exemption is how gates rot). Entries match on
+  (rule, path, symbol, detail) — line numbers are deliberately not part of
+  the key so unrelated edits don't invalidate the baseline.
+
+Stale baseline entries (matching no current finding) are reported so fixed
+defects get their entries removed — locking the fix in.
+"""
+
+import json
+import os
+import re
+from typing import Dict, List, Tuple
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_IGNORE_RE = re.compile(r"#\s*tosa:\s*ignore\[([A-Z0-9,\s]+)\]")
+
+
+def suppressed_rules_by_line(source: str) -> Dict[int, set]:
+  """{lineno: {rules}} for every ``# tosa: ignore[...]`` comment."""
+  out: Dict[int, set] = {}
+  for i, line in enumerate(source.splitlines(), 1):
+    m = _IGNORE_RE.search(line)
+    if m:
+      out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+  return out
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> List[dict]:
+  if not os.path.exists(path):
+    return []
+  with open(path, encoding="utf-8") as f:
+    entries = json.load(f)
+  for e in entries:
+    for field in ("rule", "path", "symbol", "detail", "reason"):
+      if not e.get(field):
+        raise ValueError(
+            "baseline entry %r is missing a non-empty %r field — every "
+            "baselined finding must name what it is and why it is "
+            "acceptable" % (e, field))
+  return entries
+
+
+def write_baseline(findings, path: str = DEFAULT_BASELINE) -> None:
+  entries = [{"rule": f.rule, "path": f.path, "symbol": f.symbol,
+              "detail": f.detail,
+              "reason": "TODO: justify or fix (auto-generated entry)"}
+             for f in findings]
+  with open(path, "w", encoding="utf-8") as f:
+    json.dump(entries, f, indent=2)
+    f.write("\n")
+
+
+def apply_baseline(findings, entries) -> Tuple[list, list, list]:
+  """(kept, baselined, stale_entries)."""
+  keys = {}
+  for e in entries:
+    keys.setdefault((e["rule"], e["path"], e["symbol"], e["detail"]),
+                    []).append(e)
+  kept, baselined = [], []
+  used = set()
+  for f in findings:
+    if f.key() in keys:
+      baselined.append(f)
+      used.add(f.key())
+    else:
+      kept.append(f)
+  stale = [e for k, es in keys.items() if k not in used for e in es]
+  return kept, baselined, stale
+
+
+def apply_suppressions(findings, sources: Dict[str, str]):
+  """(kept, suppressed) after honoring ``# tosa: ignore`` comments."""
+  by_path: Dict[str, Dict[int, set]] = {}
+  kept, suppressed = [], []
+  for f in findings:
+    if f.path not in by_path:
+      by_path[f.path] = suppressed_rules_by_line(sources.get(f.path, ""))
+    rules = by_path[f.path].get(f.line, set())
+    if f.rule in rules:
+      suppressed.append(f)
+    else:
+      kept.append(f)
+  return kept, suppressed

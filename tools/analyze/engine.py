@@ -1,0 +1,370 @@
+"""Repo model: module index, call graph, and executor-reachability.
+
+The rule passes in ``tools.analyze.rules`` need one piece of global context
+that a per-file linter cannot compute: whether a function can run inside an
+*executor* (an engine task process, a node background process, a feed-hub
+server process, a heartbeat thread) as opposed to only on the driver. A
+blocking ``queue.get()`` on the driver is a latency bug; the same call in an
+executor task is the PR 1 slot-deadlock class — one wedged task pins its
+executor forever and a pinned relaunch can never schedule behind it.
+
+Reachability is computed over a deliberately OVER-approximate call graph
+(stdlib ``ast`` only):
+
+- roots (seed set) are
+  (a) known process entry points (``_executor_main``, ``_background_runner``,
+      ``driver_node_main``),
+  (b) every function nested inside a ``make_*`` factory — the repo's
+      convention for building engine task closures (node.py),
+  (c) functions passed syntactically to an executor boundary:
+      ``Engine.run_on_executors`` / ``foreach_partition`` /
+      ``map_partitions[_lazy]`` / ``barrier_run`` / ``relaunch_task`` first
+      argument, and ``target=`` of ``Process``/``Thread``/``Timer``,
+  (d) the configured ``EXTRA_ROOT_PATTERNS`` below: public API that runs
+      inside user main fns executor-side (DataFeed, TPUNodeContext, the
+      rendezvous client/heartbeat machinery, the feed-hub server functions,
+      chaos hooks);
+- edges follow direct calls, ``self.method`` calls, ``module.func`` calls
+  through imports, and plain *references* to known functions (so callbacks
+  and thread targets are followed);
+- attribute calls that cannot be resolved fall back to matching every
+  function of that name in the package, EXCEPT for a blocklist of
+  ubiquitous method names (``get``, ``put``, ``close``, ...) whose
+  name-based resolution would glue the whole graph together. Their real
+  owners (FeedQueue and friends) are reachable via the root config instead.
+
+Over-approximation errs toward analyzing more code as executor-reachable;
+false positives are then handled by ``# tosa: ignore[RULE]`` comments or
+baseline entries with reasons — never by weakening the graph.
+"""
+
+import ast
+import fnmatch
+import os
+from typing import Dict, List, Optional, Set
+
+#: method names excluded from name-based attribute fallback resolution (too
+#: generic: nearly every class here has one, and following them would make
+#: everything reachable from everything)
+GENERIC_ATTRS = {
+    "get", "set", "put", "add", "close", "stop", "start", "run", "send",
+    "wait", "join", "done", "beat", "state", "empty", "qsize", "connect",
+    "accept", "recv", "read", "write", "next", "items", "keys", "values",
+    "append", "extend", "pop", "update", "copy", "split", "strip",
+    "shutdown", "release",
+}
+
+#: process / thread entry points recognized by name
+ROOT_NAMES = {"_executor_main", "_background_runner", "driver_node_main"}
+
+#: engine boundary methods: their fn argument runs on an executor
+BOUNDARY_METHODS = {"run_on_executors", "foreach_partition", "map_partitions",
+                    "map_partitions_lazy", "barrier_run", "relaunch_task"}
+
+#: constructors whose ``target=`` runs in another process/thread
+TARGET_CTORS = {"Process", "Thread", "Timer"}
+
+#: qualname glob patterns for API that runs executor-side without a
+#: syntactic hand-off visible to this analysis (called from user main fns,
+#: or inside the feed-hub manager server process)
+EXTRA_ROOT_PATTERNS = [
+    "*.datafeed.DataFeed.*",
+    "*.node.TPUNodeContext.*",
+    "*.node.DualInput.*",
+    "*.node.input_channel",
+    "*.node.consumer_channel",
+    "*.node._check_errors",
+    "*.node._get_hub",
+    "*.control.feedhub.FeedQueue.*",
+    "*.control.feedhub._init_server",
+    "*.control.feedhub._get_queue",
+    "*.control.feedhub._kv_get",
+    "*.control.feedhub._kv_set",
+    "*.control.feedhub._force_exit",
+    "*.control.feedhub.FeedHub.*",
+    "*.control.feedhub.start",
+    "*.control.feedhub.connect",
+    "*.control.feedhub.release",
+    "*.control.rendezvous.Client.*",
+    "*.control.rendezvous.MessageSocket.*",
+    "*.control.rendezvous.HeartbeatSender.*",
+    "*.control.shmring.RingQueueAdapter.*",
+    "*.control.shmring.ShmRing.*",
+    "*.utils.chaos.*",
+]
+
+
+class FuncInfo(object):
+  """One function/method definition and its place in the repo."""
+
+  def __init__(self, qualname: str, module: str, path: str, node,
+               cls: Optional[str], parent_func: Optional[str]):
+    self.qualname = qualname
+    self.module = module
+    self.path = path
+    self.node = node
+    self.cls = cls                    # qualname of enclosing class, or None
+    self.parent_func = parent_func    # qualname of enclosing function, or None
+    self.lineno = node.lineno
+    self.name = node.name
+
+  def body_nodes(self):
+    """Walk this function's body, NOT descending into nested functions
+    (they are separate FuncInfos) but descending into everything else."""
+    stack = list(self.node.body)
+    while stack:
+      n = stack.pop()
+      yield n
+      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        continue  # nested function: its own FuncInfo analyzes it
+      stack.extend(ast.iter_child_nodes(n))
+
+
+class ModuleInfo(object):
+  def __init__(self, module: str, path: str, tree, source: str):
+    self.module = module
+    self.path = path
+    self.tree = tree
+    self.source = source
+    self.imports: Dict[str, str] = {}   # alias -> dotted target
+
+
+class _Collector(ast.NodeVisitor):
+  """Collect functions + imports of one module into the model."""
+
+  def __init__(self, model: "RepoModel", mod: ModuleInfo):
+    self.model = model
+    self.mod = mod
+    self.scope: List[str] = []          # class/function name components
+    self.scope_kinds: List[str] = []    # "class" | "func"
+
+  def _qual(self, name: str) -> str:
+    return ".".join([self.mod.module] + self.scope + [name])
+
+  def visit_Import(self, node):
+    for a in node.names:
+      self.mod.imports[(a.asname or a.name).split(".")[0]] = a.name
+
+  def visit_ImportFrom(self, node):
+    base = node.module or ""
+    for a in node.names:
+      if a.name != "*":
+        self.mod.imports[a.asname or a.name] = (
+            base + "." + a.name if base else a.name)
+
+  def visit_ClassDef(self, node):
+    self.scope.append(node.name)
+    self.scope_kinds.append("class")
+    self.generic_visit(node)
+    self.scope.pop()
+    self.scope_kinds.pop()
+
+  def _visit_func(self, node):
+    qual = self._qual(node.name)
+    cls = None
+    parent_func = None
+    for i in range(len(self.scope) - 1, -1, -1):
+      q = ".".join([self.mod.module] + self.scope[:i + 1])
+      if self.scope_kinds[i] == "class" and cls is None:
+        cls = q
+      if self.scope_kinds[i] == "func" and parent_func is None:
+        parent_func = q
+      if cls and parent_func:
+        break
+    info = FuncInfo(qual, self.mod.module, self.mod.path, node, cls,
+                    parent_func)
+    self.model.functions[qual] = info
+    self.model.by_name.setdefault(node.name, []).append(qual)
+    if cls:
+      self.model.class_methods.setdefault(cls, {})[node.name] = qual
+    self.scope.append(node.name)
+    self.scope_kinds.append("func")
+    self.generic_visit(node)
+    self.scope.pop()
+    self.scope_kinds.pop()
+
+  visit_FunctionDef = _visit_func
+  visit_AsyncFunctionDef = _visit_func
+
+
+class RepoModel(object):
+  """Parsed view of a set of python files + executor-reachability."""
+
+  def __init__(self, files: Dict[str, str]):
+    """``files``: {path: source} — every file participates in reachability."""
+    self.modules: Dict[str, ModuleInfo] = {}
+    self.functions: Dict[str, FuncInfo] = {}
+    self.by_name: Dict[str, List[str]] = {}
+    self.class_methods: Dict[str, Dict[str, str]] = {}
+    self.parse_errors: List[tuple] = []   # (path, lineno, msg)
+    for path, source in sorted(files.items()):
+      try:
+        tree = ast.parse(source, filename=path)
+      except SyntaxError as e:
+        self.parse_errors.append((path, e.lineno or 0,
+                                  "syntax error: %s" % e.msg))
+        continue
+      mod = ModuleInfo(self._module_name(path), path, tree, source)
+      self.modules[mod.module] = mod
+      _Collector(self, mod).visit(tree)
+    self._reachable: Optional[Set[str]] = None
+    self.roots: Set[str] = set()
+
+  @staticmethod
+  def _module_name(path: str) -> str:
+    p = path[:-3] if path.endswith(".py") else path
+    parts = [x for x in p.replace(os.sep, "/").split("/") if x not in ("", ".")]
+    if parts and parts[-1] == "__init__":
+      parts = parts[:-1]
+    return ".".join(parts)
+
+  # -- resolution ------------------------------------------------------------
+
+  def resolve_name(self, name: str, func: Optional[FuncInfo],
+                   module: str) -> List[str]:
+    """Function qualnames a bare ``name`` may refer to in this scope."""
+    if func is not None:
+      nested = func.qualname + "." + name
+      if nested in self.functions:
+        return [nested]
+      # sibling in the same enclosing function (closure over a sibling def)
+      parent = func.parent_func
+      while parent:
+        sib = parent + "." + name
+        if sib in self.functions:
+          return [sib]
+        parent = self.functions[parent].parent_func if parent in \
+            self.functions else None
+    mod_level = module + "." + name
+    if mod_level in self.functions:
+      return [mod_level]
+    mod = self.modules.get(module)
+    if mod and name in mod.imports:
+      target = mod.imports[name]
+      if target in self.functions:
+        return [target]
+    return []
+
+  def resolve_attr(self, node, func: Optional[FuncInfo],
+                   module: str) -> List[str]:
+    """Function qualnames an attribute access/call may refer to."""
+    attr = node.attr
+    value = node.value
+    if isinstance(value, ast.Name):
+      if value.id == "self" and func is not None and func.cls:
+        meth = self.class_methods.get(func.cls, {}).get(attr)
+        if meth:
+          return [meth]
+      mod = self.modules.get(module)
+      if mod and value.id in mod.imports:
+        target = mod.imports[value.id] + "." + attr
+        if target in self.functions:
+          return [target]
+        # imported class: Class.method
+        if target.rsplit(".", 1)[0] in self.class_methods:
+          m = self.class_methods[target.rsplit(".", 1)[0]].get(attr)
+          if m:
+            return [m]
+      # Module.attr where value.id is a module-level class in this module
+      cls_qual = module + "." + value.id
+      if cls_qual in self.class_methods:
+        m = self.class_methods[cls_qual].get(attr)
+        if m:
+          return [m]
+    # name-based over-approximation for everything else
+    if attr in GENERIC_ATTRS:
+      return []
+    return list(self.by_name.get(attr, []))
+
+  # -- reachability ----------------------------------------------------------
+
+  def _edges_and_roots(self):
+    edges: Dict[str, Set[str]] = {q: set() for q in self.functions}
+    roots: Set[str] = set()
+    for qual, fn in self.functions.items():
+      if fn.name in ROOT_NAMES:
+        roots.add(qual)
+      parent = fn.parent_func
+      if parent and self.functions.get(parent) is not None \
+          and self.functions[parent].name.startswith("make_"):
+        roots.add(qual)
+      for pat in EXTRA_ROOT_PATTERNS:
+        if fnmatch.fnmatch(qual, pat):
+          roots.add(qual)
+          break
+      for node in fn.body_nodes():
+        if isinstance(node, ast.Call):
+          targets = self._boundary_args(node, fn)
+          roots.update(targets)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+          for t in self.resolve_name(node.id, fn, fn.module):
+            edges[qual].add(t)
+        elif isinstance(node, ast.Attribute) and \
+            isinstance(getattr(node, "ctx", None), ast.Load):
+          for t in self.resolve_attr(node, fn, fn.module):
+            edges[qual].add(t)
+    return edges, roots
+
+  def _boundary_args(self, call: ast.Call, fn: FuncInfo) -> List[str]:
+    """Functions handed to an executor boundary at this call site."""
+    out: List[str] = []
+    callee = call.func
+    name = callee.attr if isinstance(callee, ast.Attribute) else (
+        callee.id if isinstance(callee, ast.Name) else None)
+    if name in BOUNDARY_METHODS:
+      # fn argument: run_on_executors(fn,...) / foreach_partition(parts, fn)
+      # / relaunch_task(job, task_id, ...) — scan every arg; only args that
+      # resolve to known functions are taken
+      for arg in call.args:
+        out.extend(self._arg_targets(arg, fn))
+    if name in TARGET_CTORS:
+      for kw in call.keywords:
+        if kw.arg == "target":
+          out.extend(self._arg_targets(kw.value, fn))
+    return out
+
+  def _arg_targets(self, arg, fn: FuncInfo) -> List[str]:
+    if isinstance(arg, ast.Name):
+      return self.resolve_name(arg.id, fn, fn.module)
+    if isinstance(arg, ast.Attribute):
+      return self.resolve_attr(arg, fn, fn.module)
+    return []
+
+  def reachable(self) -> Set[str]:
+    """Qualnames of executor-reachable functions (cached)."""
+    if self._reachable is not None:
+      return self._reachable
+    edges, roots = self._edges_and_roots()
+    self.roots = roots
+    seen = set(roots)
+    stack = list(roots)
+    while stack:
+      q = stack.pop()
+      for t in edges.get(q, ()):
+        if t not in seen:
+          seen.add(t)
+          stack.append(t)
+    self._reachable = seen
+    return seen
+
+  def is_executor_reachable(self, qualname: str) -> bool:
+    return qualname in self.reachable()
+
+
+def collect_files(paths: List[str]) -> Dict[str, str]:
+  """{relative path: source} for every .py under the given paths."""
+  out: Dict[str, str] = {}
+  for root in paths:
+    if os.path.isfile(root):
+      if root.endswith(".py"):
+        with open(root, encoding="utf-8") as f:
+          out[root] = f.read()
+      continue
+    for dirpath, dirnames, filenames in os.walk(root):
+      dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+      for name in sorted(filenames):
+        if name.endswith(".py"):
+          path = os.path.join(dirpath, name)
+          with open(path, encoding="utf-8") as f:
+            out[path] = f.read()
+  return out

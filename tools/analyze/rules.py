@@ -1,0 +1,589 @@
+"""The TOS rule passes: distributed-runtime bug classes this repo has bled from.
+
+Each rule encodes a real incident class (see docs/ANALYSIS.md for the
+catalogue with incident references):
+
+- TOS001  blocking call without timeout in executor-reachable code
+- TOS002  socket used before ``settimeout``
+- TOS003  spawn-unsafe callable handed to a process boundary
+- TOS004  exception swallowed in executor-reachable code
+- TOS005  impure operation inside a jit/pjit/shard_map region
+- TOS006  resource opened outside ``with`` with an unprotected close
+- TOS007  thread without explicit ``daemon=``; bare ``lock.acquire()``
+- TOS008  config drift: unregistered ``TOS_*`` environment variable
+
+Findings carry a ``detail`` string that is stable across reformatting (no
+line numbers) — the baseline matches on (rule, path, symbol, detail).
+"""
+
+import ast
+from typing import Iterator, List, Optional
+
+from tools.analyze.engine import FuncInfo, RepoModel
+
+
+class Finding(object):
+  def __init__(self, rule: str, path: str, line: int, symbol: str,
+               detail: str, msg: str):
+    self.rule = rule
+    self.path = path
+    self.line = line
+    self.symbol = symbol
+    self.detail = detail
+    self.msg = msg
+
+  def key(self):
+    return (self.rule, self.path, self.symbol, self.detail)
+
+  def __repr__(self):
+    return "%s:%d: %s [%s] %s" % (self.path, self.line, self.rule,
+                                  self.symbol, self.msg)
+
+
+#: env var names that are legitimate but not declared via an ``ENV_*``
+#: constant anywhere (third-party / conventional names)
+KNOWN_ENV = set()
+
+_LOG_RECEIVERS = {"logger", "logging", "log", "_logger"}
+_BLOCKING_VERB_QUEUE = ("get", "get_many", "put", "put_many")
+_SOCKET_BLOCKING = ("recv", "recv_into", "recvfrom", "accept", "connect")
+_SUBPROCESS_BLOCKING = ("run", "call", "check_call", "check_output",
+                        "communicate")
+
+
+def _call_parts(call: ast.Call):
+  """(receiver_name_or_None, attr_or_funcname, is_attr)."""
+  f = call.func
+  if isinstance(f, ast.Attribute):
+    recv = f.value.id if isinstance(f.value, ast.Name) else None
+    return recv, f.attr, True
+  if isinstance(f, ast.Name):
+    return None, f.id, False
+  return None, None, False
+
+
+def _kwargs(call: ast.Call):
+  return {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _kwarg_value(call: ast.Call, name: str):
+  for kw in call.keywords:
+    if kw.arg == name:
+      return kw.value
+  return None
+
+
+def _is_false(node) -> bool:
+  return isinstance(node, ast.Constant) and node.value is False
+
+
+def _camel(name: Optional[str]) -> bool:
+  return bool(name) and name[0].isupper()
+
+
+# --- TOS001: blocking call without timeout ----------------------------------
+
+def check_tos001(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
+  if not model.is_executor_reachable(fn.qualname):
+    return
+  for node in fn.body_nodes():
+    if not isinstance(node, ast.Call):
+      continue
+    recv, name, is_attr = _call_parts(node)
+    kws = _kwargs(node)
+    if not is_attr:
+      continue
+    if recv == "subprocess" and name in _SUBPROCESS_BLOCKING:
+      if "timeout" not in kws:
+        yield Finding("TOS001", fn.path, node.lineno, fn.qualname,
+                      "subprocess.%s" % name,
+                      "subprocess.%s() without timeout= can wedge this "
+                      "executor forever" % name)
+      continue
+    if name in _BLOCKING_VERB_QUEUE:
+      if _camel(recv):
+        continue  # ClassName.get() classmethod idiom (TaskContext.get())
+      if name == "get" and (node.args or kws - {"block", "timeout"}):
+        continue  # dict-style .get(key[, default])
+      if name == "get" and recv is None:
+        continue  # x.y.get(): zero-arg accessors (reservations.get());
+        # the queue idiom here is a simple local name (task_q.get())
+      if _is_false(_kwarg_value(node, "block")):
+        continue
+      if "timeout" in kws:
+        continue
+      yield Finding("TOS001", fn.path, node.lineno, fn.qualname,
+                    "queue.%s" % name,
+                    "blocking .%s() without timeout= in executor-reachable "
+                    "code (slot-deadlock class: a wedged task pins its "
+                    "executor and a pinned relaunch never schedules)" % name)
+      continue
+    if name == "join" and not node.args and "timeout" not in kws:
+      yield Finding("TOS001", fn.path, node.lineno, fn.qualname, "join",
+                    ".join() without timeout= blocks forever if the joined "
+                    "thread/process/queue never finishes")
+      continue
+    if name == "wait" and not node.args and "timeout" not in kws:
+      yield Finding("TOS001", fn.path, node.lineno, fn.qualname, "wait",
+                    ".wait() without timeout= blocks forever if the event "
+                    "is never set / the process never exits")
+      continue
+    if name in ("recv", "recvfrom") and recv is not None \
+        and not _sock_created_locally(fn, recv):
+      # sockets created in this function are TOS002's job; recv on a
+      # socket of unknown provenance (parameter, attribute) is flagged
+      # here unless annotated
+      yield Finding("TOS001", fn.path, node.lineno, fn.qualname,
+                    "socket.%s" % name,
+                    "blocking %s() on a socket this function did not "
+                    "create — timeout discipline cannot be verified here"
+                    % name)
+
+
+def _sock_created_locally(fn: FuncInfo, name: str) -> bool:
+  for node in fn.body_nodes():
+    if isinstance(node, ast.Assign):
+      for t in node.targets:
+        if isinstance(t, ast.Name) and t.id == name:
+          return True
+  return False
+
+
+# --- TOS002: socket created without settimeout before first use -------------
+
+def _socket_ctor(call: ast.Call) -> bool:
+  recv, name, is_attr = _call_parts(call)
+  return (is_attr and name == "socket" and recv == "socket") or \
+      (not is_attr and name == "socket")
+
+
+def check_tos002(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
+  created = {}       # name -> lineno created
+  aliases = {}       # alias -> root name
+  timed = set()      # root names with settimeout/setblocking before use
+  with_managed = set()
+  for node in ast.walk(fn.node):
+    if isinstance(node, ast.withitem) and \
+        isinstance(node.context_expr, ast.Call) and \
+        _socket_ctor(node.context_expr):
+      if node.optional_vars is not None and \
+          isinstance(node.optional_vars, ast.Name):
+        with_managed.add(node.optional_vars.id)
+
+  def root_of(name):
+    seen = set()
+    while name in aliases and name not in seen:
+      seen.add(name)
+      name = aliases[name]
+    return name
+
+  events = []
+  for node in fn.body_nodes():
+    if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+        and _socket_ctor(node.value):
+      for t in node.targets:
+        if isinstance(t, ast.Name):
+          events.append((node.lineno, "create", t.id))
+    elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Name):
+      for t in node.targets:
+        if isinstance(t, ast.Name):
+          events.append((node.lineno, "alias", (t.id, node.value.id)))
+    elif isinstance(node, ast.Call):
+      recv, name, is_attr = _call_parts(node)
+      if is_attr and recv is not None:
+        if name in ("settimeout", "setblocking"):
+          events.append((node.lineno, "timed", recv))
+        elif name in _SOCKET_BLOCKING:
+          events.append((node.lineno, "use", (recv, name)))
+  for lineno, kind, payload in sorted(events, key=lambda e: e[0]):
+    if kind == "create":
+      created[payload] = lineno
+    elif kind == "alias":
+      dst, src = payload
+      if root_of(src) in created:
+        aliases[dst] = src
+    elif kind == "timed":
+      r = root_of(payload)
+      if r in created:
+        timed.add(r)
+    elif kind == "use":
+      recv, op = payload
+      r = root_of(recv)
+      if r in created and r not in timed and r not in with_managed:
+        yield Finding("TOS002", fn.path, lineno, fn.qualname,
+                      "socket:%s.%s" % (r, op),
+                      "socket %r used for %s() without a prior settimeout() "
+                      "— an unresponsive peer blocks this call forever "
+                      "(rendezvous reconnect-hang class)" % (r, op))
+        timed.add(r)   # one finding per socket
+
+
+# --- TOS003: spawn-unsafe callable at a process boundary --------------------
+
+def check_tos003(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
+  for node in fn.body_nodes():
+    if not isinstance(node, ast.Call):
+      continue
+    recv, name, is_attr = _call_parts(node)
+    if name != "Process":
+      continue
+    target = _kwarg_value(node, "target")
+    if target is None:
+      continue
+    bad = None
+    if isinstance(target, ast.Lambda):
+      bad = "a lambda"
+    elif isinstance(target, ast.Name):
+      resolved = model.resolve_name(target.id, fn, fn.module)
+      for q in resolved:
+        if model.functions[q].parent_func is not None:
+          bad = "closure %r (defined inside %s)" % (
+              target.id, model.functions[q].parent_func)
+    elif isinstance(target, ast.Attribute) and \
+        isinstance(target.value, ast.Name) and target.value.id == "self":
+      bad = "instance-bound method self.%s" % target.attr
+    if bad:
+      yield Finding("TOS003", fn.path, node.lineno, fn.qualname,
+                    "process-target",
+                    "%s handed to Process(target=...): spawn pickles the "
+                    "target with plain pickle — lambdas/closures/bound "
+                    "methods fail at start() or drag unpicklable state"
+                    % bad)
+
+
+# --- TOS004: swallowed exception in executor-reachable code -----------------
+
+def _is_log_only(stmt) -> bool:
+  if isinstance(stmt, (ast.Pass, ast.Continue)):
+    return True
+  if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+    recv, name, is_attr = _call_parts(stmt.value)
+    if not is_attr and name == "print":
+      return True
+    if is_attr and recv in _LOG_RECEIVERS:
+      return True
+  return False
+
+
+#: exception types whose silent swallow hides RUNTIME failures. Narrow
+#: feature-gate handlers (ImportError, AttributeError, KeyError, ...) that
+#: pass/log are deliberate capability probes and are not flagged.
+_SWALLOW_TYPES = {"Exception", "BaseException", "OSError", "IOError",
+                  "ConnectionError", "RuntimeError", "TimeoutError",
+                  "error"}
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+  if handler.type is None:
+    return True   # bare except:
+  types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+      else [handler.type]
+  for t in types:
+    name = t.attr if isinstance(t, ast.Attribute) else (
+        t.id if isinstance(t, ast.Name) else None)
+    if name in _SWALLOW_TYPES:
+      return True
+  return False
+
+
+def check_tos004(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
+  if not model.is_executor_reachable(fn.qualname):
+    return
+  for node in fn.body_nodes():
+    if isinstance(node, ast.ExceptHandler):
+      if node.body and _broad_handler(node) and \
+          all(_is_log_only(s) for s in node.body):
+        yield Finding("TOS004", fn.path, node.lineno, fn.qualname,
+                      "except:swallow",
+                      "exception swallowed (pass/log-only handler) in "
+                      "executor-reachable code: the driver's traceback "
+                      "propagation never sees this failure")
+
+
+# --- TOS005: jit purity -----------------------------------------------------
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+
+
+def _collect_jitted(model: RepoModel) -> set:
+  jitted = set()
+  for qual, fn in model.functions.items():
+    for dec in fn.node.decorator_list:
+      d = dec
+      if isinstance(d, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(...) / @shard_map(...)
+        inner_names = [a for a in ast.walk(d)
+                       if isinstance(a, (ast.Name, ast.Attribute))]
+        if any((n.attr if isinstance(n, ast.Attribute) else n.id)
+               in _JIT_NAMES for n in inner_names):
+          jitted.add(qual)
+      elif isinstance(d, (ast.Name, ast.Attribute)):
+        nm = d.attr if isinstance(d, ast.Attribute) else d.id
+        if nm in _JIT_NAMES:
+          jitted.add(qual)
+  # call-site form: jax.jit(f), shard_map(f, mesh=...)
+  for qual, fn in model.functions.items():
+    for node in fn.body_nodes():
+      if isinstance(node, ast.Call):
+        recv, name, _ = _call_parts(node)
+        if name in _JIT_NAMES and node.args:
+          first = node.args[0]
+          if isinstance(first, ast.Name):
+            jitted.update(model.resolve_name(first.id, fn, fn.module))
+          elif isinstance(first, ast.Attribute):
+            jitted.update(model.resolve_attr(first, fn, fn.module))
+  return jitted
+
+
+def check_tos005(model: RepoModel, fn: FuncInfo, jitted: set) -> \
+    Iterator[Finding]:
+  if fn.qualname not in jitted:
+    return
+  params = {a.arg for a in fn.node.args.args + fn.node.args.kwonlyargs}
+  params.discard("self")
+  for node in fn.body_nodes():
+    if isinstance(node, (ast.Nonlocal, ast.Global)):
+      yield Finding("TOS005", fn.path, node.lineno, fn.qualname,
+                    "jit:mutation",
+                    "nonlocal/global mutation inside a jit region only "
+                    "happens at trace time — it will not re-run per step")
+      continue
+    if not isinstance(node, ast.Call):
+      continue
+    recv, name, is_attr = _call_parts(node)
+    if not is_attr and name == "print":
+      yield Finding("TOS005", fn.path, node.lineno, fn.qualname, "jit:print",
+                    "print() inside a jit region fires at trace time only; "
+                    "use jax.debug.print for per-step output")
+    elif is_attr and recv == "time" and name in ("time", "perf_counter",
+                                                 "monotonic"):
+      yield Finding("TOS005", fn.path, node.lineno, fn.qualname, "jit:clock",
+                    "time.%s() inside a jit region is evaluated once at "
+                    "trace time — it cannot time the compiled step" % name)
+    elif is_attr and name == "item" and not node.args and \
+        isinstance(node.func.value, ast.Name) and \
+        node.func.value.id in params:
+      yield Finding("TOS005", fn.path, node.lineno, fn.qualname, "jit:item",
+                    ".item() on a traced argument forces a host sync and "
+                    "fails under jit; return the array instead")
+    elif not is_attr and name in ("float", "int", "bool") and \
+        len(node.args) == 1 and isinstance(node.args[0], ast.Name) and \
+        node.args[0].id in params:
+      yield Finding("TOS005", fn.path, node.lineno, fn.qualname,
+                    "jit:host-cast",
+                    "%s() on a traced argument raises ConcretizationError "
+                    "under jit" % name)
+    elif is_attr and recv in ("np", "numpy") and \
+        any(isinstance(a, ast.Name) and a.id in params for a in node.args):
+      yield Finding("TOS005", fn.path, node.lineno, fn.qualname, "jit:numpy",
+                    "np.%s applied to a traced argument silently forces a "
+                    "host transfer (or fails); use jnp.%s" % (name, name))
+
+
+# --- TOS006: resource leak --------------------------------------------------
+
+def _resource_ctor(call: ast.Call) -> Optional[str]:
+  recv, name, is_attr = _call_parts(call)
+  if not is_attr and name == "open":
+    return "file"
+  if _socket_ctor(call):
+    return "socket"
+  return None
+
+
+def check_tos006(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
+  # parent links for finally/handler detection
+  parents = {}
+  for node in ast.walk(fn.node):
+    for child in ast.iter_child_nodes(node):
+      parents[child] = node
+
+  def enclosing_finally_or_handler(n) -> bool:
+    cur = n
+    while cur in parents:
+      p = parents[cur]
+      if isinstance(p, ast.Try) and \
+          any(cur is x or any(m is cur for m in ast.walk(x))
+              for x in p.finalbody):
+        return True
+      if isinstance(p, ast.ExceptHandler):
+        return True
+      cur = p
+    return False
+
+  with_managed = set()
+  for node in ast.walk(fn.node):
+    if isinstance(node, ast.withitem) and \
+        isinstance(node.context_expr, ast.Call) and \
+        _resource_ctor(node.context_expr):
+      with_managed.add(id(node.context_expr))
+
+  tracked = []   # (name, kind, lineno, stmt_node)
+  for node in fn.body_nodes():
+    if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+      kind = _resource_ctor(node.value)
+      if kind and id(node.value) not in with_managed:
+        for t in node.targets:
+          if isinstance(t, ast.Name):
+            tracked.append((t.id, kind, node.lineno))
+
+  if not tracked:
+    return
+
+  for rname, kind, created_line in tracked:
+    closes = []         # (lineno, protected)
+    escape_lines = []   # handoffs: returned / stored on self / passed along
+    for node in fn.body_nodes():
+      if isinstance(node, ast.Call):
+        recv, cname, is_attr = _call_parts(node)
+        if is_attr and recv == rname and cname == "close":
+          closes.append((node.lineno, enclosing_finally_or_handler(node)))
+          continue
+        if is_attr and recv == rname:
+          continue   # other method calls on the resource itself
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+          if isinstance(a, ast.Name) and a.id == rname and \
+              node.lineno > created_line:
+            escape_lines.append(node.lineno)
+      elif isinstance(node, ast.Return) and node.value is not None:
+        if any(isinstance(n, ast.Name) and n.id == rname
+               for n in ast.walk(node.value)):
+          escape_lines.append(node.lineno)
+      elif isinstance(node, ast.Assign):
+        for t in node.targets:
+          if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+              isinstance(node.value, ast.Name) and node.value.id == rname:
+            escape_lines.append(node.lineno)
+    if any(p for _, p in closes):
+      continue   # a close lives in a finally/except: protected
+    first_close = min((ln for ln, _ in closes), default=None)
+    escape_line = min(escape_lines, default=None)
+    if first_close is None and escape_line is None:
+      yield Finding("TOS006", fn.path, created_line, fn.qualname,
+                    "%s:%s:never-closed" % (kind, rname),
+                    "%s %r is never closed and never handed off — leaks in "
+                    "this (long-lived executor) process" % (kind, rname))
+      continue
+    boundary = min(x for x in (first_close, escape_line) if x is not None)
+    risky = any(isinstance(n, ast.Call) and
+                created_line < n.lineno < boundary
+                for n in fn.body_nodes())
+    if risky:
+      yield Finding("TOS006", fn.path, created_line, fn.qualname,
+                    "%s:%s:exception-path" % (kind, rname),
+                    "%s %r is closed/handed off only on the success path — "
+                    "an exception between creation (line %d) and line %d "
+                    "leaks it (no finally)" % (kind, rname, created_line,
+                                               boundary))
+
+
+# --- TOS007: thread/lock hygiene --------------------------------------------
+
+def check_tos007(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
+  daemon_assigned = set()
+  for node in fn.body_nodes():
+    if isinstance(node, ast.Assign):
+      for t in node.targets:
+        if isinstance(t, ast.Attribute) and t.attr == "daemon" and \
+            isinstance(t.value, ast.Name):
+          daemon_assigned.add(t.value.id)
+  for node in fn.body_nodes():
+    if not isinstance(node, ast.Call):
+      continue
+    recv, name, is_attr = _call_parts(node)
+    if name in ("Thread", "Timer") and (not is_attr or
+                                        recv in ("threading", None)):
+      if "daemon" not in _kwargs(node):
+        # feedhub Timer idiom: t = Timer(...); t.daemon = True
+        assigned_to = None
+        parent_assign = None
+        for st in fn.body_nodes():
+          if isinstance(st, ast.Assign) and st.value is node:
+            parent_assign = st
+        if parent_assign is not None:
+          for t in parent_assign.targets:
+            if isinstance(t, ast.Name):
+              assigned_to = t.id
+        if assigned_to in daemon_assigned:
+          continue
+        yield Finding("TOS007", fn.path, node.lineno, fn.qualname,
+                      "thread:daemon",
+                      "%s() without an explicit daemon=: an implicit "
+                      "non-daemon thread blocks interpreter exit when its "
+                      "owner dies uncleanly" % name)
+    elif name == "acquire" and is_attr:
+      yield Finding("TOS007", fn.path, node.lineno, fn.qualname,
+                    "lock:acquire",
+                    "bare .acquire(): an exception before release() "
+                    "deadlocks every other user — use 'with lock:'")
+
+
+# --- TOS008: env config drift -----------------------------------------------
+
+def _env_registry(model: RepoModel) -> set:
+  known = set(KNOWN_ENV)
+  for mod in model.modules.values():
+    for node in mod.tree.body:
+      if isinstance(node, ast.Assign) and \
+          isinstance(node.value, ast.Constant) and \
+          isinstance(node.value.value, str):
+        for t in node.targets:
+          if isinstance(t, ast.Name) and t.id.startswith("ENV_"):
+            known.add(node.value.value)
+  return known
+
+
+def _env_key_literals(tree) -> Iterator[tuple]:
+  """(lineno, key) for literal env-var keys in reads and writes."""
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Call):
+      recv, name, is_attr = _call_parts(node)
+      f = node.func
+      env_recv = (isinstance(f, ast.Attribute) and
+                  isinstance(f.value, ast.Attribute) and
+                  f.value.attr == "environ")
+      if is_attr and recv == "os" and name == "getenv" and node.args:
+        a = node.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+          yield node.lineno, a.value
+      elif env_recv and name in ("get", "setdefault", "pop") and node.args:
+        a = node.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+          yield node.lineno, a.value
+    elif isinstance(node, ast.Subscript):
+      v = node.value
+      if isinstance(v, ast.Attribute) and v.attr == "environ":
+        s = node.slice
+        if isinstance(s, ast.Constant) and isinstance(s.value, str):
+          yield node.lineno, s.value
+
+
+def check_tos008(model: RepoModel) -> Iterator[Finding]:
+  known = _env_registry(model)
+  for mod in model.modules.values():
+    for lineno, key in _env_key_literals(mod.tree):
+      if key.startswith("TOS_") and key not in known:
+        yield Finding("TOS008", mod.path, lineno, "<module>",
+                      "env:%s" % key,
+                      "env var %r is not registered (no ENV_* constant "
+                      "declares it): typos in config knobs are silently "
+                      "ignored — declare ENV_X = %r in the owning module"
+                      % (key, key))
+
+
+# --- driver -----------------------------------------------------------------
+
+def run_rules(model: RepoModel) -> List[Finding]:
+  findings: List[Finding] = []
+  jitted = _collect_jitted(model)
+  for fn in model.functions.values():
+    findings.extend(check_tos001(model, fn))
+    findings.extend(check_tos002(model, fn))
+    findings.extend(check_tos003(model, fn))
+    findings.extend(check_tos004(model, fn))
+    findings.extend(check_tos005(model, fn, jitted))
+    findings.extend(check_tos006(model, fn))
+    findings.extend(check_tos007(model, fn))
+  findings.extend(check_tos008(model))
+  findings.sort(key=lambda f: (f.path, f.line, f.rule))
+  return findings

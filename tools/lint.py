@@ -1,159 +1,20 @@
-"""Self-contained lint gate (stdlib-only).
+"""Thin shim: the style gate moved into the analysis suite.
 
-The reference gated CI on pycodestyle/pylint/mypy (reference tox.ini,
-screwdriver.yaml:15-80). This image ships none of those and installs are
-not possible, so this implements the highest-signal subset with ast +
-tokenize alone:
-
-- E9: syntax errors (files must compile)
-- W291/W293: trailing whitespace
-- E501: lines over the limit (100 here; the reference used 160)
-- W191: tabs in indentation
-- F401: imported name never used (module scope; ``# noqa`` honored,
-  ``__init__.py`` re-exports exempt via ``# noqa: F401`` like the real
-  pyflakes convention)
-- E722: bare ``except:``
-- F811: duplicate top-level definition names
-- B006: mutable default arguments
-
-Usage: ``python tools/lint.py [paths...]`` (defaults to the package,
-tests, examples and repo-root scripts). Exit 1 on any finding.
+``python tools/lint.py [paths...]`` now delegates to
+``python -m tools.analyze --style`` (tools/analyze/style.py), which carries
+the original checks (E9, W291/W293, E501, W191, F401, F811, E722, B006)
+plus F841 (unused local) and W605 (invalid escape sequence). This file
+stays so existing muscle memory and Makefile references keep working.
 """
 
-import ast
-import io
 import os
 import sys
-import tokenize
 
-MAX_LINE = 100
+# running as a script puts tools/ on sys.path[0]; the package import needs
+# the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DEFAULT_PATHS = ["tensorflowonspark_tpu", "tests", "examples", "bench.py",
-                 "__graft_entry__.py"]
-
-
-def _noqa_lines(source):
-  """Line numbers carrying a ``# noqa`` comment (any code)."""
-  out = set()
-  try:
-    for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-      if tok.type == tokenize.COMMENT and "noqa" in tok.string:
-        out.add(tok.start[0])
-  except tokenize.TokenizeError:
-    pass
-  return out
-
-
-class _ImportTracker(ast.NodeVisitor):
-  """Module-scope imports vs every name used anywhere in the module."""
-
-  def __init__(self):
-    self.imports = {}   # name -> lineno
-    self.used = set()
-
-  def visit_Import(self, node):
-    for a in node.names:
-      name = (a.asname or a.name).split(".")[0]
-      self.imports[name] = node.lineno
-    self.generic_visit(node)
-
-  def visit_ImportFrom(self, node):
-    for a in node.names:
-      if a.name == "*":
-        continue
-      self.imports[a.asname or a.name] = node.lineno
-    self.generic_visit(node)
-
-  def visit_Name(self, node):
-    self.used.add(node.id)
-    self.generic_visit(node)
-
-  def visit_Attribute(self, node):
-    self.generic_visit(node)
-
-
-def _check_ast(path, tree, source, findings):
-  noqa = _noqa_lines(source)
-  is_init = os.path.basename(path) == "__init__.py"
-
-  tracker = _ImportTracker()
-  tracker.visit(tree)
-  if not is_init:
-    exported = source.split("__all__", 1)[1] if "__all__" in source else ""
-    for name, lineno in sorted(tracker.imports.items(), key=lambda kv: kv[1]):
-      if name not in tracker.used and name != "_" and lineno not in noqa \
-          and name not in exported:
-        findings.append((path, lineno, "F401 %r imported but unused" % name))
-
-  seen_defs = {}
-  for node in tree.body:
-    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-      if node.name in seen_defs and node.lineno not in noqa:
-        findings.append((path, node.lineno,
-                         "F811 redefinition of %r (first at line %d)"
-                         % (node.name, seen_defs[node.name])))
-      seen_defs[node.name] = node.lineno
-
-  for node in ast.walk(tree):
-    if isinstance(node, ast.ExceptHandler) and node.type is None \
-        and node.lineno not in noqa:
-      findings.append((path, node.lineno, "E722 bare 'except:'"))
-    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-      for default in list(node.args.defaults) + \
-          [d for d in node.args.kw_defaults if d is not None]:
-        if isinstance(default, (ast.List, ast.Dict, ast.Set)) \
-            and default.lineno not in noqa:
-          findings.append((path, default.lineno,
-                           "B006 mutable default argument"))
-
-
-def _check_text(path, source, findings):
-  noqa = _noqa_lines(source)
-  for i, line in enumerate(source.splitlines(), 1):
-    if i in noqa:
-      continue
-    stripped = line.rstrip("\n")
-    if stripped != stripped.rstrip():
-      findings.append((path, i, "W291 trailing whitespace"))
-    if len(stripped) > MAX_LINE and "http" not in stripped:
-      findings.append((path, i, "E501 line too long (%d > %d)"
-                       % (len(stripped), MAX_LINE)))
-    body = stripped[:len(stripped) - len(stripped.lstrip())]
-    if "\t" in body:
-      findings.append((path, i, "W191 tab in indentation"))
-
-
-def lint_file(path, findings):
-  with open(path, encoding="utf-8") as f:
-    source = f.read()
-  try:
-    tree = ast.parse(source, filename=path)
-  except SyntaxError as e:
-    findings.append((path, e.lineno or 0, "E9 syntax error: %s" % e.msg))
-    return
-  _check_text(path, source, findings)
-  _check_ast(path, tree, source, findings)
-
-
-def main(argv):
-  roots = argv[1:] or DEFAULT_PATHS
-  files = []
-  for root in roots:
-    if os.path.isfile(root):
-      files.append(root)
-      continue
-    for dirpath, dirnames, filenames in os.walk(root):
-      dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-      files.extend(os.path.join(dirpath, f) for f in sorted(filenames)
-                   if f.endswith(".py"))
-  findings = []
-  for path in sorted(files):
-    lint_file(path, findings)
-  for path, lineno, msg in findings:
-    print("%s:%d: %s" % (path, lineno, msg))
-  print("lint: %d file(s), %d finding(s)" % (len(files), len(findings)))
-  return 1 if findings else 0
-
+from tools.analyze.style import main  # noqa: E402
 
 if __name__ == "__main__":
   sys.exit(main(sys.argv))

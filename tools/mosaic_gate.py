@@ -31,17 +31,28 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+def _libtpu_init_env():
+  """The init identifiers libtpu wants when no metadata server answers.
+
+  Off-GCE the instance-metadata endpoint can refuse (403) rather than
+  fail fast, and libtpu's fetch retries each variable 30 times — the
+  PJRT plugin init then blocks for minutes inside a C call no signal
+  can interrupt (TOS001, observed hanging the whole tier-1 run). These
+  must be set before the FIRST topology/backend init in the process, so
+  every entry into the plugin (`_topology` and the CLI sanitize) routes
+  through here."""
+  os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+  os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+  os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
+
 def _ensure_clean_env():
   """Sanitize before jax backend init: the gate must never touch the
   device plane. The remote-TPU plugin drop is the shared implementation
   (utils.platform_env.drop_remote_plugin — same as the dryrun and tests);
   on top of that the gate forces real-kernel mode and the libtpu init
-  identifiers libtpu wants when no metadata server answers (applied
-  unconditionally — they must be in place before the first topology
-  call)."""
-  os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
-  os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
-  os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+  identifiers."""
+  _libtpu_init_env()
   os.environ["TOS_PALLAS_INTERPRET"] = "0"   # the gate exists for Mosaic
   os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
   from tensorflowonspark_tpu.utils.platform_env import drop_remote_plugin
@@ -54,6 +65,7 @@ _TOPO_CACHE = {}
 def _topology(name: str):
   from jax.experimental import topologies
   if name not in _TOPO_CACHE:
+    _libtpu_init_env()
     _TOPO_CACHE[name] = topologies.get_topology_desc(name, "tpu")
   return _TOPO_CACHE[name]
 
