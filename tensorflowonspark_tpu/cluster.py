@@ -262,6 +262,10 @@ class ClusterSupervisor(object):
     pending: Dict[str, List] = {}
     if self.cluster_meta.get("input_mode") == InputMode.ENGINE:
       from tensorflowonspark_tpu.datafeed import drain_pending_rows
+      # inference feeds need their EndPartition markers preserved in
+      # stream order across the refeed, or per-partition result alignment
+      # is lost (TPUCluster.inference stamps feed_kind on the shared meta)
+      keep_markers = self.cluster_meta.get("feed_kind") == "inference"
       # every DATA queue, not just the default: train/inference accept a
       # custom qname and those rows (and their blocked feeders) need the
       # drain just as much
@@ -269,7 +273,7 @@ class ClusterSupervisor(object):
         if qname in ("error", "output", "control"):
           continue
         try:
-          rows = drain_pending_rows(hub, qname)
+          rows = drain_pending_rows(hub, qname, keep_markers=keep_markers)
         except Exception:  # noqa: BLE001 - manager vanished mid-drain
           logger.warning("draining queue %r of executor %d's dead hub "
                          "failed", qname, old_meta["executor_id"])
@@ -381,6 +385,7 @@ class TPUCluster(object):
     logger.info("feeding training data")
     assert self.input_mode == InputMode.ENGINE, \
         "train() requires InputMode.ENGINE/SPARK"
+    self.cluster_meta["feed_kind"] = "train"
     epochs = max(1, num_epochs)
     parts = self._wrap_lazy(data_partitions)
     fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
@@ -458,6 +463,7 @@ class TPUCluster(object):
     """
     assert self.input_mode == InputMode.ENGINE, \
         "train_dstream() requires InputMode.ENGINE/SPARK"
+    self.cluster_meta["feed_kind"] = "train"
     fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
                                 feed_timeout=feed_timeout, qname=qname)
     handle = _StreamFeedHandle()
@@ -486,6 +492,7 @@ class TPUCluster(object):
     """
     assert self.input_mode == InputMode.ENGINE, \
         "foreach_batch() requires InputMode.ENGINE/SPARK"
+    self.cluster_meta["feed_kind"] = "train"
     fn = node_mod.make_train_fn(self.cluster_info, self.cluster_meta,
                                 feed_timeout=feed_timeout, qname=qname)
 
@@ -521,6 +528,9 @@ class TPUCluster(object):
     logger.info("feeding inference data")
     assert self.input_mode == InputMode.ENGINE, \
         "inference() requires InputMode.ENGINE/SPARK"
+    # recovery drains must keep EndPartition markers for inference feeds
+    # (ClusterSupervisor._quarantine_dead_hub reads this off the shared meta)
+    self.cluster_meta["feed_kind"] = "inference"
     fn = node_mod.make_inference_fn(self.cluster_info, self.cluster_meta,
                                     feed_timeout=feed_timeout, qname=qname)
     data_partitions = self._wrap_lazy(data_partitions)
@@ -683,7 +693,7 @@ def run(engine: Engine, main_fn, tf_args=None,
         queues: Sequence[str] = ("input", "output", "error", "control"),
         eval_node: bool = False, release_port: bool = True,
         chips_per_node: int = 0, qmax: int = 1024,
-        feed_transport: str = "auto",
+        feed_transport: str = "auto", feed_chunk_size: int = 256,
         shm_capacity: int = 64 * 1024 * 1024,
         heartbeat_interval: Optional[float] = 5.0,
         supervise: bool = True, max_restarts: int = 2,
@@ -784,6 +794,9 @@ def run(engine: Engine, main_fn, tf_args=None,
       # shared-memory ring for the input stream; single host or per-host).
       # The default "auto" resolved above: shm on colocated engines.
       "feed_transport": feed_transport,
+      # rows per feed chunk: one codec envelope / ring payload per chunk —
+      # the transport batching unit AND the columnar assembly granularity
+      "feed_chunk_size": feed_chunk_size,
       "shm_capacity": max(shm_capacity, 8 * 1024 * 1024),
       "heartbeat_interval": heartbeat_interval,
   }

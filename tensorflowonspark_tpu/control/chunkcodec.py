@@ -13,6 +13,17 @@ Format: msgpack map ``{"f": format, ...}``; format 0 = cloudpickle
 payload under ``"p"``; format 1 = columnar with ``"t"`` (rows are tuples)
 and ``"c"`` (list of columns, each ``{"d": dtype, "s": shape, "b": bytes,
 "y": python-scalar flag}``).
+
+Two decode modes:
+
+- :func:`decode` — row materialization (pickle parity: writable rows),
+  the legacy hot path;
+- :func:`decode_columns` — returns a :class:`ColumnChunk` whose column
+  arrays are ZERO-COPY views over the msgpack bin payload (msgpack owns
+  the bytes, so the views outlive any transport scratch buffer the
+  payload was parsed from). Consumers assemble batches by slicing and
+  concatenating these columns; the concatenation at batch hand-off is
+  the single copy on that path.
 """
 
 from typing import List, Optional
@@ -25,6 +36,11 @@ _F_PICKLE = 0
 _F_COLUMNAR = 1
 
 _SCALARS = (bool, int, float)
+
+#: chunk payloads above this are split at the row level before transport
+#: (a ring record larger than ~half the ring capacity can wedge against
+#: the wrap-around padding; hub-queue envelopes just get cheaper to pickle)
+MAX_PAYLOAD = 4 * 1024 * 1024
 
 
 def _encode_column(values) -> Optional[dict]:
@@ -40,24 +56,65 @@ def _encode_column(values) -> Optional[dict]:
             "y": 0}
   if isinstance(first, _SCALARS):
     kind = type(first)
-    if not all(type(v) is kind for v in values):
+    # EXACT python types only: decode materializes .item() python scalars,
+    # so np.float64 (a float subclass that passes the isinstance above)
+    # would silently come back retyped — pickle round-trips it instead
+    if kind not in _SCALARS or not all(type(v) is kind for v in values):
       return None
-    arr = np.asarray(values)
-    if arr.dtype == object:
+    try:
+      arr = np.asarray(values)
+    except OverflowError:   # int outside every numpy integer range
+      return None
+    # the array dtype must round-trip the value kind EXACTLY: ints beyond
+    # int64 coerce to float64 (silent rounding + retyping), so ineligible
+    if arr.dtype.kind != {bool: "b", int: "i", float: "f"}[kind]:
       return None
     return {"d": arr.dtype.str, "s": [], "b": arr.tobytes(), "y": 1}
   return None
 
 
-def _decode_column(col: dict, n: int) -> List:
-  # bytearray: one copy per column, but the rows come out WRITABLE (pickle
-  # parity — consumers mutate rows in place, e.g. `row /= 255.0`)
-  arr = np.frombuffer(bytearray(col["b"]), dtype=np.dtype(col["d"]))
-  shape = tuple(col["s"])
-  arr = arr.reshape((n,) + shape)
-  if col["y"]:
-    return [v.item() for v in arr]
-  return list(arr)
+def _view_column(col: dict, n: int) -> np.ndarray:
+  """Column descriptor -> (n, *shape) ndarray view over the bin payload.
+
+  Zero-copy: the array is read-only and backed by the bytes object
+  msgpack produced for the bin — no bytearray copy, no per-row list."""
+  arr = np.frombuffer(col["b"], dtype=np.dtype(col["d"]))
+  return arr.reshape((n,) + tuple(col["s"]))
+
+
+class ColumnChunk(object):
+  """A decoded columnar chunk: per-column ndarray views sharing one payload.
+
+  ``cols[j]`` has shape ``(n, *row_shape)`` and is READ-ONLY (it aliases
+  the msgpack bin bytes). ``scalar[j]`` marks columns whose row values
+  were python scalars; ``tuples`` says whether rows were tuples.
+  :meth:`rows` materializes the exact row list :func:`decode` returns
+  (writable, pickle parity) — the fallback for row-granular consumers.
+  """
+
+  __slots__ = ("cols", "scalar", "tuples", "n")
+
+  def __init__(self, cols: List[np.ndarray], scalar: List[int],
+               tuples: bool, n: int):
+    self.cols = cols
+    self.scalar = scalar
+    self.tuples = tuples
+    self.n = n
+
+  def rows(self, start: int = 0) -> List:
+    """Materialize rows ``start..n`` (writable copies, decode() parity)."""
+    per_col = []
+    for arr, y in zip(self.cols, self.scalar):
+      part = arr[start:]
+      if y:
+        per_col.append([v.item() for v in part])
+      else:
+        # one copy per column; rows come out as non-overlapping WRITABLE
+        # views of it (consumers mutate rows in place, e.g. `row /= 255.0`)
+        per_col.append(list(part.copy()))
+    if not self.tuples:
+      return per_col[0]
+    return [tuple(col[i] for col in per_col) for i in range(self.n - start)]
 
 
 def encode(chunk) -> bytes:
@@ -83,12 +140,41 @@ def encode(chunk) -> bytes:
                        use_bin_type=True)
 
 
-def decode(payload: bytes):
+def decode_columns(payload):
+  """Decode WITHOUT materializing rows: columnar chunks come back as a
+  :class:`ColumnChunk` of zero-copy column views; pickle-format payloads
+  return the original object (typically a row list). ``payload`` may be
+  any buffer (bytes or a memoryview over a transport scratch — msgpack
+  copies bin data into owned bytes during the parse, so the returned
+  views never alias the caller's buffer)."""
   msg = msgpack.unpackb(payload, raw=False)
   if msg["f"] == _F_PICKLE:
     return cloudpickle.loads(msg["p"])
   n = msg["n"]
-  columns = [_decode_column(c, n) for c in msg["c"]]
-  if not msg["t"]:
-    return columns[0]
-  return [tuple(col[i] for col in columns) for i in range(n)]
+  return ColumnChunk([_view_column(c, n) for c in msg["c"]],
+                     [c["y"] for c in msg["c"]], bool(msg["t"]), n)
+
+
+def decode(payload):
+  out = decode_columns(payload)
+  if isinstance(out, ColumnChunk):
+    return out.rows()
+  return out
+
+
+def classify_decoded(chunk):
+  """Normalize a :func:`decode_columns` result to the consumer wire union.
+
+  THE single definition of the chunk-boundary contract every transport's
+  ``get_chunk`` and the feed's fetch path share: ``("data", ColumnChunk |
+  row_list)`` for payload chunks, ``("marker", m)`` for a single-marker
+  chunk (an end-of-feed ``None`` or a ``Marker`` shipped alone at a chunk
+  boundary); bare pickled scalars wrap into a one-row list."""
+  from tensorflowonspark_tpu.control.marker import Marker
+  if isinstance(chunk, ColumnChunk):
+    return ("data", chunk)
+  if isinstance(chunk, list):
+    if len(chunk) == 1 and (chunk[0] is None or isinstance(chunk[0], Marker)):
+      return ("marker", chunk[0])
+    return ("data", chunk)
+  return ("data", [chunk])

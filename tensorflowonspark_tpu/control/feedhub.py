@@ -26,7 +26,32 @@ import time
 from multiprocessing.managers import BaseManager
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from tensorflowonspark_tpu.control.marker import Marker
+
 logger = logging.getLogger(__name__)
+
+
+class ChunkEnvelope(object):
+  """A codec-encoded feed chunk traveling the hub queue as ONE item.
+
+  ``n`` rows ride inside ``payload`` (control/chunkcodec.py bytes); the
+  queue's bound and unfinished-task counter both weigh the envelope as
+  ``n`` rows, so backpressure and ``join`` semantics are identical to
+  the same rows enqueued individually — but the manager round-trip moves
+  one bytes object instead of pickling every row."""
+
+  __slots__ = ("n", "payload")
+
+  def __init__(self, n: int, payload: bytes):
+    self.n = n
+    self.payload = payload
+
+  def __reduce__(self):
+    return (ChunkEnvelope, (self.n, self.payload))
+
+
+def _item_weight(item) -> int:
+  return item.n if isinstance(item, ChunkEnvelope) else 1
 
 
 class FeedQueue(object):
@@ -35,17 +60,20 @@ class FeedQueue(object):
   Semantics match ``multiprocessing.JoinableQueue``: every item put increments
   an unfinished-task counter which ``task_done`` decrements; ``join`` blocks
   until it reaches zero. Adds ``put_many``/``get_many`` so a whole chunk moves
-  per manager round-trip.
+  per manager round-trip, and ``put_chunk``/``get_chunk`` so a chunk moves as
+  ONE :class:`ChunkEnvelope` item (weighted as its row count) with markers
+  delivered as chunk-boundary envelopes.
   """
 
   def __init__(self, maxsize: int = 0):
     self._maxsize = maxsize
     self._items = collections.deque()
+    self._size = 0          # weighted length: envelopes count their rows
     self._cond = threading.Condition()
     self._unfinished = 0
 
   def _has_room(self, n: int) -> bool:
-    return self._maxsize <= 0 or len(self._items) + n <= self._maxsize
+    return self._maxsize <= 0 or self._size + n <= self._maxsize
 
   def put(self, item, block: bool = True, timeout: Optional[float] = None):
     self.put_many([item], block=block, timeout=timeout)
@@ -69,7 +97,7 @@ class FeedQueue(object):
         raise QueueFull(0)
       while pos < len(items):
         room = (len(items) - pos if self._maxsize <= 0
-                else self._maxsize - len(self._items))
+                else self._maxsize - self._size)
         if room <= 0:
           if not block:
             raise QueueFull(pos)
@@ -80,7 +108,9 @@ class FeedQueue(object):
           continue
         chunk = items[pos:pos + room]
         self._items.extend(chunk)
-        self._unfinished += len(chunk)
+        weight = sum(_item_weight(it) for it in chunk)
+        self._size += weight
+        self._unfinished += weight
         pos += len(chunk)
         self._cond.notify_all()
 
@@ -108,9 +138,81 @@ class FeedQueue(object):
         self._cond.wait(remaining if remaining is not None else 1.0)
       out = []
       while self._items and len(out) < max_items:
-        out.append(self._items.popleft())
+        item = self._items.popleft()
+        self._size -= _item_weight(item)
+        out.append(item)
       self._cond.notify_all()
       return out
+
+  # -- chunk-granular delivery ----------------------------------------------
+
+  def put_chunk(self, n: int, payload: bytes, block: bool = True,
+                timeout: Optional[float] = None) -> None:
+    """Enqueue one codec-encoded chunk of ``n`` rows as a single envelope.
+
+    The envelope is atomic (it cannot spill in pieces): admission waits
+    for ``n`` rows of room, or for the queue to be empty — so a chunk
+    larger than the whole bound still streams through alone instead of
+    deadlocking. Weighted exactly like ``n`` individual rows for both the
+    bound and the ``join`` counter.
+    """
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with self._cond:
+      while not (self._has_room(n) or self._size == 0):
+        if not block:
+          raise QueueFull(0)
+        remaining = None if deadline is None else deadline - time.monotonic()
+        if remaining is not None and remaining <= 0:
+          raise QueueFull(0)
+        self._cond.wait(remaining if remaining is not None else 1.0)
+      self._items.append(ChunkEnvelope(n, payload))
+      self._size += n
+      self._unfinished += n
+      self._cond.notify_all()
+
+  def get_chunk(self, max_rows: int = 1024, block: bool = True,
+                timeout: Optional[float] = None):
+    """Pop ONE chunk-boundary unit; ``None`` on timeout.
+
+    Returns a wire tuple (the caller acks with ``task_done(weight)``):
+
+    - ``("enc", n, payload)`` — one codec-encoded envelope (weight n);
+    - ``("marker", m)`` — an end-of-feed ``None`` or a ``Marker``
+      instance, always delivered alone at a chunk boundary (weight 1);
+    - ``("rows", [..])`` — contiguous legacy raw rows, gathered up to
+      ``max_rows`` and stopping BEFORE any envelope/marker (weight =
+      row count).
+    """
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with self._cond:
+      while not self._items:
+        if not block:
+          return None
+        remaining = None if deadline is None else deadline - time.monotonic()
+        if remaining is not None and remaining <= 0:
+          return None
+        self._cond.wait(remaining if remaining is not None else 1.0)
+      head = self._items[0]
+      if isinstance(head, ChunkEnvelope):
+        self._items.popleft()
+        self._size -= head.n
+        self._cond.notify_all()
+        return ("enc", head.n, head.payload)
+      if head is None or isinstance(head, Marker):
+        self._items.popleft()
+        self._size -= 1
+        self._cond.notify_all()
+        return ("marker", head)
+      out = []
+      while self._items and len(out) < max_rows:
+        item = self._items[0]
+        if isinstance(item, ChunkEnvelope) or item is None \
+            or isinstance(item, Marker):
+          break
+        out.append(self._items.popleft())
+        self._size -= 1
+      self._cond.notify_all()
+      return ("rows", out)
 
   def task_done(self, n: int = 1) -> None:
     with self._cond:
@@ -132,8 +234,9 @@ class FeedQueue(object):
       return True
 
   def qsize(self) -> int:
+    """Pending ROWS (envelopes weigh their row count), not deque entries."""
     with self._cond:
-      return len(self._items)
+      return self._size
 
   def empty(self) -> bool:
     return self.qsize() == 0
@@ -208,8 +311,8 @@ def _force_exit():
   return True
 
 
-_QUEUE_METHODS = ["put", "put_many", "get", "get_many", "task_done", "join",
-                  "qsize", "empty"]
+_QUEUE_METHODS = ["put", "put_many", "put_chunk", "get", "get_many",
+                  "get_chunk", "task_done", "join", "qsize", "empty"]
 
 
 class FeedHubManager(BaseManager):

@@ -20,6 +20,9 @@ import os
 import subprocess
 from typing import Optional
 
+from tensorflowonspark_tpu.control.chunkcodec import MAX_PAYLOAD as \
+    _CODEC_MAX_PAYLOAD
+
 
 logger = logging.getLogger(__name__)
 
@@ -177,14 +180,15 @@ class RingQueueAdapter(object):
 
   def __init__(self, ring: "ShmRing"):
     self._ring = ring
-    self._closed = False
+    self._end_sent = False   # synthesized end-of-feed delivered (either API)
     import collections
     self._buffer = collections.deque()
 
   # keep any single ring payload comfortably below the ring capacity so a
   # write can always be placed after a drain (a record larger than roughly
-  # half the ring can wedge against the wrap-around padding)
-  MAX_PAYLOAD = 4 * 1024 * 1024
+  # half the ring can wedge against the wrap-around padding); ONE bound
+  # shared with put_rows_chunk so both producer paths split identically
+  MAX_PAYLOAD = _CODEC_MAX_PAYLOAD
 
   # producer side ------------------------------------------------------------
 
@@ -205,6 +209,16 @@ class RingQueueAdapter(object):
   def put(self, item, block: bool = True, timeout=None) -> None:
     self.put_many([item], block=block, timeout=timeout)
 
+  def put_chunk(self, n: int, payload: bytes, block: bool = True,
+                timeout=None) -> None:
+    """Enqueue one ALREADY-ENCODED chunk (``n`` is informational here —
+    the ring's byte accounting is its own backpressure). Same signature
+    as ``FeedQueue.put_chunk`` so producers treat both transports alike;
+    callers split oversized chunks at the row level (``node.put_rows_chunk``)
+    before reaching either."""
+    t = None if (block and timeout is None) else (timeout if block else 0.0)
+    self._ring.put_payload(payload, timeout=t)
+
   def join(self, timeout=None) -> bool:
     import time as _time
     deadline = None if timeout is None else _time.monotonic() + timeout
@@ -218,7 +232,7 @@ class RingQueueAdapter(object):
 
   def get_many(self, max_items: int, block: bool = True, timeout=None):
     if not self._buffer:
-      if self._closed:
+      if self._end_sent:
         return []
       try:
         got = self._ring.get_batch(
@@ -233,12 +247,46 @@ class RingQueueAdapter(object):
         # DataFeed.next_batch reaches done_feeding instead of polling an
         # empty closed ring forever — and later calls return [] so
         # DataFeed.terminate's consecutive-empty drain loop still ends
-        self._closed = True
+        self._end_sent = True
         return [None]
     out = []
     while self._buffer and len(out) < max_items:
       out.append(self._buffer.popleft())
     return out
+
+  def get_chunk(self, max_rows: int = 1024, block: bool = True,
+                timeout=None):
+    """Dequeue ONE chunk without materializing rows; ``None`` on timeout.
+
+    Returns the consumer-facing union ``("data", ColumnChunk | row_list)``
+    or ``("marker", m)``: one ring payload maps to one chunk, decoded via
+    ``chunkcodec.decode_columns`` with the scratch buffer passed straight
+    into msgpack (no whole-payload copy; the column views are backed by
+    msgpack-owned bytes, so producer slot reuse after ``task_done`` cannot
+    touch a handed-off chunk). Single-marker chunks (a ``put(None)`` /
+    ``put(EndPartition())`` from the producer) surface as chunk-boundary
+    ``("marker", m)`` envelopes; a ring closed without an in-band marker
+    synthesizes ``("marker", None)`` exactly once.
+    """
+    from tensorflowonspark_tpu.control import chunkcodec
+    if self._buffer:
+      # rows left over from interleaved legacy get_many use
+      out = []
+      while self._buffer and len(out) < max_rows:
+        out.append(self._buffer.popleft())
+      return ("rows", out)
+    if self._end_sent:
+      return None
+    try:
+      payload = self._ring.get_payload(
+          timeout=(timeout if timeout is not None else
+                   (None if block else 0.0)))
+    except RingTimeout:
+      return None
+    except RingClosed:
+      self._end_sent = True
+      return ("marker", None)
+    return chunkcodec.classify_decoded(chunkcodec.decode_columns(payload))
 
   def task_done(self, n: int = 1) -> None:
     pass
@@ -310,12 +358,20 @@ class ShmRing(object):
 
   def get_batch(self, timeout: Optional[float] = None):
     """Dequeue one batch; raises RingClosed when drained after close."""
+    from tensorflowonspark_tpu.control import chunkcodec
+    return chunkcodec.decode(self.get_payload(timeout=timeout))
+
+  def get_payload(self, timeout: Optional[float] = None):
+    """Dequeue one raw serialized record as a memoryview over the reader
+    scratch buffer — ZERO-COPY hand-off to the codec. The view is only
+    valid until the next read: decode before reading again (msgpack
+    copies bin/str data into owned bytes during the parse, so decoded
+    chunks survive scratch reuse)."""
     t = -1 if timeout is None else int(timeout * 1000)
     while True:
       n = self._lib.tos_ring_read(self._h, self._buf, len(self._buf), t)
       if n >= 0:
-        from tensorflowonspark_tpu.control import chunkcodec
-        return chunkcodec.decode(self._buf.raw[:n])
+        return memoryview(self._buf)[:n]
       if n == -1:
         raise RingTimeout("ring %r read timed out" % self.name)
       if n == -2:

@@ -112,6 +112,28 @@ def batched(rows: Iterable, batch_size: int, drop_remainder: bool = True,
     yield collate(batch)
 
 
+def feed_batches(feed, batch_size: int, dtype=None) -> Iterator:
+  """Host-batch generator over a :class:`datafeed.DataFeed`.
+
+  Yields ``feed.next_batch_arrays(batch_size)`` results (arrays on the
+  columnar fast path, one per batch) until the feed's end-of-feed marker,
+  skipping the empty tail batch — the canonical source for
+  :func:`device_prefetch` / ``datafeed.prefetch_to_device``::
+
+      for x in device_prefetch(feed_batches(feed, B), size=2):
+          state, loss = step(state, x)
+
+  With the feed's own fetch pipeline on (``TOS_FEED_PIPELINE``), hub RPC +
+  decode, host→device transfer, and the jitted step all overlap.
+  """
+  while not feed.should_stop():
+    batch = feed.next_batch_arrays(batch_size, dtype=dtype)
+    n = len(next(iter(batch.values()))) if isinstance(batch, dict) \
+        else len(batch)
+    if n:
+      yield batch
+
+
 def device_prefetch(batches: Iterable, size: int = 2,
                     sharding=None) -> Iterator:
   """Double-buffered host→device transfer (parity role: tf.data prefetch).

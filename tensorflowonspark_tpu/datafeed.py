@@ -13,24 +13,155 @@ Capability parity with the reference's ``TFNode.DataFeed``
 - ``input_mapping`` transposes row-tuples into a dict of named columns
   (:251,274,294-298).
 
-TPU-first difference: items move through the hub in chunks
-(``get_many``/``put_many``), one manager round-trip per batch rather than per
-row, and ``to_device_arrays`` stages a batch into device HBM.
+TPU-first difference — the COLUMNAR feed plane: items move through the hub
+in chunk-boundary envelopes (one codec-encoded chunk per transport unit,
+``control/chunkcodec.py``), and the feed keeps a chunk-granular buffer.
+Homogeneous array chunks stay columnar from the feeder all the way to
+batch assembly: ``next_batch_arrays`` / ``input_mapping`` batches are built
+by SLICING AND CONCATENATING column ndarray views across chunk boundaries
+— no per-row Python loop; the single copy happens at the concatenation
+that hands the batch off (which also makes handed-off batches immune to
+ring-slot reuse). Heterogeneous / pickle chunks and the row-list
+``next_batch`` API fall back to row materialization with unchanged
+semantics. A bounded background fetch thread (``TOS_FEED_PIPELINE``)
+pipelines hub RPCs + decode under the caller's jitted step, composing
+with ``prefetch_to_device`` double-buffering for the host→device leg.
 """
 
 import collections
 import logging
+import os
+import queue as std_queue
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from tensorflowonspark_tpu.control import chunkcodec
 from tensorflowonspark_tpu.control.marker import EndPartition, Marker
 
 logger = logging.getLogger(__name__)
+
+#: depth of the background fetch pipeline (chunks buffered ahead of the
+#: consumer); 0 disables the fetch thread (env registry: TOS008)
+ENV_FEED_PIPELINE = "TOS_FEED_PIPELINE"
+
+#: raw-row gather cap per chunk fetch (legacy unframed streams only —
+#: envelope chunks keep their own boundaries)
+DEFAULT_FETCH_ROWS = 1024
+
+#: bound on every blocking wait inside the fetch thread (TOS001: a wedged
+#: hub must never pin the thread past its stop flag check)
+_PIPELINE_POLL = 0.5
 
 
 class FeedStalledError(TimeoutError):
   """The feed produced no data (and no end-of-feed marker) for longer than
   ``liveness_timeout`` — the feeder process is presumed dead."""
+
+
+def _chunk_weight(got) -> int:
+  """task_done weight of one ``get_chunk`` wire unit."""
+  kind = got[0]
+  if kind == "enc":
+    return got[1]
+  if kind == "rows":
+    return len(got[1])
+  if kind == "data":
+    chunk = got[1]
+    return chunk.n if isinstance(chunk, chunkcodec.ColumnChunk) \
+        else len(chunk)
+  return 1  # marker
+
+
+def _fetch_chunk(channel, max_rows: int, timeout, stats=None):
+  """One chunk-granular fetch + ack off ``channel``.
+
+  Normalizes every transport's wire format to ``("data", ColumnChunk |
+  row_list)`` / ``("marker", m)`` / ``None`` (timeout), acking the
+  channel with the unit's row weight immediately after the fetch (the
+  same eager-ack the row path always used)."""
+  t0 = time.perf_counter() if stats is not None else 0.0
+  got = channel.get_chunk(max_rows, block=True, timeout=timeout)
+  if stats is not None:
+    stats["fetch_s"] += time.perf_counter() - t0
+  if not got:
+    return None
+  channel.task_done(_chunk_weight(got))
+  kind = got[0]
+  if kind != "enc":
+    if kind == "rows":
+      return ("data", got[1])
+    return got  # already normalized ("data", ...) / ("marker", m)
+  t0 = time.perf_counter() if stats is not None else 0.0
+  chunk = chunkcodec.decode_columns(got[2])
+  if stats is not None:
+    stats["decode_s"] += time.perf_counter() - t0
+  return chunkcodec.classify_decoded(chunk)
+
+
+class _FetchPipeline(object):
+  """Bounded background chunk fetcher (the hub-RPC overlap plane).
+
+  One daemon thread repeats ``_fetch_chunk`` into a depth-bounded local
+  queue so the manager round-trip AND the msgpack decode of chunk N+1 run
+  under the caller's jitted step for chunk N. Every blocking call is
+  timeout-bounded (TOS001); a fetch error is forwarded and re-raised in
+  the consumer; the thread retires itself at end-of-feed.
+  """
+
+  def __init__(self, channel, depth: int, max_rows: int, stats):
+    self._channel = channel
+    self._max_rows = max_rows
+    self._stats = stats
+    self._out = std_queue.Queue(maxsize=max(1, depth))
+    self._stop = threading.Event()
+    self._thread = threading.Thread(target=self._run, daemon=True,
+                                    name="tos-feed-fetch")
+    self._thread.start()
+
+  def _run(self):
+    while not self._stop.is_set():
+      try:
+        got = _fetch_chunk(self._channel, self._max_rows,
+                           timeout=_PIPELINE_POLL, stats=self._stats)
+      except BaseException as e:  # noqa: BLE001 - re-raised consumer-side
+        self._forward(("err", e))
+        return
+      if got is None:
+        continue
+      if not self._forward(got):
+        return
+      if got[0] == "marker" and got[1] is None:
+        return  # end-of-feed: the stream is over, retire the thread
+
+  def _forward(self, item) -> bool:
+    while not self._stop.is_set():
+      try:
+        self._out.put(item, timeout=_PIPELINE_POLL)
+        return True
+      except std_queue.Full:
+        continue
+    return False
+
+  def get(self, timeout: float):
+    """Next fetched chunk, or None; re-raises a fetch-thread error."""
+    try:
+      item = self._out.get(timeout=timeout)
+    except std_queue.Empty:
+      return None
+    if item[0] == "err":
+      raise item[1]
+    return item
+
+  def stop(self) -> None:
+    """Stop the thread and discard buffered chunks (already acked)."""
+    self._stop.set()
+    self._thread.join(timeout=5.0)
+    while True:
+      try:
+        self._out.get(block=False)
+      except std_queue.Empty:
+        return
 
 
 class DataFeed(object):
@@ -39,7 +170,8 @@ class DataFeed(object):
   def __init__(self, hub, train_mode: bool = True, qname_in: str = "input",
                qname_out: str = "output",
                input_mapping: Optional[Dict[str, str]] = None,
-               liveness_timeout: Optional[float] = 600.0):
+               liveness_timeout: Optional[float] = 600.0,
+               pipeline_depth: Optional[int] = None):
     self.hub = hub
     self.train_mode = train_mode
     self.qname_in = qname_in
@@ -56,7 +188,50 @@ class DataFeed(object):
     from tensorflowonspark_tpu.node import consumer_channel
     self._queue_in = consumer_channel(hub, qname_in)
     self._queue_out = hub.get_queue(qname_out)
-    self._buffer = collections.deque()
+    #: chunk-granular buffer: ["cols", ColumnChunk, offset] (mutable — the
+    #: offset advances as batches slice the chunk), ("rows", deque) for
+    #: heterogeneous/legacy chunks, ("marker", m) for chunk-boundary markers
+    self._chunks = collections.deque()
+    if pipeline_depth is None:
+      pipeline_depth = int(os.environ.get(ENV_FEED_PIPELINE, "2"))
+    self._pipeline_depth = max(0, pipeline_depth)
+    self._pipeline: Optional[_FetchPipeline] = None
+    #: per-stage accounting (seconds / counts), filled on the hot path —
+    #: tools/feed_bench.py reads this for its breakdown
+    self.stats = {"fetch_s": 0.0, "decode_s": 0.0, "assemble_s": 0.0,
+                  "chunks": 0, "columnar_chunks": 0}
+
+  # -- fetch plane -----------------------------------------------------------
+
+  def _fetch(self, timeout: float = 1.0) -> bool:
+    """One fetch attempt; True if a chunk entry was appended."""
+    if self._pipeline_depth > 0:
+      if self._pipeline is None:
+        self._pipeline = _FetchPipeline(self._queue_in, self._pipeline_depth,
+                                        DEFAULT_FETCH_ROWS, self.stats)
+      got = self._pipeline.get(timeout)
+    else:
+      got = _fetch_chunk(self._queue_in, DEFAULT_FETCH_ROWS,
+                         timeout=timeout, stats=self.stats)
+    if got is None:
+      return False
+    kind, payload = got
+    if kind == "marker":
+      self._chunks.append(("marker", payload))
+      return True
+    self.stats["chunks"] += 1
+    if isinstance(payload, chunkcodec.ColumnChunk):
+      self.stats["columnar_chunks"] += 1
+      self._chunks.append(["cols", payload, 0])
+    else:
+      self._chunks.append(("rows", collections.deque(payload)))
+    return True
+
+  def _stop_pipeline(self) -> None:
+    """Retire the fetch thread (already-acked buffered chunks discard)."""
+    if self._pipeline is not None:
+      self._pipeline.stop()
+      self._pipeline = None
 
   def _check_liveness(self, stalled_since: float) -> None:
     """Raise instead of polling forever when the producer side died.
@@ -70,6 +245,18 @@ class DataFeed(object):
     ``liveness_timeout`` seconds without data.
     """
     from tensorflowonspark_tpu.node import _check_errors
+    try:
+      self._check_liveness_inner(stalled_since, _check_errors)
+    except BaseException:
+      # the feed is being abandoned via this raise: retire the fetch
+      # thread NOW or it keeps polling (and eagerly acking) the hub
+      # forever — racing any replacement DataFeed for chunks it would
+      # then bury in its dead queue
+      self._stop_pipeline()
+      raise
+
+  def _check_liveness_inner(self, stalled_since: float,
+                            _check_errors) -> None:
     _check_errors(self.hub, "next_batch")
     try:
       state = self.hub.get("state")
@@ -86,39 +273,182 @@ class DataFeed(object):
           "no data and no end-of-feed marker for %.0fs (hub state %r) — "
           "feeder presumed dead" % (self.liveness_timeout, state))
 
-  def next_batch(self, batch_size: int):
-    """Return up to ``batch_size`` items (or a dict of columns when an
-    input_mapping is configured). Blocks until data arrives.
+  # -- batch assembly --------------------------------------------------------
 
-    Raises :class:`FeedStalledError` (or the worker's own error, re-raised
-    from the error queue) instead of blocking forever when the producer
-    side has died; see ``liveness_timeout``.
+  def _assemble_columns(self, batch_size: int, dtype=None,
+                        require_single: bool = False):
+    """Columnar fast path: a batch as a list of column arrays, or None.
+
+    Plans up to ``batch_size`` rows over PENDING chunks first (fetching
+    more as needed), committing nothing until the whole batch is known to
+    be assemblable from ColumnChunks with matching schemas — any
+    heterogeneous/legacy row chunk in the stretch returns None and the
+    untouched buffer falls back to the row path. Markers keep their exact
+    row-path semantics: end-of-feed ends the batch (partial OK) and sets
+    ``done_feeding``; ``EndPartition`` is skipped in train mode and ends
+    the batch in inference mode. Each output column is ONE
+    ``np.concatenate`` over chunk slices — the only copy on the path.
     """
+    import numpy as np
+    plan = []             # (ColumnChunk, start, stop)
+    pops = 0              # buffer entries fully consumed, in order
+    tail_off = None       # new offset for a partially-consumed head chunk
+    end_of_feed = False
+    need = batch_size
+    sig = None            # (ncols, per-col (dtype, trailing shape))
+    stalled_since = time.monotonic()
+    while need > 0:
+      if pops >= len(self._chunks):
+        if self.done_feeding:
+          break
+        if not self._fetch(1.0):
+          if not self.done_feeding:
+            self._check_liveness(stalled_since)
+          continue
+        stalled_since = time.monotonic()
+        continue
+      entry = self._chunks[pops]
+      kind = entry[0]
+      if kind == "rows":
+        return None
+      if kind == "marker":
+        m = entry[1]
+        if m is None:
+          end_of_feed = True
+          pops += 1
+          break
+        if self.train_mode:
+          pops += 1
+          continue
+        if not plan:
+          # partition boundary with ZERO planned rows: leave the marker
+          # (nothing was committed) so the row fallback pops it and
+          # returns the same empty boundary batch the row path always
+          # produced when batch_size exactly divides the partition
+          return None
+        pops += 1
+        break  # inference: batch ends at the partition boundary
+      cc, off = entry[1], entry[2]
+      if require_single and (cc.tuples or len(cc.cols) != 1):
+        return None
+      this_sig = (len(cc.cols),
+                  tuple((a.dtype.str, a.shape[1:]) for a in cc.cols))
+      if sig is None:
+        sig = this_sig
+      elif this_sig != sig:
+        return None  # schema changed mid-batch: row fallback handles it
+      take = min(need, cc.n - off)
+      plan.append((cc, off, off + take))
+      need -= take
+      if off + take >= cc.n:
+        pops += 1
+        tail_off = None
+      else:
+        tail_off = off + take
+        break  # batch filled from a partial chunk
+
+    if not plan:
+      # nothing columnar to hand out; commit marker effects and fall back
+      for _ in range(pops):
+        self._chunks.popleft()
+      if end_of_feed:
+        logger.info("end-of-feed marker received")
+        self.done_feeding = True
+      return None
+
+    t0 = time.perf_counter()
+    for _ in range(pops):
+      self._chunks.popleft()
+    if tail_off is not None:
+      self._chunks[0][2] = tail_off
+    if end_of_feed:
+      logger.info("end-of-feed marker received")
+      self.done_feeding = True
+    ncols = len(plan[0][0].cols)
+    if self.input_tensors is not None:
+      ncols = min(ncols, len(self.input_tensors))
+    out = []
+    for j in range(ncols):
+      pieces = [cc.cols[j][a:b] for cc, a, b in plan]
+      arr = np.concatenate(pieces)  # the hand-off copy (always copies)
+      if dtype is not None and arr.dtype != np.dtype(dtype):
+        arr = arr.astype(dtype)
+      out.append(arr)
+    self.stats["assemble_s"] += time.perf_counter() - t0
+    return out
+
+  def _next_rows(self, batch_size: int) -> List:
+    """Row-granular batch loop (the legacy semantics, unchanged)."""
     batch: List = []
     stalled_since = time.monotonic()
     while len(batch) < batch_size:
-      if not self._buffer:
-        got = self._queue_in.get_many(batch_size - len(batch), block=True,
-                                      timeout=1.0)
-        if not got:
+      if not self._chunks:
+        if self.done_feeding:
+          break
+        if not self._fetch(1.0):
           if self.done_feeding:
             break
           self._check_liveness(stalled_since)
           continue
         stalled_since = time.monotonic()
-        self._queue_in.task_done(len(got))
-        self._buffer.extend(got)
-      item = self._buffer.popleft()
-      if item is None:
-        logger.info("end-of-feed marker received")
-        self.done_feeding = True
-        break
-      if isinstance(item, (Marker, EndPartition)):
+        continue
+      entry = self._chunks[0]
+      kind = entry[0]
+      if kind == "marker":
+        self._chunks.popleft()
+        m = entry[1]
+        if m is None:
+          logger.info("end-of-feed marker received")
+          self.done_feeding = True
+          break
         if self.train_mode:
           continue
         break  # inference: batch ends at the partition boundary
-      batch.append(item)
+      if kind == "cols":
+        # row-list consumers materialize the chunk (same per-row cost the
+        # old decode paid eagerly for every chunk)
+        self._chunks[0] = ("rows",
+                           collections.deque(entry[1].rows(entry[2])))
+        continue
+      rows = entry[1]
+      stop = False
+      while rows and len(batch) < batch_size:
+        item = rows.popleft()
+        if item is None:
+          logger.info("end-of-feed marker received")
+          self.done_feeding = True
+          stop = True
+          break
+        if isinstance(item, (Marker, EndPartition)):
+          if self.train_mode:
+            continue
+          stop = True  # inference: batch ends at the partition boundary
+          break
+        batch.append(item)
+      if not rows:
+        self._chunks.popleft()
+      if stop:
+        break
+    return batch
 
+  def next_batch(self, batch_size: int):
+    """Return up to ``batch_size`` items (or a dict of columns when an
+    input_mapping is configured). Blocks until data arrives.
+
+    With an input_mapping, homogeneous array chunks take the columnar
+    fast path and the dict values are stacked ndarrays; heterogeneous /
+    legacy row chunks keep the historical list values. The plain row-list
+    form (no mapping) is unchanged.
+
+    Raises :class:`FeedStalledError` (or the worker's own error, re-raised
+    from the error queue) instead of blocking forever when the producer
+    side has died; see ``liveness_timeout``.
+    """
+    if self.input_tensors is not None:
+      cols = self._assemble_columns(batch_size)
+      if cols is not None:
+        return dict(zip(self.input_tensors, cols))
+    batch = self._next_rows(batch_size)
     if self.input_tensors is None:
       return batch
     # transpose rows -> named columns
@@ -159,17 +489,26 @@ class DataFeed(object):
       err.admitted = admitted
       raise err from e
 
-  def terminate(self) -> None:
+  def terminate(self, settle_rounds: int = 3,
+                settle_timeout: float = 0.1) -> None:
     """Request early termination: mark the hub terminating and drain the
-    input queue so blocked feeders can finish (parity :320-343)."""
+    input queue so blocked feeders can finish (parity :320-343).
+
+    The drain settles after ``settle_rounds`` consecutive empty polls of
+    ``settle_timeout`` seconds each — an already-empty queue costs
+    ``settle_rounds * settle_timeout`` (0.3 s at the defaults), not the
+    3 s the old fixed 1-second polls burned on every teardown."""
     logger.info("terminate() requested; draining input queue")
     self.hub.set("state", "terminating")
     self.done_feeding = True
+    self._stop_pipeline()  # buffered chunks were already acked; discard
+    self._chunks.clear()
     empty_rounds = 0
-    while empty_rounds < 3:
-      got = self._queue_in.get_many(512, block=True, timeout=1.0)
+    while empty_rounds < settle_rounds:
+      got = self._queue_in.get_chunk(DEFAULT_FETCH_ROWS, block=True,
+                                     timeout=settle_timeout)
       if got:
-        self._queue_in.task_done(len(got))
+        self._queue_in.task_done(_chunk_weight(got))
         empty_rounds = 0
       else:
         empty_rounds += 1
@@ -200,8 +539,19 @@ class DataFeed(object):
 
   def next_batch_arrays(self, batch_size: int, dtype=None):
     """Like ``next_batch`` but returns stacked numpy arrays, ready for
-    ``jax.device_put`` (host-staging step of the feed plane redesign)."""
+    ``jax.device_put`` (the host-staging step of the feed plane).
+
+    Columnar chunks assemble with NO per-row loop: one concatenate of
+    column views per output column (single-column chunks without an
+    input_mapping return one array; with a mapping, a dict of arrays).
+    Row/heterogeneous chunks fall back to the historical stack."""
     import numpy as np
+    cols = self._assemble_columns(
+        batch_size, dtype=dtype, require_single=self.input_tensors is None)
+    if cols is not None:
+      if self.input_tensors is None:
+        return cols[0]
+      return dict(zip(self.input_tensors, cols))
     batch = self.next_batch(batch_size)
     if isinstance(batch, dict):
       return {k: np.asarray(v, dtype=dtype) for k, v in batch.items()}
@@ -209,20 +559,26 @@ class DataFeed(object):
 
 
 def drain_pending_rows(hub, qname: str = "input", settle_rounds: int = 3,
-                       settle_timeout: float = 0.1) -> List:
+                       settle_timeout: float = 0.1,
+                       keep_markers: bool = False) -> List:
   """Pull every undelivered row out of a (presumed dead) node's feed queue.
 
   Fault-recovery primitive: when a worker dies mid-feed, rows already
   pushed into its hub queue would otherwise be lost — and the feeder tasks
   blocked in ``queue.join()`` would wedge until their feed timeout. This
-  drains the queue, acking each batch with ``task_done`` so blocked
-  feeders complete, and returns the data rows for requeueing through the
-  engine feed path (``ClusterSupervisor`` refeeds them to live workers).
+  drains the queue chunk by chunk (expanding codec envelopes back into
+  rows), acking each unit with ``task_done`` so blocked feeders complete,
+  and returns the data rows for requeueing through the engine feed path
+  (``ClusterSupervisor`` refeeds them to live workers).
 
-  End-of-feed / partition markers are dropped, not returned: the requeued
-  rows ride a fresh feed round with its own markers. The drain keeps
+  End-of-feed ``None`` markers are always dropped: the requeued rows ride
+  a fresh feed round with its own end-of-feed. ``EndPartition`` (and any
+  other ``Marker``) is dropped by default but PRESERVED in stream order
+  with ``keep_markers=True`` — inference feeds need the partition
+  boundaries to keep per-partition result alignment across a refeed (the
+  supervisor passes this for inference recovery). The drain keeps
   sweeping until ``settle_rounds`` consecutive empty polls, catching a
-  feeder caught mid-``put_many``.
+  feeder caught mid-put.
 
   Only call this against a hub whose consumer is KNOWN dead — draining a
   live node's queue steals its input.
@@ -231,14 +587,32 @@ def drain_pending_rows(hub, qname: str = "input", settle_rounds: int = 3,
   rows: List = []
   empty = 0
   while empty < settle_rounds:
-    got = queue.get_many(1024, block=True, timeout=settle_timeout)
+    got = queue.get_chunk(DEFAULT_FETCH_ROWS, block=True,
+                          timeout=settle_timeout)
     if not got:
       empty += 1
       continue
     empty = 0
-    queue.task_done(len(got))
-    rows.extend(r for r in got
-                if r is not None and not isinstance(r, Marker))
+    queue.task_done(_chunk_weight(got))
+    kind = got[0]
+    if kind == "marker":
+      if keep_markers and got[1] is not None:
+        rows.append(got[1])
+      continue
+    if kind == "enc":
+      ckind, decoded = chunkcodec.classify_decoded(
+          chunkcodec.decode_columns(got[2]))
+      if ckind == "marker":
+        items = [decoded]
+      elif isinstance(decoded, chunkcodec.ColumnChunk):
+        items = decoded.rows()
+      else:
+        items = decoded
+    else:  # "rows"
+      items = got[1]
+    rows.extend(r for r in items
+                if r is not None
+                and (keep_markers or not isinstance(r, Marker)))
   return rows
 
 
@@ -259,6 +633,7 @@ def prefetch_to_device(batches, size: int = 2, device=None):
       for x in prefetch_to_device(host_batches(), size=2):
           state, loss = step(state, x)
 
+  (or use ``data.readers.feed_batches(feed, B)`` for the loop above).
   With ``size=1`` this degrades to plain ``device_put`` per batch. The
   buffer holds ``size`` batches in device memory — keep it small. Note
   the fill also gates startup: the first batch is yielded only once
@@ -267,6 +642,9 @@ def prefetch_to_device(batches, size: int = 2, device=None):
   Delegates to ``data.readers.device_prefetch`` — the FILES-mode input
   pipeline's prefetcher — so there is exactly ONE implementation of the
   overlap trick (``device`` may also be a sharding for SPMD staging).
+  Stacked with the feed's own fetch pipeline (``TOS_FEED_PIPELINE``),
+  the three stages overlap: hub RPC + decode (fetch thread), host→device
+  transfer (this buffer), and the jitted step.
   """
   from tensorflowonspark_tpu.data.readers import device_prefetch
   return device_prefetch(batches, size=size, sharding=device)

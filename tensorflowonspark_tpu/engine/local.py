@@ -27,6 +27,7 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 import cloudpickle
 
+from tensorflowonspark_tpu.control import chunkcodec
 from tensorflowonspark_tpu.engine.base import (EXECUTOR_LOST, BarrierContext,
                                                Engine, EngineJob)
 
@@ -51,7 +52,7 @@ def _executor_main(slot: int, workdir: str, task_q, result_q, env: Dict[str, str
     job_id, task_id, attempt, fn_bytes, data_bytes = item
     try:
       fn = cloudpickle.loads(fn_bytes)
-      data = cloudpickle.loads(data_bytes)
+      data = chunkcodec.decode(data_bytes)
       result = fn(iter(data))
       # mapPartitions-style fns may return generators; materialize here,
       # inside the executor, like Spark does on collect
@@ -139,7 +140,7 @@ class LocalEngine(Engine):
     fn_bytes = cloudpickle.dumps(fn)
     with self._lock:
       for i in range(n):
-        data_bytes = cloudpickle.dumps([payloads[i]])
+        data_bytes = chunkcodec.encode([payloads[i]])
         job._task_specs[i] = (fn_bytes, data_bytes, i)   # pinned to slot i
         self._pinned[i].append((job.job_id, i, 0, fn_bytes, data_bytes))
       self._schedule_locked()
@@ -150,7 +151,11 @@ class LocalEngine(Engine):
     fn_bytes = cloudpickle.dumps(fn)
     with self._lock:
       for i, part in enumerate(partitions):
-        data_bytes = cloudpickle.dumps(part)
+        # feeder side of the feed plane: homogeneous row partitions cross
+        # the driver→executor task queue COLUMNAR (one buffer per column,
+        # control/chunkcodec.py) instead of as a per-row pickle walk;
+        # anything else falls back to cloudpickle inside the codec
+        data_bytes = chunkcodec.encode(part)
         job._task_specs[i] = (fn_bytes, data_bytes, None)  # any free slot
         self._shared.append((job.job_id, i, 0, fn_bytes, data_bytes))
       self._schedule_locked()
@@ -190,7 +195,7 @@ class LocalEngine(Engine):
                        % (getattr(job, "job_id", "?"), task_id))
     fn_bytes, data_bytes, slot = spec
     if payload is not None:
-      data_bytes = cloudpickle.dumps([payload])
+      data_bytes = chunkcodec.encode([payload])
       job._task_specs[task_id] = (fn_bytes, data_bytes, slot)
     attempt = job._task_restarted(task_id)
     with self._lock:

@@ -617,6 +617,29 @@ def input_channel(hub, qname: str = "input"):
   return ring if ring is not None else hub.get_queue(qname)
 
 
+def put_rows_chunk(channel, rows, timeout=None) -> None:
+  """Ship one feed chunk as a single chunk-boundary envelope.
+
+  The chunk is encoded ONCE in the feeder process (columnar for
+  homogeneous rows, ``control/chunkcodec.py``) and travels as one unit on
+  either transport: a ring payload, or a hub-queue ``ChunkEnvelope``
+  whose manager pickle is a bytes memcpy instead of a per-row object
+  walk. Chunk boundaries survive to the consumer, which is what lets
+  ``DataFeed`` assemble batches from column views instead of row tuples.
+  Oversized chunks split at the row level so both transports stay within
+  ``chunkcodec.MAX_PAYLOAD``.
+  """
+  from tensorflowonspark_tpu.control import chunkcodec
+  rows = list(rows)
+  payload = chunkcodec.encode(rows)
+  if len(payload) > chunkcodec.MAX_PAYLOAD and len(rows) > 1:
+    half = len(rows) // 2
+    put_rows_chunk(channel, rows[:half], timeout=timeout)
+    put_rows_chunk(channel, rows[half:], timeout=timeout)
+    return
+  channel.put_chunk(len(rows), payload, block=True, timeout=timeout)
+
+
 class DualInput(object):
   """CONSUMER-side input draining the shm ring AND the hub queue.
 
@@ -638,6 +661,7 @@ class DualInput(object):
     self._queue = queue
     self._last = None
     self._stash = None    # ring tail (from the marker on) awaiting drain
+    self._stash_chunk = None  # held-back end-of-feed chunk (get_chunk path)
 
   def _from(self, ch, got):
     self._last = ch
@@ -687,6 +711,50 @@ class DualInput(object):
       got = self._ring.get_many(max_items, block=True, timeout=wait)
       if got:
         return self._deliver_ring(got, max_items)
+
+  def _ring_chunk(self, got, max_rows: int):
+    """Deliver a ring chunk, holding back an end-of-feed marker while the
+    hub queue still has remote feeders' data (get_chunk analog of
+    ``_deliver_ring``)."""
+    if got[0] == "marker" and got[1] is None and not self._queue.empty():
+      queued = self._queue.get_chunk(max_rows, block=False)
+      if queued:
+        self._stash_chunk = got
+        return self._from(self._queue, queued)
+      # the queue drained between the check and the read: release now
+    return self._from(self._ring, got)
+
+  def get_chunk(self, max_rows: int = 1024, block: bool = True,
+                timeout=None):
+    """Chunk-granular dequeue over both channels (``None`` on timeout).
+
+    Same contract as the single-channel ``get_chunk``: one chunk-boundary
+    unit per call; an end-of-feed ``None`` chunk from the ring waits for
+    the hub queue to drain, exactly like the row-granular path."""
+    import time as _time
+    if self._stash_chunk is not None:
+      queued = self._queue.get_chunk(max_rows, block=False)
+      if queued:
+        return self._from(self._queue, queued)
+      out, self._stash_chunk = self._stash_chunk, None
+      return self._from(self._ring, out)
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+      got = self._ring.get_chunk(max_rows, block=False)
+      if got:
+        return self._ring_chunk(got, max_rows)
+      got = self._queue.get_chunk(max_rows, block=False)
+      if got:
+        return self._from(self._queue, got)
+      if not block:
+        return None
+      remaining = None if deadline is None else deadline - _time.monotonic()
+      if remaining is not None and remaining <= 0:
+        return None
+      wait = 0.25 if remaining is None else min(remaining, 0.25)
+      got = self._ring.get_chunk(max_rows, block=True, timeout=wait)
+      if got:
+        return self._ring_chunk(got, max_rows)
 
   def task_done(self, n: int = 1) -> None:
     if self._last is not None:
@@ -746,15 +814,18 @@ def _materialize_partition(iterator):
 
 
 def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
-                  chunk_size=256):
+                  chunk_size=None):
   """Feeder task: push one data partition into the local node's input queue.
 
   TPU-first redesign of the reference's row-at-a-time loop
-  (TFSparkNode.py:500-502): rows move in chunks via ``put_many``, preserving
-  blocking backpressure and the terminating-state drain semantics
-  (TFSparkNode.py:492-531).
+  (TFSparkNode.py:500-502): rows move as chunk-boundary envelopes via
+  ``put_rows_chunk`` — encoded once (columnar for homogeneous rows) and
+  shipped whole — preserving blocking backpressure and the
+  terminating-state drain semantics (TFSparkNode.py:492-531).
+  ``chunk_size`` defaults to the cluster's ``feed_chunk_size``.
   """
   authkey = cluster_meta["authkey"]
+  chunk_size = chunk_size or cluster_meta.get("feed_chunk_size", 256)
 
   def _train(iterator):
     executor_id = hostinfo.read_executor_id(os.getcwd())
@@ -777,7 +848,7 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
     for item in iterator:
       chunk.append(item)
       if len(chunk) >= chunk_size:
-        queue.put_many(chunk, block=True, timeout=feed_timeout)
+        put_rows_chunk(queue, chunk, timeout=feed_timeout)
         rows += len(chunk)
         chunk = []
         # poll the error queue every 8th flushed chunk — at the flush
@@ -786,7 +857,7 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
         if (rows // chunk_size) % 8 == 0:
           _check_errors(hub, "feeding")
     if chunk:
-      queue.put_many(chunk, block=True, timeout=feed_timeout)
+      put_rows_chunk(queue, chunk, timeout=feed_timeout)
       rows += len(chunk)
     # wait until the consumer processed everything, surfacing errors
     # (parity :504-517)
@@ -805,10 +876,11 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
 
 
 def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
-                      qname="input", chunk_size=256):
+                      qname="input", chunk_size=None):
   """Inference task: feed one partition, collect its results from the output
   queue (parity: TFSparkNode.inference, TFSparkNode.py:538-599)."""
   authkey = cluster_meta["authkey"]
+  chunk_size = chunk_size or cluster_meta.get("feed_chunk_size", 256)
 
   def _inference(iterator):
     from tensorflowonspark_tpu.control.marker import EndPartition
@@ -821,11 +893,11 @@ def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
     for item in iterator:
       chunk.append(item)
       if len(chunk) >= chunk_size:
-        queue.put_many(chunk, block=True, timeout=feed_timeout)
+        put_rows_chunk(queue, chunk, timeout=feed_timeout)
         count += len(chunk)
         chunk = []
     if chunk:
-      queue.put_many(chunk, block=True, timeout=feed_timeout)
+      put_rows_chunk(queue, chunk, timeout=feed_timeout)
       count += len(chunk)
     if count == 0:
       return []  # empty partitions short-circuit (parity :569-570)

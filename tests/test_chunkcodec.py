@@ -62,6 +62,76 @@ class TestColumnarEligible:
     assert [float(x) for x in out] == [0.0, 1.0, 2.0, 3.0, 4.0]
 
 
+class TestDecodeColumns:
+  """decode_columns: the zero-copy columnar decode mode (feed plane PR)."""
+
+  def test_column_views_are_zero_copy_and_read_only(self):
+    rows = [(np.full(6, i, np.float32), i) for i in range(10)]
+    cc = chunkcodec.decode_columns(chunkcodec.encode(rows))
+    assert isinstance(cc, chunkcodec.ColumnChunk)
+    assert cc.n == 10 and cc.tuples and len(cc.cols) == 2
+    # zero-copy: the array is a view over msgpack-owned bytes, not a copy
+    assert not cc.cols[0].flags.writeable
+    assert cc.cols[0].base is not None
+    assert cc.cols[0].shape == (10, 6)
+    np.testing.assert_array_equal(cc.cols[0][3], np.full(6, 3, np.float32))
+    # scalar column decodes as a 1-D array with the scalar flag set
+    assert cc.scalar == [0, 1]
+    np.testing.assert_array_equal(cc.cols[1], np.arange(10))
+
+  def test_rows_materialization_matches_decode(self):
+    rows = [(np.arange(4, dtype=np.int64) + i, float(i)) for i in range(6)]
+    payload = chunkcodec.encode(rows)
+    via_cols = chunkcodec.decode_columns(payload).rows()
+    via_decode = chunkcodec.decode(payload)
+    assert len(via_cols) == len(via_decode) == 6
+    for (a1, f1), (a2, f2) in zip(via_cols, via_decode):
+      np.testing.assert_array_equal(a1, a2)
+      assert type(f1) is float and f1 == f2
+    # pickle parity: materialized rows are writable and don't alias
+    via_cols[0][0][:] = -1
+    np.testing.assert_array_equal(via_cols[1][0], np.arange(4) + 1)
+
+  def test_rows_with_offset(self):
+    rows = [np.full(3, i, np.float32) for i in range(5)]
+    cc = chunkcodec.decode_columns(chunkcodec.encode(rows))
+    tail = cc.rows(3)
+    assert len(tail) == 2
+    np.testing.assert_array_equal(tail[0], np.full(3, 3, np.float32))
+
+  def test_pickle_payload_passes_through(self):
+    rows = [1, "two", None]
+    out = chunkcodec.decode_columns(chunkcodec.encode(rows))
+    assert out == rows  # not a ColumnChunk
+
+  def test_huge_ints_fall_back_to_pickle_exactly(self):
+    # ints beyond int64 would coerce to float64 under np.asarray (silent
+    # rounding + retype); the column must be refused so the pickle path
+    # round-trips them exactly
+    rows = [(np.zeros(2, np.float32), 2 ** 63), (np.zeros(2, np.float32), 7)]
+    out = chunkcodec.decode(chunkcodec.encode(rows))
+    assert out[0][1] == 2 ** 63 and type(out[0][1]) is int
+    assert out[1][1] == 7 and type(out[1][1]) is int
+    out = chunkcodec.decode(chunkcodec.encode([2 ** 64, -2 ** 70]))
+    assert out == [2 ** 64, -2 ** 70]
+
+  def test_numpy_scalar_subclasses_fall_back_to_pickle_typed(self):
+    # np.float64 IS a float subclass but decode would materialize python
+    # floats — type fidelity requires the pickle path
+    rows = [(np.float64(1.5),), (np.float64(2.5),)]
+    out = chunkcodec.decode(chunkcodec.encode(rows))
+    assert type(out[0][0]) is np.float64 and out[1][0] == 2.5
+
+  def test_memoryview_payload(self):
+    # ring consumers hand the scratch buffer through as a memoryview;
+    # the decoded views must survive the scratch being overwritten
+    rows = [np.full(4, 7, np.int32) for _ in range(3)]
+    buf = bytearray(chunkcodec.encode(rows))
+    cc = chunkcodec.decode_columns(memoryview(buf))
+    buf[:] = b"\x00" * len(buf)
+    np.testing.assert_array_equal(cc.cols[0][1], np.full(4, 7, np.int32))
+
+
 class TestFallback:
   def test_string_rows_fall_back(self):
     rows = ["a", "bb", "ccc"]

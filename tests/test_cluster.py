@@ -566,3 +566,39 @@ def test_inference_over_lazy_tfrecord_partitions(engine, tmp_path):
   pids = {open(m).read() for m in _glob.glob(marker + ".*")}
   assert pids and str(_os.getpid()) not in pids, \
       "lazy partitions were materialized on the driver"
+
+
+def test_quarantine_drain_keeps_markers_for_inference_feeds():
+  """The supervisor's dead-hub drain preserves EndPartition markers when
+  the active feed is an inference feed (cluster_meta carries feed_kind),
+  so a refeed keeps per-partition result alignment — and keeps dropping
+  them for train feeds."""
+  from tensorflowonspark_tpu.cluster import ClusterSupervisor
+  from tensorflowonspark_tpu.control import feedhub
+  from tensorflowonspark_tpu.control.marker import EndPartition
+  from tensorflowonspark_tpu.node import put_rows_chunk
+
+  def _drain(feed_kind):
+    hub = feedhub.start(b"k", ["input", "output", "error"], mode="remote")
+    try:
+      q = hub.get_queue("input")
+      put_rows_chunk(q, [1, 2], timeout=5)
+      q.put(EndPartition())
+      put_rows_chunk(q, [3], timeout=5)
+      meta = {"authkey": b"k", "input_mode": InputMode.ENGINE,
+              "queues": ["input", "output", "error"],
+              "feed_kind": feed_kind}
+      sup = ClusterSupervisor(engine=None, server=None, node_job=None,
+                              cluster_meta=meta, cluster_info=[],
+                              engine_ids=[], tf_status={"error": None})
+      return sup._quarantine_dead_hub(
+          {"executor_id": 0, "hub_addr": list(hub.addr)})
+    finally:
+      hub.shutdown()
+
+  pending = _drain("inference")
+  assert [r for r in pending["input"] if not isinstance(r, EndPartition)] \
+      == [1, 2, 3]
+  assert isinstance(pending["input"][2], EndPartition)  # position preserved
+  pending = _drain("train")
+  assert pending["input"] == [1, 2, 3]

@@ -59,6 +59,183 @@ class TestDataFeed:
     assert batch == {"x": [1, 2], "y": ["a", "b"]}
 
 
+class TestColumnarFeed:
+  """The columnar fast path: chunk envelopes assembled into batches by
+  column slicing/concatenation (no per-row loop), with marker semantics
+  and the row-list fallback pinned."""
+
+  def _feed_chunks(self, hub, chunks, end=True, pipeline_depth=0,
+                   **feed_kwargs):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    for chunk in chunks:
+      put_rows_chunk(q, chunk, timeout=5)
+    if end:
+      q.put(None)
+    return DataFeed(hub, pipeline_depth=pipeline_depth, **feed_kwargs)
+
+  @pytest.mark.parametrize("pipeline_depth", [0, 2])
+  def test_batch_spans_chunk_boundaries(self, hub, pipeline_depth):
+    chunks = [[(np.full(3, 4 * c + i, np.float32), 4 * c + i)
+               for i in range(4)] for c in range(3)]   # 3 chunks x 4 rows
+    feed = self._feed_chunks(hub, chunks, pipeline_depth=pipeline_depth,
+                             input_mapping={"a_x": "x", "b_y": "y"})
+    batch = feed.next_batch_arrays(6)                  # spans chunks 0+1
+    assert isinstance(batch["x"], np.ndarray) and batch["x"].shape == (6, 3)
+    np.testing.assert_array_equal(batch["y"], np.arange(6))
+    np.testing.assert_array_equal(batch["x"][5], np.full(3, 5, np.float32))
+    batch = feed.next_batch_arrays(6)                  # chunks 1(tail)+2
+    np.testing.assert_array_equal(batch["y"], np.arange(6, 12))
+    assert feed.stats["columnar_chunks"] == 3
+
+  def test_partial_final_batch_and_end_of_feed(self, hub):
+    chunks = [[(np.ones(2, np.float32) * i,) for i in range(4)]]
+    feed = self._feed_chunks(hub, chunks,
+                             input_mapping={"only": "x"})
+    batch = feed.next_batch_arrays(3)
+    assert len(batch["x"]) == 3
+    batch = feed.next_batch_arrays(3)                  # 1 row + end marker
+    assert len(batch["x"]) == 1
+    assert feed.should_stop()
+
+  def test_marker_at_chunk_boundary_train_skips(self, hub):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    put_rows_chunk(q, [(np.float32(i) * np.ones(2),) for i in range(3)],
+                   timeout=5)
+    q.put(EndPartition())
+    put_rows_chunk(q, [(np.float32(10 + i) * np.ones(2),) for i in range(3)],
+                   timeout=5)
+    q.put(None)
+    feed = DataFeed(hub, train_mode=True, pipeline_depth=0,
+                    input_mapping={"only": "x"})
+    batch = feed.next_batch_arrays(6)                  # marker skipped
+    np.testing.assert_array_equal(batch["x"][:, 0], [0, 1, 2, 10, 11, 12])
+
+  def test_marker_at_chunk_boundary_inference_ends_batch(self, hub):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    put_rows_chunk(q, [(np.float32(i) * np.ones(2),) for i in range(3)],
+                   timeout=5)
+    q.put(EndPartition())
+    put_rows_chunk(q, [(np.float32(7),) for _ in range(2)], timeout=5)
+    q.put(None)
+    feed = DataFeed(hub, train_mode=False, pipeline_depth=0,
+                    input_mapping={"only": "x"})
+    assert len(feed.next_batch_arrays(10)["x"]) == 3   # partition-aligned
+    assert len(feed.next_batch_arrays(10)["x"]) == 2
+    assert feed.should_stop()
+
+  def test_inference_empty_boundary_batch_when_batch_divides_partition(
+      self, hub):
+    # when batch_size exactly divides the partition, the row path returns
+    # an EMPTY batch at the partition boundary (main_fns key per-partition
+    # output on it) — the columnar path must not swallow it
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    put_rows_chunk(q, [(np.float32(i) * np.ones(2),) for i in range(3)],
+                   timeout=5)
+    q.put(EndPartition())
+    put_rows_chunk(q, [(np.float32(7),) for _ in range(2)], timeout=5)
+    q.put(None)
+    feed = DataFeed(hub, train_mode=False, pipeline_depth=0,
+                    input_mapping={"only": "x"})
+    assert len(feed.next_batch_arrays(3)["x"]) == 3
+    assert len(feed.next_batch_arrays(3)["x"]) == 0    # boundary batch
+    assert len(feed.next_batch_arrays(3)["x"]) == 2
+    assert feed.should_stop()
+
+  def test_stall_raise_retires_fetch_thread(self, hub):
+    # abandoning a feed via FeedStalledError must stop the pipeline
+    # thread, or it keeps polling + eagerly acking the hub and steals
+    # chunks from any replacement DataFeed
+    from tensorflowonspark_tpu.datafeed import FeedStalledError
+    feed = DataFeed(hub, train_mode=True, pipeline_depth=2,
+                    liveness_timeout=1.5)
+    with pytest.raises(FeedStalledError):
+      feed.next_batch(4)
+    assert feed._pipeline is None
+    assert not any(t.name == "tos-feed-fetch"
+                   for t in threading.enumerate())
+
+  def test_input_mapping_column_ordering(self, hub):
+    # sorted(input_mapping) keys map to tuple positions in order: the
+    # FIRST sorted key names column 0 regardless of insertion order
+    chunks = [[(np.float32(i) * np.ones(1), 100 + i) for i in range(4)]]
+    feed = self._feed_chunks(hub, chunks,
+                             input_mapping={"z_second": "y", "a_first": "x"})
+    batch = feed.next_batch_arrays(4)
+    np.testing.assert_array_equal(batch["x"][:, 0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(batch["y"], [100, 101, 102, 103])
+
+  def test_row_list_api_on_columnar_chunks_unchanged(self, hub):
+    # next_batch (no mapping) materializes rows: same types/values as the
+    # legacy decode path, rows writable
+    chunks = [[(np.full(2, i, np.float32), i) for i in range(4)]]
+    feed = self._feed_chunks(hub, chunks)
+    rows = feed.next_batch(10)
+    assert len(rows) == 4
+    arr, label = rows[2]
+    assert isinstance(arr, np.ndarray) and label == 2
+    arr /= 2.0                                         # writable (parity)
+    np.testing.assert_array_equal(rows[3][0], np.full(2, 3, np.float32))
+
+  def test_mixed_columnar_and_raw_rows_fall_back(self, hub):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    put_rows_chunk(q, [(np.float32(1),), (np.float32(2),)], timeout=5)
+    q.put_many([(np.float32(3),), None])               # legacy raw rows
+    feed = DataFeed(hub, pipeline_depth=0, input_mapping={"only": "x"})
+    batch = feed.next_batch(5)
+    assert [float(v[0]) if isinstance(v, np.ndarray) else float(v)
+            for v in batch["x"]] == [1.0, 2.0, 3.0]
+    assert feed.should_stop()
+
+  def test_single_column_chunks_next_batch_arrays(self, hub):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    put_rows_chunk(q, [np.full(3, i, np.float32) for i in range(5)],
+                   timeout=5)
+    q.put(None)
+    feed = DataFeed(hub, pipeline_depth=0)
+    arr = feed.next_batch_arrays(4)
+    assert isinstance(arr, np.ndarray) and arr.shape == (4, 3)
+    np.testing.assert_array_equal(arr[2], np.full(3, 2, np.float32))
+
+  def test_pipeline_depth_env_knob(self, hub, monkeypatch):
+    from tensorflowonspark_tpu.datafeed import ENV_FEED_PIPELINE
+    monkeypatch.setenv(ENV_FEED_PIPELINE, "0")
+    feed = DataFeed(hub)
+    assert feed._pipeline_depth == 0
+    monkeypatch.setenv(ENV_FEED_PIPELINE, "3")
+    assert DataFeed(hub)._pipeline_depth == 3
+
+  def test_terminate_fast_on_empty_queue(self, hub):
+    feed = DataFeed(hub)
+    t0 = time.monotonic()
+    feed.terminate()
+    assert time.monotonic() - t0 < 1.5   # was >= 3s with 3x1.0s fixed polls
+    assert feed.should_stop()
+
+  def test_drain_keeps_markers_for_inference_recovery(self, hub):
+    from tensorflowonspark_tpu.datafeed import drain_pending_rows
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    put_rows_chunk(q, [1, 2], timeout=5)
+    q.put(EndPartition())
+    put_rows_chunk(q, [3], timeout=5)
+    q.put(None)
+    rows = drain_pending_rows(hub, keep_markers=True)
+    assert rows[:2] == [1, 2] and rows[3] == 3
+    assert isinstance(rows[2], EndPartition)           # position preserved
+    assert q.join(timeout=5)
+    # default still drops markers (train refeed semantics)
+    put_rows_chunk(q, [4], timeout=5)
+    q.put(EndPartition())
+    q.put(None)
+    assert drain_pending_rows(hub) == [4]
+
+
 class TestLiveness:
   """A dead feeder must raise, not hang (VERDICT r2 weakness 6; consumer-
   side extension of the reference's feeder error polling,

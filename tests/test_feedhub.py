@@ -76,6 +76,85 @@ class TestFeedQueue:
       q.task_done(2)
 
 
+class TestChunkEnvelopes:
+  """Chunk-granular delivery: envelopes, weighted accounting, marker
+  boundaries (the columnar feed-plane transport contract)."""
+
+  def test_put_chunk_get_chunk_roundtrip(self):
+    from tensorflowonspark_tpu.control import chunkcodec
+    q = FeedQueue()
+    payload = chunkcodec.encode([1, 2, 3])
+    q.put_chunk(3, payload, timeout=1)
+    got = q.get_chunk(timeout=1)
+    assert got[0] == "enc" and got[1] == 3
+    assert chunkcodec.decode(got[2]) == [1, 2, 3]
+    q.task_done(3)
+    assert q.join(timeout=1)
+
+  def test_envelope_weighted_backpressure(self):
+    # qmax counts ROWS: a 3-row envelope fills a maxsize-4 queue past a
+    # second 3-row envelope, exactly like 3 individual rows would
+    q = FeedQueue(maxsize=4)
+    q.put_chunk(3, b"a", timeout=1)
+    assert q.qsize() == 3
+    with pytest.raises(QueueFull):
+      q.put_chunk(3, b"b", block=False)
+    q.get_chunk(timeout=1)
+    q.put_chunk(3, b"b", block=False)   # room again after the pop
+
+  def test_oversized_envelope_admitted_when_empty(self):
+    # an envelope bigger than the whole bound must stream through alone
+    q = FeedQueue(maxsize=2)
+    q.put_chunk(10, b"big", timeout=1)
+    assert q.get_chunk(timeout=1)[1] == 10
+
+  def test_markers_pop_alone_at_chunk_boundaries(self):
+    from tensorflowonspark_tpu.control.marker import EndPartition
+    q = FeedQueue()
+    q.put_many([1, 2, EndPartition(), 3, None])
+    assert q.get_chunk(timeout=1) == ("rows", [1, 2])   # stops BEFORE marker
+    got = q.get_chunk(timeout=1)
+    assert got[0] == "marker" and isinstance(got[1], EndPartition)
+    assert q.get_chunk(timeout=1) == ("rows", [3])
+    assert q.get_chunk(timeout=1) == ("marker", None)
+    assert q.get_chunk(block=False) is None             # empty, not marker
+
+  def test_raw_row_gather_stops_before_envelope(self):
+    q = FeedQueue()
+    q.put_many([7, 8])
+    q.put_chunk(2, b"payload", timeout=1)
+    assert q.get_chunk(timeout=1) == ("rows", [7, 8])
+    assert q.get_chunk(timeout=1)[0] == "enc"
+
+  def test_get_chunk_timeout_returns_none(self):
+    q = FeedQueue()
+    assert q.get_chunk(timeout=0.05) is None
+
+  def test_mixed_join_accounting(self):
+    # envelopes weigh their row count in the unfinished counter too
+    q = FeedQueue()
+    q.put_chunk(4, b"p", timeout=1)
+    q.put(None)
+    q.get_chunk(timeout=1)
+    q.get_chunk(timeout=1)
+    assert not q.join(timeout=0.1)   # 4 + 1 unfinished
+    q.task_done(5)
+    assert q.join(timeout=1)
+
+  def test_envelope_through_manager_proxy(self):
+    from tensorflowonspark_tpu.control import chunkcodec
+    hub = feedhub.start(b"k", ["input"], mode="local")
+    try:
+      client = feedhub.connect(hub.addr, b"k")
+      payload = chunkcodec.encode([10, 20])
+      client.get_queue("input").put_chunk(2, payload, block=True, timeout=5)
+      got = hub.get_queue("input").get_chunk(1024, block=True, timeout=5)
+      assert got[0] == "enc" and got[1] == 2
+      assert chunkcodec.decode(got[2]) == [10, 20]
+    finally:
+      hub.shutdown()
+
+
 class TestFeedHubCrossProcess:
   def test_local_hub_roundtrip(self):
     hub = feedhub.start(b"secret", ["input", "output", "error"], mode="local")

@@ -180,6 +180,60 @@ class TestShmRing:
       assert dual.get_many(8, timeout=0.5) == [7, 8]
       assert dual.get_many(8, timeout=0.5) == [None]
 
+  def test_adapter_get_chunk_columnar(self):
+    """One ring payload maps to one chunk: homogeneous rows come back as a
+    zero-copy ColumnChunk, markers as chunk-boundary envelopes."""
+    from tensorflowonspark_tpu.control import chunkcodec
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    with shmring.ShmRing.create(_name(), capacity=1 << 20) as ring:
+      q = shmring.RingQueueAdapter(ring)
+      rows = [(np.full(4, i, np.float32), i) for i in range(6)]
+      put_rows_chunk(q, rows, timeout=5)
+      q.put(None)
+      kind, cc = q.get_chunk(timeout=2)
+      assert kind == "data" and isinstance(cc, chunkcodec.ColumnChunk)
+      assert cc.n == 6 and len(cc.cols) == 2
+      np.testing.assert_array_equal(cc.cols[0][3], np.full(4, 3, np.float32))
+      assert q.get_chunk(timeout=2) == ("marker", None)
+
+  def test_adapter_get_chunk_synthesizes_close_marker_once(self):
+    with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
+      q = shmring.RingQueueAdapter(ring)
+      ring.close_write()
+      assert q.get_chunk(timeout=2) == ("marker", None)
+      assert q.get_chunk(timeout=2) is None     # once, then empty
+
+  def test_ring_slot_reuse_cannot_corrupt_handed_off_batches(self):
+    """THE ring-slot-reuse contract: once a chunk is decoded (and after
+    batch hand-off, which concatenates), the producer overwriting the
+    ring slots — wrap-around reuse after task_done — must not be able to
+    touch it. The capacity is sized so the second/third writes physically
+    reuse the first chunk's bytes."""
+    from tensorflowonspark_tpu.control import chunkcodec
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    rows_a = [(np.full(64, 1.0, np.float32),) for _ in range(8)]
+    rows_b = [(np.full(64, -9.0, np.float32),) for _ in range(8)]
+    payload_len = len(chunkcodec.encode(rows_a))
+    # room for ~1.5 payloads: every later write wraps over chunk A's bytes
+    with shmring.ShmRing.create(_name(),
+                                capacity=payload_len + payload_len // 2
+                                + 4096) as ring:
+      q = shmring.RingQueueAdapter(ring)
+      put_rows_chunk(q, rows_a, timeout=5)
+      kind, cc = q.get_chunk(timeout=5)
+      assert kind == "data"
+      batch = np.concatenate([cc.cols[0][0:8]])   # the hand-off copy
+      q.task_done(8)                               # slot free for reuse
+      for _ in range(4):                           # producer wraps the ring
+        put_rows_chunk(q, rows_b, timeout=5)
+        got = q.get_chunk(timeout=5)
+        q.task_done(8)
+      np.testing.assert_array_equal(batch, np.ones((8, 64), np.float32))
+      # even the pre-concat views are msgpack-owned, not shm-backed
+      np.testing.assert_array_equal(cc.cols[0][5],
+                                    np.full(64, 1.0, np.float32))
+      assert got[1].cols[0][0][0] == -9.0          # later chunks decode too
+
   def test_read_timeout(self):
     with shmring.ShmRing.create(_name(), capacity=1 << 16) as ring:
       t0 = time.monotonic()

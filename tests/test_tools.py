@@ -73,3 +73,38 @@ class TestBenchWatchParse:
     from tools import bench_watch as bw
     for tail in (json.dumps({"value": "err"}), json.dumps({"value": [9.0]})):
       assert bw.parse_bench_tail(tail) == (0.0, False, None)
+
+
+class TestFeedBenchSmoke:
+  def test_smoke_runs_end_to_end(self):
+    """`feed_bench --smoke` drives the REAL feed plane (hub + ring + jitted
+    step) on CPU: the bench path itself is tier-1-covered, so a feed-plane
+    regression cannot hide until the next chip window."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "feed_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "feed_overhead_pct"
+    assert result["compute_steps_per_sec"] > 0
+    for key in ("queue", "shm", "shm+prefetch"):
+      entry = result["per_transport"][key]
+      if "error" in entry:        # no native toolchain on this host
+        continue
+      assert "feed_overhead_pct" in entry
+      # per-stage breakdown present and sane
+      stages = entry["stages"]
+      for stage in ("fetch_s", "decode_s", "assemble_s", "host_batch_s",
+                    "wall_s"):
+        assert stages[stage] >= 0.0
+      # the production path actually went columnar
+      assert stages["columnar_chunks"] == stages["chunks"] > 0

@@ -45,7 +45,8 @@ class Finding(object):
 KNOWN_ENV = set()
 
 _LOG_RECEIVERS = {"logger", "logging", "log", "_logger"}
-_BLOCKING_VERB_QUEUE = ("get", "get_many", "put", "put_many")
+_BLOCKING_VERB_QUEUE = ("get", "get_many", "put", "put_many",
+                        "get_chunk", "put_chunk")
 _SOCKET_BLOCKING = ("recv", "recv_into", "recvfrom", "accept", "connect")
 _SUBPROCESS_BLOCKING = ("run", "call", "check_call", "check_output",
                         "communicate")
