@@ -4,8 +4,8 @@
 
 PY ?= python
 
-.PHONY: test lint analyze check native bench dryrun mosaic-gate validate \
-	clean chaos
+.PHONY: test lint analyze check native bench serve-bench dryrun \
+	mosaic-gate validate clean chaos
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -43,6 +43,12 @@ native:
 
 bench:
 	$(PY) bench.py
+
+# continuous (serving.ServingEngine) vs static batching on the seeded
+# mixed-length workload; writes the committed artifact
+serve-bench:
+	$(PY) tools/serve_bench.py --compare \
+	  --json-out bench_artifacts/serve_bench_continuous.json
 
 # AOT-compile every Pallas kernel + the full fused train step against a
 # deviceless v5e topology (real Mosaic lowering via local libtpu; no chip

@@ -440,7 +440,7 @@ class TPUCluster(object):
       self.train(partitions, num_epochs=1, feed_timeout=feed_timeout,
                  qname=qname)
       rounds += 1
-      if self.server.done.is_set():
+      if self.server.stopping():
         logger.info("stop signal received; ending stream after %d rounds",
                     rounds)
         break
@@ -469,7 +469,7 @@ class TPUCluster(object):
     handle = _StreamFeedHandle()
 
     def _feed(rdd):
-      if self.server.done.is_set():
+      if self.server.stopping():
         if not handle.stopped:
           logger.info("stop signal received; skipping further micro-batches "
                       "after %d rounds", handle.rounds)
@@ -497,15 +497,19 @@ class TPUCluster(object):
                                 feed_timeout=feed_timeout, qname=qname)
 
     def _feed(batch_df, batch_id):
-      if self.server.done.is_set():
+      if self.server.stopping():
         return
       self.engine.foreach_partition(batch_df, fn).wait()
 
     return _feed
 
   def request_stop(self) -> None:
-    """Signal streaming feeds to stop after the current round."""
-    self.server.done.set()
+    """Signal streaming feeds to stop after the current round.
+
+    Sets the server's stop-REQUESTED flag only: the rendezvous keeps
+    serving (bring-up polls, heartbeats, goodbyes) until ``shutdown()``
+    actually stops it."""
+    self.server.stop_requested.set()
 
   @property
   def server_addr(self):

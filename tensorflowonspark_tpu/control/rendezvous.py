@@ -369,6 +369,15 @@ class Server(MessageSocket):
     self.liveness = Liveness(heartbeat_interval, miss_limit=miss_limit,
                              startup_grace=startup_grace)
     self.done = threading.Event()
+    # streaming-stop flag (the STOP verb): "stop feeding after the current
+    # round" — DISTINCT from ``done`` (serving ended). The server must keep
+    # serving after a stop request: nodes still in bring-up poll
+    # await_reservations, and heartbeats/goodbyes keep the liveness table
+    # truthful until shutdown actually stops the server. Closing the
+    # listener on STOP (the old behavior) made any bring-up that raced the
+    # stop signal retry against ECONNREFUSED for its whole reservation
+    # timeout and fail the node (the train_stream shutdown flake).
+    self.stop_requested = threading.Event()
     self._listener: Optional[socket.socket] = None
     self.addr: Optional[Tuple[str, int]] = None
     # round -> set of arrived task ids; sets make re-sent arrivals (client
@@ -533,7 +542,7 @@ class Server(MessageSocket):
                        "done": arrived >= int(msg["required"])})
     elif mtype == "STOP":
       logger.info("rendezvous server received STOP")
-      self.done.set()
+      self.stop_requested.set()
       self.send(sock, {"type": "OK"})
     else:
       self.send(sock, {"type": "ERROR", "error": "unknown verb: %r" % mtype})
@@ -557,12 +566,18 @@ class Server(MessageSocket):
     return self.reservations.get()
 
   def stop(self) -> None:
+    self.stop_requested.set()
     self.done.set()
     if self._listener is not None:
       try:
         self._listener.close()
       except OSError:
         pass
+
+  def stopping(self) -> bool:
+    """Stop requested (STOP verb) or serving already ended — the flag the
+    streaming feed loops check between rounds."""
+    return self.stop_requested.is_set() or self.done.is_set()
 
 
 class Client(MessageSocket):

@@ -19,9 +19,31 @@ Example:
 import argparse
 import json
 import logging
+import os
 import sys
 
 logger = logging.getLogger(__name__)
+
+
+def _validate_output_path(path: str) -> None:
+  """Fail fast on an unwritable --output destination.
+
+  Predictions stream to the output file only AFTER the engine ran the
+  whole transform — a bad path must be rejected up front, not as a
+  traceback after minutes of inference.
+  """
+  parent = os.path.dirname(os.path.abspath(path))
+  if not os.path.isdir(parent):
+    raise SystemExit(
+        "--output %s: parent directory %s does not exist — create it "
+        "first (predictions are written only after inference completes, "
+        "so this would fail at the very end)" % (path, parent))
+  if not os.access(parent, os.W_OK):
+    raise SystemExit("--output %s: parent directory %s is not writable"
+                     % (path, parent))
+  if os.path.isdir(path):
+    raise SystemExit("--output %s is a directory; pass a file path"
+                     % path)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -52,6 +74,7 @@ def main(argv=None) -> int:
   args = build_parser().parse_args(argv)
   if args.verbose:
     logging.basicConfig(level=logging.INFO)
+  _validate_output_path(args.output)
 
   from tensorflowonspark_tpu.data import dfutil
   from tensorflowonspark_tpu.data.schema import parse_schema

@@ -386,6 +386,13 @@ class Attention(nn.Module):
     instead of materializing an expanded cache. A fresh-cache prefill of
     a block-divisible segment runs through the GQA flash kernel instead
     of the seg × max_seq dense einsum (see the cond below).
+
+    The cursor (``cache/index``) is either a SCALAR — all rows in
+    lockstep, the classic batched-decode path — or a VECTOR of per-row
+    cursors (``serving/``'s slot slabs): each row writes at its own
+    offset (a vmapped update-slice, i.e. one scatter) and masks against
+    its own length, so one jitted step can advance in-flight requests
+    that are at different positions in their sequences.
     """
     cfg = self.cfg
     b, seg, h, d = q.shape
@@ -406,10 +413,25 @@ class Attention(nn.Module):
     cursor = self.variable("cache", "index",
                            lambda: jnp.zeros((), jnp.int32))
     idx = cursor.value
+    vec = idx.ndim == 1          # per-slot cursors (serving slab decode)
 
-    positions = idx + jnp.broadcast_to(jnp.arange(seg), (b, seg))
+    if vec:
+      positions = idx[:, None] + jnp.arange(seg)[None, :]
+    else:
+      positions = idx + jnp.broadcast_to(jnp.arange(seg), (b, seg))
     q = _rotary(q, positions)
     k = _rotary(k, positions)
+
+    def _cache_write(buf, val, trail):
+      """Write ``val`` at the cursor: one dynamic_update_slice for the
+      shared scalar cursor, a vmapped per-row update (one scatter) for
+      per-slot cursors. ``trail``: trailing dims after the seq axis."""
+      if not vec:
+        return jax.lax.dynamic_update_slice(
+            buf, val, (0, idx) + (0,) * trail)
+      return jax.vmap(
+          lambda row, v, i: jax.lax.dynamic_update_slice(
+              row, v, (i,) + (0,) * trail))(buf, val, idx)
     # tensor-parallel serving: keep the cache sharded on its (grouped)
     # heads dim so each chip holds 1/t of the KV bytes and attends its own
     # head slice — without the constraint GSPMD may gather the cache.
@@ -428,16 +450,16 @@ class Attention(nn.Module):
       k8, ks = _quantize(k)
       v8, vs = _quantize(v)
       k_store, v_store = k8, v8
-      k_scale.value = _constrain(jax.lax.dynamic_update_slice(
-          k_scale.value, ks, (0, idx, 0)), kv_spec[:3], self.mesh)
-      v_scale.value = _constrain(jax.lax.dynamic_update_slice(
-          v_scale.value, vs, (0, idx, 0)), kv_spec[:3], self.mesh)
+      k_scale.value = _constrain(_cache_write(k_scale.value, ks, 1),
+                                 kv_spec[:3], self.mesh)
+      v_scale.value = _constrain(_cache_write(v_scale.value, vs, 1),
+                                 kv_spec[:3], self.mesh)
     else:
       k_store, v_store = k.astype(cfg.dtype), v.astype(cfg.dtype)
-    cached_k.value = _constrain(jax.lax.dynamic_update_slice(
-        cached_k.value, k_store, (0, idx, 0, 0)), kv_spec, self.mesh)
-    cached_v.value = _constrain(jax.lax.dynamic_update_slice(
-        cached_v.value, v_store, (0, idx, 0, 0)), kv_spec, self.mesh)
+    cached_k.value = _constrain(_cache_write(cached_k.value, k_store, 2),
+                                kv_spec, self.mesh)
+    cached_v.value = _constrain(_cache_write(cached_v.value, v_store, 2),
+                                kv_spec, self.mesh)
     cursor.value = idx + seg
 
     scale = 1.0 / (d ** 0.5)
@@ -459,14 +481,26 @@ class Attention(nn.Module):
         # [b, max, hk] -> [b, hk, 1, 1, max] over the scores' k dim
         ks5 = k_scale.value.transpose(0, 2, 1)[:, :, None, None, :]
         scores = scores * ks5
-      q_pos = idx + jnp.arange(seg)[:, None]          # [seg, 1]
-      k_pos = jnp.arange(cfg.max_seq_len)[None, :]    # [1, max]
-      keep = k_pos <= q_pos                           # causal + unwritten
-      if cfg.attention_window:
-        # sliding window: cache entries older than the window are masked
-        # (they stay in the cache buffer; the mask is what bounds decode)
-        keep = jnp.logical_and(keep, k_pos > q_pos - cfg.attention_window)
-      mask = keep[None, None, None]
+      if vec:
+        # per-row cursors: each slot masks against ITS length
+        q_pos = idx[:, None, None] + jnp.arange(seg)[None, :, None]
+        k_pos = jnp.arange(cfg.max_seq_len)[None, None, :]
+        keep = k_pos <= q_pos                         # [b, seg, max]
+        if cfg.attention_window:
+          keep = jnp.logical_and(keep,
+                                 k_pos > q_pos - cfg.attention_window)
+        mask = keep[:, None, None]                    # [b,1,1,seg,max]
+      else:
+        q_pos = idx + jnp.arange(seg)[:, None]        # [seg, 1]
+        k_pos = jnp.arange(cfg.max_seq_len)[None, :]  # [1, max]
+        keep = k_pos <= q_pos                         # causal + unwritten
+        if cfg.attention_window:
+          # sliding window: cache entries older than the window are
+          # masked (they stay in the cache buffer; the mask is what
+          # bounds decode)
+          keep = jnp.logical_and(keep,
+                                 k_pos > q_pos - cfg.attention_window)
+        mask = keep[None, None, None]
       scores = jnp.where(mask, scores, -1e30)
       probs = jax.nn.softmax(scores, axis=-1)
       if quant:
@@ -492,7 +526,8 @@ class Attention(nn.Module):
     heads_consistent = single or (
         _heads_logical(h, self.mesh) == _heads_logical(hk, self.mesh))
     use_flash_prefill = False
-    if heads_consistent and seg > 1 and cfg.attention_impl != "dense":
+    if not vec and heads_consistent and seg > 1 \
+        and cfg.attention_impl != "dense":
       ecfg = cfg
       if cfg.attention_impl == "flash" and seg % min(128, seg) != 0:
         # serving accepts arbitrary prompt lengths the caller doesn't
@@ -878,10 +913,17 @@ def _select_token(logits, rng, temperature: float, top_k: int):
 @functools.lru_cache(maxsize=8)
 def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
                     num_steps: int, temperature: float, top_k: int,
-                    mesh=None):
+                    mesh=None, eos_id=None, pad_id: int = 0):
   """Cached jitted KV-cache decode: prefill once, then one token per step
   against the per-layer key/value cache — O(1) attention work per new
   token instead of a full-sequence recompute.
+
+  With ``eos_id``, the scan carries a per-sequence done-mask: a row that
+  sampled ``eos_id`` keeps its EOS token and emits ``pad_id`` for every
+  later step (its unavoidable padding work inside this fixed-shape loop —
+  the ``serving/`` slot engine is the path that RECLAIMS those steps by
+  freeing the slot). The loop itself stays fixed-length so the compiled
+  program's shape never depends on data.
 
   With ``mesh``, decode is tensor-parallel (the reference's dedicated
   inference layer scaled past one chip, TFModel.scala:245-292): params go
@@ -898,18 +940,23 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
                                   mutable=["cache"])
     rng, sub = jax.random.split(rng)
     nxt = _select_token(logits[:, -1], sub, temperature, top_k)
+    done = (nxt == eos_id) if eos_id is not None \
+        else jnp.zeros((batch,), jnp.bool_)
 
     def step(carry, _):
-      cache, tok, rng = carry
+      cache, tok, rng, done = carry
       logits, mutated = model.apply({"params": params, "cache": cache},
                                     tok[:, None], decode=True,
                                     mutable=["cache"])
       rng, sub = jax.random.split(rng)
       new = _select_token(logits[:, -1], sub, temperature, top_k)
-      return (mutated["cache"], new, rng), new
+      if eos_id is not None:
+        new = jnp.where(done, jnp.int32(pad_id), new)
+        done = jnp.logical_or(done, new == eos_id)
+      return (mutated["cache"], new, rng, done), new
 
     # prefill produced g_1; each scan iteration computes one further token
-    _, toks = lax.scan(step, (mutated["cache"], nxt, rng), None,
+    _, toks = lax.scan(step, (mutated["cache"], nxt, rng, done), None,
                        length=num_steps - 1)
     generated = jnp.concatenate([nxt[:, None], toks.T], axis=1) \
         if num_steps > 1 else nxt[:, None]
@@ -941,7 +988,8 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
 
 def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
                        num_steps: int, temperature: float = 0.0,
-                       top_k: int = 0, rng=None, mesh=None):
+                       top_k: int = 0, rng=None, mesh=None,
+                       eos_id=None, pad_id: int = 0):
   """Decoding with a per-layer KV cache (the serving path).
 
   Greedy by default; ``temperature > 0`` samples (optionally top-k
@@ -951,6 +999,13 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
   prompt_len + num_steps <= cfg.max_seq_len. With ``mesh``, decode runs
   tensor-parallel: heads (and the heads-sharded KV cache) split over the
   tensor axis, batch over the data axes (see ``_kv_generate_fn``).
+
+  ``eos_id`` enables per-sequence stopping: a row that emits ``eos_id``
+  keeps the EOS token and every later position is ``pad_id`` (the output
+  shape stays [b, plen + num_steps]); tokens before the stop are
+  identical to the eos-free decode. The loop still runs ``num_steps``
+  device steps — reclaiming finished rows' steps is what
+  ``serving.ServingEngine`` (continuous batching) is for.
   """
   b, plen = prompt.shape
   if plen + num_steps > cfg.max_seq_len:
@@ -959,6 +1014,9 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
         "cfg.max_seq_len=%d cache" % (num_steps, plen, cfg.max_seq_len))
   if temperature < 0:
     raise ValueError("temperature must be >= 0, got %r" % temperature)
+  if eos_id is not None and int(eos_id) == int(pad_id):
+    raise ValueError("eos_id and pad_id must differ (both %d): a padded "
+                     "position would read as a fresh stop" % int(pad_id))
   if rng is None:
     if temperature != 0:
       # a silent fixed key would make every "sampled" call identical
@@ -980,8 +1038,9 @@ def greedy_generate_kv(params, cfg: TransformerConfig, prompt,
         [prompt.astype(jnp.int32),
          jnp.zeros((pad, plen), jnp.int32)], axis=0)
   out = _kv_generate_fn(cfg, b + pad, plen, num_steps, float(temperature),
-                        int(top_k), mesh)(params,
-                                          prompt.astype(jnp.int32), rng)
+                        int(top_k), mesh,
+                        None if eos_id is None else int(eos_id),
+                        int(pad_id))(params, prompt.astype(jnp.int32), rng)
   return out[:b] if pad else out
 
 
@@ -1132,10 +1191,35 @@ def speculative_generate_kv(draft_params, draft_cfg: TransformerConfig,
 # make_serving_predict_fn._mesh — deliberately NOT closure state)
 _SERVING_MESH_CACHE = {}
 
+# per-process continuous-batching engines for variable-length serving
+# batches (same NOT-closure-state rationale: a live ServingEngine holds a
+# thread + device arrays and must never ride a pickled bundle)
+_SERVING_ENGINE_CACHE = {}
+
+
+def _prompt_rows(prompts):
+  """Normalize a predict-fn prompt column to (rows, ragged?).
+
+  ``rows`` is a list of 1-D int32 arrays; ``ragged`` is True when rows
+  disagree on length — list/tuple columns of per-row sequences and
+  object-dtype arrays (``pipeline``'s ragged-column fallback) both land
+  here. Rectangular ndarrays return (None, False): the batched
+  fixed-shape path handles them without row materialization.
+  """
+  import numpy as np
+  if isinstance(prompts, np.ndarray) and prompts.dtype != object:
+    return None, False
+  seq = list(prompts)
+  rows = [np.atleast_1d(np.asarray(r, np.int32).ravel()) for r in seq]
+  lengths = {len(r) for r in rows}
+  return rows, len(lengths) > 1
+
 
 def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
                             temperature: float = 0.0, top_k: int = 0,
-                            seed: int = 0, mesh=None, mesh_spec=None):
+                            seed: int = 0, mesh=None, mesh_spec=None,
+                            eos_id=None, pad_id: int = 0,
+                            num_slots=None):
   """Build a ``predict_fn(params, batch)`` for ``pipeline.export_bundle``.
 
   The batched KV-cache serving loop as a pipeline bundle: TFModel.transform
@@ -1158,6 +1242,16 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
   ``mesh_spec`` (a picklable ``parallel.mesh.MeshSpec``) instead: each
   executor process builds the mesh from ITS visible devices on first
   serve (the per-executor-session pattern of the reference's JVM layer).
+
+  VARIABLE-LENGTH batches (a list/object column whose rows disagree on
+  prompt length — ``TFModel.transform``'s ragged-column fallback) route
+  through the continuous-batching ``serving.ServingEngine`` instead of
+  the fixed-shape loop: one persistent per-process engine per config
+  (``num_slots`` slots, default ``TOS_SERVE_SLOTS``), EOS early-exit via
+  ``eos_id``, outputs right-padded with ``pad_id`` to a rectangle. The
+  engine is greedy-only, so ragged batches with ``temperature > 0``
+  raise. ``eos_id`` also applies on the rectangular path (per-sequence
+  stop inside the fixed loop).
   """
   if mesh is not None and mesh_spec is not None:
     raise ValueError("pass mesh OR mesh_spec, not both")
@@ -1181,10 +1275,55 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
       m = _self._SERVING_MESH_CACHE[key] = mesh_lib.build_mesh(mesh_spec)
     return m
 
+  def _engine(params):
+    # cache OUTSIDE the closure, reached via an IMPORT at call time (the
+    # _SERVING_MESH_CACHE pickling rationale). One engine per serving
+    # config; rebuilt if the caller serves a different param tree.
+    import tensorflowonspark_tpu.models.transformer as _self
+    from tensorflowonspark_tpu.serving import ServingEngine
+    # identity check on params is stable within a serving process:
+    # pipeline.load_bundle memoizes (params, predict_fn) per export_dir,
+    # so every transform partition hands back the SAME pytree object
+    key = (cfg, num_steps, eos_id, pad_id, num_slots, repr(mesh_spec),
+           None if mesh is None else id(mesh))
+    cached = _self._SERVING_ENGINE_CACHE.get(key)
+    # a dead engine (loop thread died on an error) must be rebuilt, not
+    # returned — otherwise one bad batch poisons ragged serving forever
+    if cached is not None and cached[0] is params and cached[1].alive:
+      return cached[1]
+    if cached is not None:
+      cached[1].stop()
+    eng = ServingEngine(params, cfg, num_slots=num_slots, eos_id=eos_id,
+                        pad_id=pad_id, max_new_tokens=num_steps,
+                        mesh=_mesh()).start()
+    _self._SERVING_ENGINE_CACHE[key] = (params, eng)
+    return eng
+
   def predict_fn(params, batch):
     import zlib
     import numpy as np
-    prompts = np.asarray(next(iter(batch.values())), np.int32)
+    raw = next(iter(batch.values()))
+    rows, ragged = _prompt_rows(raw)
+    if ragged:
+      # mixed-length generation: the continuous-batching engine decodes
+      # each row to ITS own length/stop instead of a padded lockstep loop
+      if temperature > 0:
+        raise ValueError(
+            "variable-length serving batches decode through the "
+            "continuous-batching engine, which is greedy-only — "
+            "temperature > 0 needs equal-length prompts")
+      eng = _engine(params)
+      outs = eng.generate(rows, max_new_tokens=num_steps)
+      width = max(len(o) for o in outs)
+      padded = np.full((len(outs), width), pad_id, np.int32)
+      for i, o in enumerate(outs):
+        padded[i, :len(o)] = o
+      return {"tokens": padded}
+    # an object/list column whose rows happen to share one length is NOT
+    # ragged — but np.asarray on the object array would still raise, so
+    # stack the already-normalized rows
+    prompts = np.stack(rows) if rows is not None else \
+        np.asarray(raw, np.int32)
     if prompts.ndim == 1:          # one column of scalar token ids
       prompts = prompts[:, None]
     rng = None
@@ -1196,7 +1335,7 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
           state["calls"])
     out = greedy_generate_kv(params, cfg, jnp.asarray(prompts), num_steps,
                              temperature=temperature, top_k=top_k, rng=rng,
-                             mesh=_mesh())
+                             mesh=_mesh(), eos_id=eos_id, pad_id=pad_id)
     return {"tokens": np.asarray(out)}
 
   return predict_fn

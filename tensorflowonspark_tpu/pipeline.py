@@ -462,6 +462,19 @@ class TFModel(TFParams):
 
     def _transform_partition(iterator):
       import numpy as np
+
+      def _stack_column(col):
+        # variable-length rows (mixed-length generation prompts) cannot
+        # stack rectangularly: hand the predict fn an object column —
+        # serving predict fns route those through the continuous-batching
+        # engine (models.transformer.make_serving_predict_fn)
+        try:
+          return np.asarray(col)
+        except ValueError:
+          arr = np.empty(len(col), object)
+          arr[:] = col
+          return arr
+
       # N parallel inference tasks on one TPU host must claim DISJOINT
       # chips (the same allocation parallel/runner.py does, parity
       # TFParallel.py:43-56) — before the bundle load initializes JAX
@@ -471,10 +484,10 @@ class TFModel(TFParams):
       n_cols = len(input_tensors) if input_tensors else 1
       for cols in yield_batch(iterator, batch_size, n_cols):
         if input_tensors:
-          batch = {name: np.asarray(col)
+          batch = {name: _stack_column(col)
                    for name, col in zip(input_tensors, cols)}
         else:
-          batch = {"input": np.asarray(cols[0])}
+          batch = {"input": _stack_column(cols[0])}
         out = predict_fn(params, batch)
         if not isinstance(out, dict):
           out = {"output": out}

@@ -1,0 +1,23 @@
+"""Continuous-batching serving runtime (slot-based in-flight decode).
+
+Public surface:
+
+* :class:`~tensorflowonspark_tpu.serving.engine.ServingEngine` — the
+  runtime: submit/poll/stream/generate over a persistent slot slab.
+* :class:`~tensorflowonspark_tpu.serving.slots.SlotDecoder` /
+  :func:`~tensorflowonspark_tpu.serving.slots.chunk_plan` — the jitted
+  device ops and the bucketed-prefill policy.
+* :class:`~tensorflowonspark_tpu.serving.scheduler.Request` /
+  :class:`~tensorflowonspark_tpu.serving.scheduler.RequestQueue` — the
+  host-side bookkeeping.
+
+See docs/PERFORMANCE.md §Serving for the static-vs-continuous batching
+story and ``tools/serve_bench.py --compare`` for the measurement.
+"""
+
+from tensorflowonspark_tpu.serving.engine import (            # noqa: F401
+    ENV_SERVE_POLL, ENV_SERVE_SLOTS, ServingEngine)
+from tensorflowonspark_tpu.serving.scheduler import (         # noqa: F401
+    ENV_SERVE_BUCKETS, Request, RequestQueue)
+from tensorflowonspark_tpu.serving.slots import (             # noqa: F401
+    DEFAULT_BUCKETS, SlotDecoder, chunk_plan)

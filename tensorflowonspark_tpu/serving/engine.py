@@ -1,0 +1,346 @@
+"""ServingEngine: slot-based in-flight (continuous) batching.
+
+The throughput lever the fixed-batch serving loop leaves on the table:
+``greedy_generate_kv`` decodes every request in a batch for the full
+``num_steps`` and a new batch cannot start until the slowest sequence
+finishes — on mixed-length traffic most slot-steps are wasted padding.
+This engine keeps ONE persistent jitted step function advancing a
+fixed-capacity slot slab (``serving.slots.SlotDecoder``); the moment a
+slot's request hits EOS or its token budget the slot is freed, the next
+queued request is prefilled directly into that cache region, and the
+step keeps running — the device stays saturated at request granularity
+(the same overlap-and-saturate principle as the PR 4 feed plane, and
+the batching story of arXiv:2011.03641).
+
+Greedy decode only, and per-request outputs are BIT-IDENTICAL to the
+single-request ``greedy_generate_kv`` decode of the same prompt: rows
+are independent in every einsum, per-slot cursors mask each lane to its
+own length, and prefill chunking changes which einsum computes a value
+but not the value (pinned by tests/test_serving.py).
+
+Usage::
+
+    eng = ServingEngine(params, cfg, num_slots=8, eos_id=2).start()
+    rid = eng.submit(prompt_ids, max_new_tokens=128)
+    tokens = eng.result(rid, timeout=60)        # prompt + generated
+    # or: for tok in eng.stream(rid): ...
+    eng.stop()
+
+All waits are timeout-bounded (TOS001) and the loop thread is a daemon
+(TOS007). Config knobs ride registered ``TOS_*`` env vars (TOS008):
+``TOS_SERVE_SLOTS``, ``TOS_SERVE_BUCKETS``, ``TOS_SERVE_POLL``.
+"""
+
+import logging
+import os
+import queue as std_queue
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from tensorflowonspark_tpu.serving import scheduler as sched
+from tensorflowonspark_tpu.serving import slots as slots_lib
+
+logger = logging.getLogger(__name__)
+
+#: default slot capacity when the caller passes ``num_slots=None``
+ENV_SERVE_SLOTS = "TOS_SERVE_SLOTS"
+#: idle-loop poll interval (seconds) — the bound on every engine wait
+ENV_SERVE_POLL = "TOS_SERVE_POLL"
+#: decode horizon: how many tokens one fused step dispatch advances.
+#: 1 = per-token dispatch (lowest admission latency); larger values
+#: amortize dispatch + host-sync overhead over the horizon at the cost
+#: of at most horizon-1 frozen slot-steps per finished request and
+#: admission every horizon tokens (see SlotDecoder.step_many)
+ENV_SERVE_HORIZON = "TOS_SERVE_HORIZON"
+
+_DEFAULT_SLOTS = 4
+_DEFAULT_POLL = 0.05
+_DEFAULT_HORIZON = 4
+
+
+class ServingEngine(object):
+  """Continuous-batching serving runtime over one model + param set."""
+
+  def __init__(self, params, cfg, num_slots: Optional[int] = None,
+               eos_id: Optional[int] = None, pad_id: int = 0,
+               max_new_tokens: int = 64, buckets=None, mesh=None,
+               poll_interval: Optional[float] = None,
+               horizon: Optional[int] = None):
+    if eos_id is not None and int(eos_id) == int(pad_id):
+      raise ValueError("eos_id and pad_id must differ (both %d)"
+                       % int(pad_id))
+    if num_slots is None:
+      num_slots = int(os.environ.get(ENV_SERVE_SLOTS, str(_DEFAULT_SLOTS)))
+    if horizon is None:
+      horizon = int(os.environ.get(ENV_SERVE_HORIZON,
+                                   str(_DEFAULT_HORIZON)))
+    if horizon < 1:
+      raise ValueError("horizon must be >= 1, got %d" % horizon)
+    self.params = params
+    self.cfg = cfg
+    self.eos_id = None if eos_id is None else int(eos_id)
+    self.pad_id = int(pad_id)
+    self.horizon = horizon
+    self.default_max_new_tokens = int(max_new_tokens)
+    # explicit argument beats the env knob (the num_slots/horizon rule)
+    self.buckets = tuple(buckets) if buckets is not None \
+        else sched.buckets_from_env(slots_lib.DEFAULT_BUCKETS)
+    self.decoder = slots_lib.SlotDecoder(cfg, num_slots, pad_id=pad_id,
+                                         eos_id=self.eos_id, mesh=mesh)
+    self._poll = float(poll_interval if poll_interval is not None
+                       else os.environ.get(ENV_SERVE_POLL, _DEFAULT_POLL))
+    self._queue = sched.RequestQueue()
+    self._lock = threading.Lock()
+    self._requests = {}                    # rid -> Request (in flight or done)
+    self._slots: List[Optional[sched.Request]] = [None] * num_slots
+    self._slabs = None                     # built lazily on start()
+    self._last = np.full((num_slots,), self.pad_id, np.int32)
+    self._stop_evt = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+    self._loop_error: Optional[BaseException] = None
+    self.stats = {"steps": 0, "live_slot_steps": 0, "emitted_tokens": 0,
+                  "prefills": 0, "completed": 0}
+
+  # -- lifecycle ------------------------------------------------------------
+
+  @property
+  def num_slots(self) -> int:
+    return self.decoder.num_slots
+
+  def start(self) -> "ServingEngine":
+    if self._thread is not None and self._thread.is_alive():
+      return self
+    self._stop_evt.clear()
+    self._loop_error = None
+    if self._slabs is None:
+      self._slabs = self.decoder.init_slabs()
+    self._thread = threading.Thread(target=self._loop, daemon=True,
+                                    name="tos-serving-engine")
+    self._thread.start()
+    return self
+
+  def stop(self, timeout: float = 30.0) -> None:
+    """Stop the loop thread; queued-but-unstarted requests are failed."""
+    self._stop_evt.set()
+    t = self._thread
+    if t is not None:
+      t.join(timeout=timeout)
+      if t.is_alive():
+        logger.warning("serving loop did not stop within %.1fs", timeout)
+    err = RuntimeError("serving engine stopped")
+    for req in self._queue.drain():
+      req.finish(err)
+    with self._lock:
+      live = [r for r in self._slots if r is not None]
+      self._slots = [None] * self.num_slots
+    for req in live:
+      if not req.done.is_set():
+        req.finish(err)
+    self._slabs = None                     # next start() gets a fresh slab
+
+  def __enter__(self):
+    return self.start()
+
+  def __exit__(self, *exc):
+    self.stop()
+
+  # -- client API -----------------------------------------------------------
+
+  def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
+    """Queue one prompt; returns the request id."""
+    budget = int(max_new_tokens if max_new_tokens is not None
+                 else self.default_max_new_tokens)
+    if budget < 1:
+      raise ValueError("max_new_tokens must be >= 1, got %d" % budget)
+    req = sched.Request(prompt, budget)
+    if len(req.prompt) < 1:
+      # reject here, not in the loop thread: a chunk_plan(0) crash there
+      # would take every other in-flight request down with it
+      raise ValueError("prompt must contain at least one token")
+    if len(req.prompt) + budget > self.cfg.max_seq_len:
+      raise ValueError(
+          "prompt of %d tokens + budget %d exceeds the max_seq_len=%d "
+          "slot cache" % (len(req.prompt), budget, self.cfg.max_seq_len))
+    if self._loop_error is not None:
+      raise RuntimeError("serving loop died") from self._loop_error
+    with self._lock:
+      self._requests[req.rid] = req
+    self._queue.push(req)
+    return req.rid
+
+  def _req(self, rid: int) -> sched.Request:
+    with self._lock:
+      try:
+        return self._requests[rid]
+      except KeyError:
+        raise KeyError("unknown request id %r" % (rid,))
+
+  def request(self, rid: int) -> sched.Request:
+    """The live Request handle (timing/latency fields ride on it).
+
+    Hold the handle before calling :meth:`result`/:meth:`poll` — those
+    pop the registry entry once the output is delivered."""
+    return self._req(rid)
+
+  def poll(self, rid: int) -> Optional[np.ndarray]:
+    """The finished output (prompt + generated), or None if in flight."""
+    req = self._req(rid)
+    if not req.done.is_set():
+      return None
+    return self._result_of(req, pop=True)
+
+  def result(self, rid: int, timeout: float = 600.0) -> np.ndarray:
+    """Block (bounded) for one request's output."""
+    req = self._req(rid)
+    if not req.done.wait(timeout=timeout):
+      raise TimeoutError("request %d not finished within %.1fs"
+                         % (rid, timeout))
+    return self._result_of(req, pop=True)
+
+  def _result_of(self, req: sched.Request, pop: bool) -> np.ndarray:
+    if pop:
+      with self._lock:
+        self._requests.pop(req.rid, None)
+    if req.error is not None:
+      raise RuntimeError("request %d failed" % req.rid) from req.error
+    return req.output()
+
+  def stream(self, rid: int, timeout: float = 600.0):
+    """Yield generated tokens as they are produced (EOS inclusive)."""
+    req = self._req(rid)
+    deadline = time.monotonic() + timeout
+    emitted = 0
+    while True:
+      remaining = deadline - time.monotonic()
+      if remaining <= 0:
+        raise TimeoutError("stream for request %d stalled" % rid)
+      try:
+        tok = req.stream_q.get(timeout=min(remaining, self._poll * 10))
+      except std_queue.Empty:
+        continue
+      if tok is None:
+        break
+      emitted += 1
+      yield tok
+    with self._lock:
+      self._requests.pop(rid, None)
+    if req.error is not None:
+      raise RuntimeError("request %d failed after %d token(s)"
+                         % (rid, emitted)) from req.error
+
+  def generate(self, prompts: Sequence,
+               max_new_tokens: Optional[int] = None,
+               timeout: float = 600.0) -> List[np.ndarray]:
+    """Submit a batch of prompts and wait for all outputs (in order)."""
+    rids = [self.submit(p, max_new_tokens=max_new_tokens) for p in prompts]
+    deadline = time.monotonic() + timeout
+    outs = []
+    for rid in rids:
+      outs.append(self.result(rid, timeout=max(0.001,
+                                               deadline - time.monotonic())))
+    return outs
+
+  @property
+  def alive(self) -> bool:
+    """False once the loop thread has died on an error — callers holding
+    a cached engine must rebuild instead of reusing a dead one."""
+    return self._loop_error is None
+
+  @property
+  def occupancy(self) -> float:
+    """Live-slot fraction over all decode steps so far (goodput proxy)."""
+    steps = self.stats["steps"]
+    if not steps:
+      return 0.0
+    return self.stats["live_slot_steps"] / float(steps * self.num_slots)
+
+  # -- engine loop ----------------------------------------------------------
+
+  def _loop(self) -> None:
+    try:
+      while not self._stop_evt.is_set():
+        self._admit()
+        if not any(r is not None for r in self._slots):
+          # idle: bounded block until work arrives (TOS001)
+          self._queue.wait_nonempty(timeout=self._poll)
+          continue
+        self._decode_once()
+    except BaseException as e:  # noqa: BLE001 - forwarded to every waiter
+      self._loop_error = e
+      logger.exception("serving loop died")
+      for req in self._queue.drain():
+        req.finish(e)
+      with self._lock:
+        live = [r for r in self._slots if r is not None]
+        self._slots = [None] * self.num_slots
+      for req in live:
+        req.finish(e)
+
+  def _admit(self) -> None:
+    """Prefill queued requests into free slots (EOS-freed or virgin)."""
+    for slot in range(self.num_slots):
+      if self._slots[slot] is not None:
+        continue
+      req = self._queue.pop_nowait()
+      if req is None:
+        return
+      req.started_at = time.monotonic()
+      row_cache, first = self.decoder.prefill(self.params, req.prompt,
+                                              self.buckets)
+      self.stats["prefills"] += 1
+      req.emit(first)
+      self.stats["emitted_tokens"] += 1
+      if self._finished(req, first):
+        self._complete(req)
+        continue                 # slot stays free for the next request
+      self._slabs = self.decoder.insert(self._slabs, row_cache, slot)
+      with self._lock:
+        self._slots[slot] = req
+      self._last[slot] = first
+
+  def _finished(self, req: sched.Request, token: int) -> bool:
+    if self.eos_id is not None and int(token) == self.eos_id:
+      return True
+    return len(req.tokens) >= req.max_new_tokens
+
+  def _complete(self, req: sched.Request) -> None:
+    self.stats["completed"] += 1
+    req.finish(None)
+
+  def _decode_once(self) -> None:
+    """One fused ``horizon``-step dispatch + host-side harvest.
+
+    The device scan carries each lane's EOS/budget done-mask; the host
+    replays the identical stop rule over the returned ``[horizon,
+    num_slots]`` token matrix, so the two views cannot diverge. A lane
+    that stops mid-horizon idles (frozen) for the remaining scan steps —
+    the bounded price of amortizing dispatch over the horizon."""
+    active = np.asarray([r is not None for r in self._slots], bool)
+    remaining = np.asarray(
+        [0 if r is None else r.max_new_tokens - len(r.tokens)
+         for r in self._slots], np.int32)
+    self._slabs, toks, _, _ = self.decoder.step_many(
+        self.params, self._slabs, self._last, active, remaining,
+        self.horizon)
+    toks = np.asarray(toks)                       # [horizon, num_slots]
+    self.stats["steps"] += self.horizon
+    for slot in range(self.num_slots):
+      req = self._slots[slot]
+      if req is None:
+        continue
+      for j in range(self.horizon):
+        tok = int(toks[j, slot])
+        req.emit(tok)
+        self.stats["emitted_tokens"] += 1
+        self.stats["live_slot_steps"] += 1
+        if self._finished(req, tok):
+          self._complete(req)
+          with self._lock:
+            self._slots[slot] = None
+          self._last[slot] = self.pad_id
+          break
+      else:
+        self._last[slot] = int(toks[self.horizon - 1, slot])

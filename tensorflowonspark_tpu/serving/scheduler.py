@@ -1,0 +1,125 @@
+"""Host side of continuous batching: requests, the admission queue, and
+the prompt-bucket policy.
+
+Pure bookkeeping — no device work happens here. The
+:class:`ServingEngine` thread pops :class:`Request` objects off the
+:class:`RequestQueue` whenever a slot frees and prefills them in
+(``serving.slots``); callers hold the request handle and wait on its
+event / stream queue. Every blocking wait is timeout-bounded (TOS001).
+"""
+
+import collections
+import itertools
+import os
+import queue as std_queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+#: comma list overriding the default prefill bucket sizes
+#: (``serving.slots.DEFAULT_BUCKETS``)
+ENV_SERVE_BUCKETS = "TOS_SERVE_BUCKETS"
+
+_request_ids = itertools.count(1)
+
+
+def buckets_from_env(default):
+  """The prefill bucket set: ``TOS_SERVE_BUCKETS`` (comma ints) or
+  ``default``."""
+  raw = os.environ.get(ENV_SERVE_BUCKETS, "").strip()
+  if not raw:
+    return tuple(default)
+  try:
+    sizes = tuple(int(p) for p in raw.split(",") if p.strip())
+  except ValueError:
+    raise ValueError("%s must be a comma list of ints, got %r"
+                     % (ENV_SERVE_BUCKETS, raw))
+  if not sizes or min(sizes) < 1:
+    raise ValueError("%s must name positive chunk sizes, got %r"
+                     % (ENV_SERVE_BUCKETS, raw))
+  return sizes
+
+
+class Request(object):
+  """One in-flight generation request.
+
+  ``tokens`` accumulates generated ids (EOS inclusive, never pad);
+  ``done`` fires when the request finishes or fails; ``stream_q``
+  receives each token as it is emitted, then a ``None`` sentinel.
+  """
+
+  __slots__ = ("rid", "prompt", "max_new_tokens", "tokens", "done",
+               "stream_q", "error", "submitted_at", "started_at",
+               "finished_at")
+
+  def __init__(self, prompt, max_new_tokens: int):
+    self.rid = next(_request_ids)
+    self.prompt = np.asarray(prompt, np.int32).ravel()
+    self.max_new_tokens = int(max_new_tokens)
+    self.tokens: List[int] = []
+    self.done = threading.Event()
+    self.stream_q: std_queue.Queue = std_queue.Queue()
+    self.error: Optional[BaseException] = None
+    self.submitted_at = time.monotonic()
+    self.started_at: Optional[float] = None
+    self.finished_at: Optional[float] = None
+
+  def emit(self, token: int) -> None:
+    self.tokens.append(int(token))
+    self.stream_q.put_nowait(int(token))   # unbounded: never blocks
+
+  def finish(self, error: Optional[BaseException] = None) -> None:
+    self.error = error
+    self.finished_at = time.monotonic()
+    self.stream_q.put_nowait(None)         # unbounded: never blocks
+    self.done.set()
+
+  @property
+  def latency(self) -> Optional[float]:
+    if self.finished_at is None:
+      return None
+    return self.finished_at - self.submitted_at
+
+  def output(self) -> np.ndarray:
+    """prompt + generated tokens (EOS inclusive, no padding)."""
+    return np.concatenate(
+        [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class RequestQueue(object):
+  """Thread-safe FIFO of pending requests with bounded waits."""
+
+  def __init__(self):
+    self._items = collections.deque()
+    self._cond = threading.Condition()
+
+  def push(self, request: Request) -> None:
+    with self._cond:
+      self._items.append(request)
+      self._cond.notify_all()
+
+  def pop_nowait(self) -> Optional[Request]:
+    with self._cond:
+      if self._items:
+        return self._items.popleft()
+      return None
+
+  def wait_nonempty(self, timeout: float) -> bool:
+    """Block (bounded) until at least one request is queued."""
+    with self._cond:
+      if self._items:
+        return True
+      self._cond.wait(timeout=timeout)
+      return bool(self._items)
+
+  def drain(self) -> List[Request]:
+    with self._cond:
+      items = list(self._items)
+      self._items.clear()
+      return items
+
+  def __len__(self) -> int:
+    with self._cond:
+      return len(self._items)

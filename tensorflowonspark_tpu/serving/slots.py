@@ -1,0 +1,233 @@
+"""Device side of continuous batching: slot slabs and their jitted ops.
+
+The slab is ONE persistent KV-cache pytree with a fixed slot capacity —
+per layer ``[num_slots, max_seq_len, kv_heads, head_dim]`` key/value
+buffers plus a VECTOR cursor ``index: [num_slots]`` (the per-slot-cursor
+branch of ``models.transformer.Attention._decode_attend``). Three jitted
+functions own it:
+
+* :meth:`SlotDecoder.prefill` — run one request's prompt through the
+  model on a fresh single-row cache, in bucket-sized chunks so the jit
+  cache holds at most ``len(buckets)`` prefill shapes. The first chunk
+  is a fresh-cache prefill (flash-eligible on TPU); later chunks ride
+  the warm-cache ``idx > 0`` dense branch of the same cond.
+* :meth:`SlotDecoder.insert` — scatter that row cache into the slab at a
+  freed slot (``lax.dynamic_update_slice`` on every leaf) and set the
+  slot's cursor to the prompt length.
+* :meth:`SlotDecoder.step` — advance ALL live slots one token in one
+  fixed-shape call: each slot writes at its own cursor, attends its own
+  length, and inactive slots are frozen (their cursor write is undone,
+  their emitted token forced to ``pad_id``) so freed capacity costs
+  nothing but the lane's arithmetic.
+* :meth:`SlotDecoder.step_many` — ``horizon`` of those steps fused into
+  one jitted scan that carries the per-slot done-mask (EOS hit / budget
+  spent) ON DEVICE: dispatch + host-sync overhead is paid once per
+  ``horizon`` tokens instead of per token, at the cost of at most
+  ``horizon - 1`` frozen slot-steps per completion (the same
+  done-mask mechanics as ``greedy_generate_kv(eos_id=...)``, so the
+  emitted stream stays bit-identical).
+
+Everything here is functional — the ``serving.engine.ServingEngine``
+thread owns the slab value and the host-side bookkeeping (which slots
+are live, per-request budgets/EOS).
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.tree_util import tree_map_with_path
+
+from tensorflowonspark_tpu.models import transformer as tfm
+
+#: prompt-chunk sizes for bucketed prefill, largest-first. The compiled
+#: prefill cache holds at most one entry per size, so arbitrary prompt
+#: lengths never grow the jit cache unboundedly; 1 must be reachable so
+#: every length decomposes.
+DEFAULT_BUCKETS = (512, 128, 32, 16, 8, 4, 2, 1)
+
+
+def chunk_plan(plen: int, buckets: Sequence[int] = DEFAULT_BUCKETS):
+  """Decompose a prompt length into descending bucket-sized chunks.
+
+  Greedy largest-first: ``chunk_plan(37, (128, 32, 8, 4, 2, 1))`` →
+  ``[32, 4, 1]``. A trailing 1 is appended to the bucket set if missing
+  so every positive length has a plan.
+  """
+  if plen < 1:
+    raise ValueError("prompt length must be >= 1, got %d" % plen)
+  sizes = sorted({int(b) for b in buckets if int(b) > 0}, reverse=True)
+  if not sizes or sizes[-1] != 1:
+    sizes.append(1)
+  plan, rem = [], plen
+  for b in sizes:
+    while rem >= b:
+      plan.append(b)
+      rem -= b
+  return plan
+
+
+def _is_index(path) -> bool:
+  return bool(path) and getattr(path[-1], "key", None) == "index"
+
+
+class SlotDecoder(object):
+  """Jitted slab operations for one (config, num_slots) serving shape.
+
+  Greedy decode only: continuous batching's contract is that every
+  request's tokens are bit-identical to its own single-request decode,
+  which sampling's batch-shaped rng draw cannot promise.
+  """
+
+  def __init__(self, cfg, num_slots: int, pad_id: int = 0, eos_id=None,
+               mesh=None):
+    if num_slots < 1:
+      raise ValueError("num_slots must be >= 1, got %d" % num_slots)
+    self.cfg = cfg
+    self.num_slots = num_slots
+    self.pad_id = int(pad_id)
+    self.eos_id = None if eos_id is None else int(eos_id)
+    self.mesh = mesh
+    self.model = tfm.Transformer(cfg, mesh=mesh)
+    # jit caches retrace per chunk shape (bounded by the bucket set) /
+    # once for insert+step (fixed slab shapes)
+    self._prefill_fn = jax.jit(self._prefill_impl)
+    self._insert_fn = jax.jit(self._insert_impl)
+    self._step_fn = jax.jit(self._step_impl)
+    self._step_many_jits = {}    # horizon -> jitted fused-scan step
+    self._zero_row = None        # memoized fresh [1, ...] cache (immutable)
+
+  # -- slab construction ----------------------------------------------------
+
+  def init_slabs(self):
+    """A fresh all-zeros slab with VECTOR per-slot cursors."""
+    cache = tfm._zero_cache(self.model, self.num_slots)
+
+    def widen(path, leaf):
+      if _is_index(path):
+        return jnp.zeros((self.num_slots,), leaf.dtype)
+      return leaf
+
+    return tree_map_with_path(widen, cache)
+
+  # -- prefill (single row, bucketed chunks) --------------------------------
+
+  def _prefill_impl(self, params, cache, tokens):
+    logits, mutated = self.model.apply(
+        {"params": params, "cache": cache}, tokens, decode=True,
+        mutable=["cache"])
+    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    return mutated["cache"], nxt
+
+  def prefill(self, params, prompt, buckets: Sequence[int] = DEFAULT_BUCKETS
+              ) -> Tuple[object, int]:
+    """Prefill one prompt into a fresh [1, ...] row cache.
+
+    Returns ``(row_cache, first_token)``: the warm cache (cursor at
+    ``len(prompt)``) and the first generated token g1. Chunks follow
+    :func:`chunk_plan`, so only the LAST chunk's logits matter.
+    """
+    plen = len(prompt)
+    if plen + 1 > self.cfg.max_seq_len:
+      raise ValueError(
+          "prompt of %d tokens leaves no decode room in the "
+          "max_seq_len=%d cache" % (plen, self.cfg.max_seq_len))
+    if self._zero_row is None:
+      # memoized: model.init is a full trace, far too slow to pay per
+      # admitted request; jax arrays are immutable so one zero pytree
+      # serves every prefill
+      self._zero_row = tfm._zero_cache(self.model, 1)
+    cache = self._zero_row
+    prompt = jnp.asarray(prompt, jnp.int32).reshape(1, plen)
+    off, nxt = 0, None
+    for seg in chunk_plan(plen, buckets):
+      cache, nxt = self._prefill_fn(
+          params, cache, lax.dynamic_slice(prompt, (0, off), (1, seg)))
+      off += seg
+    return cache, int(nxt[0])
+
+  # -- slot insert ----------------------------------------------------------
+
+  def _insert_impl(self, slabs, row, slot):
+    def ins(s, r):
+      if r.ndim == s.ndim:        # [1, ...] row leaf into [S, ...] slab
+        return lax.dynamic_update_slice(
+            s, r.astype(s.dtype), (slot,) + (0,) * (s.ndim - 1))
+      # scalar cursor -> one element of the vector cursor
+      return lax.dynamic_update_slice(
+          s, r.astype(s.dtype).reshape(1), (slot,))
+
+    return jax.tree.map(ins, slabs, row)
+
+  def insert(self, slabs, row_cache, slot: int):
+    """Write a prefilled row cache into slab position ``slot``."""
+    return self._insert_fn(slabs, row_cache, jnp.asarray(slot, jnp.int32))
+
+  # -- decode step ----------------------------------------------------------
+
+  def _one_step(self, params, slabs, tok, active):
+    logits, mutated = self.model.apply(
+        {"params": params, "cache": slabs}, tok[:, None], decode=True,
+        mutable=["cache"])
+    new_cache = mutated["cache"]
+
+    def freeze(path, new, old):
+      # inactive slots must not advance: undo their cursor bump so the
+      # garbage k/v their lane wrote stays masked and gets overwritten
+      # by the next real token (or by the next prefill insert)
+      if _is_index(path):
+        return jnp.where(active, new, old)
+      return new
+
+    new_cache = tree_map_with_path(freeze, new_cache, slabs)
+    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    nxt = jnp.where(active, nxt, jnp.int32(self.pad_id))
+    return new_cache, nxt
+
+  def _step_impl(self, params, slabs, tok, active):
+    return self._one_step(params, slabs, tok, active)
+
+  def step(self, params, slabs, last_tokens, active):
+    """One token for every live slot: ``(new_slabs, next_tokens)``.
+
+    ``last_tokens: [num_slots] int32`` (pad for inactive lanes),
+    ``active: [num_slots] bool``. Inactive lanes compute but are frozen.
+    """
+    return self._step_fn(params, slabs, jnp.asarray(last_tokens, jnp.int32),
+                         jnp.asarray(active, jnp.bool_))
+
+  def step_many(self, params, slabs, last_tokens, active, remaining,
+                horizon: int):
+    """``horizon`` fused decode steps with on-device EOS/budget stops.
+
+    Returns ``(new_slabs, tokens, active, remaining)`` where ``tokens``
+    is ``[horizon, num_slots]`` — a lane's stream is valid up to ITS
+    stop (EOS inclusive / budget exhausted), pad after; the host replays
+    the same stop rule to harvest. ``remaining: [num_slots] int32`` is
+    each lane's unspent token budget. One compile per distinct horizon.
+    """
+    if horizon < 1:
+      raise ValueError("horizon must be >= 1, got %d" % horizon)
+    fn = self._step_many_jits.get(horizon)
+    if fn is None:
+      def impl(params, slabs, tok, active, remaining, _h=horizon):
+        def body(carry, _):
+          slabs, tok, active, remaining = carry
+          slabs, nxt = self._one_step(params, slabs, tok, active)
+          remaining = jnp.where(active, remaining - 1, remaining)
+          done_now = remaining <= 0
+          if self.eos_id is not None:
+            done_now = jnp.logical_or(done_now, nxt == self.eos_id)
+          new_active = jnp.logical_and(active, jnp.logical_not(done_now))
+          tok = jnp.where(new_active, nxt, jnp.int32(self.pad_id))
+          return (slabs, tok, new_active, remaining), nxt
+
+        (slabs, _, active, remaining), toks = lax.scan(
+            body, (slabs, tok, active, remaining), None, length=_h)
+        return slabs, toks, active, remaining
+
+      fn = self._step_many_jits[horizon] = jax.jit(impl)
+    return fn(params, slabs, jnp.asarray(last_tokens, jnp.int32),
+              jnp.asarray(active, jnp.bool_),
+              jnp.asarray(remaining, jnp.int32))

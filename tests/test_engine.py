@@ -462,6 +462,9 @@ class TestSparkStreamingFeed:
 
     class _FakeServer:
       done = threading.Event()
+      stop_requested = threading.Event()
+      def stopping(self):
+        return self.stop_requested.is_set() or self.done.is_set()
       def stop(self):
         pass
 

@@ -187,6 +187,88 @@ class TestTransformer:
     kv = tfm.greedy_generate_kv(state.params, cfg, prompt, num_steps=10)
     np.testing.assert_array_equal(np.asarray(kv), np.asarray(full))
 
+  def test_eos_early_stop_matches_plain_decode(self):
+    """greedy_generate_kv(eos_id=...) agrees with the eos-free decode up
+    to (and including) each row's stop position; every later position is
+    the pad id — the per-sequence-stop satellite, and the primitive the
+    serving engine's slot-free logic reuses."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=16, num_layers=2, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=32,
+                                remat=False, dtype=jnp.float32)
+    state = tfm.create_state(jax.random.PRNGKey(5), cfg, seq_len=16)
+    prompt = jnp.asarray([[5, 9, 2, 11], [1, 1, 7, 0], [3, 3, 3, 3]],
+                         jnp.int32)
+    steps, pad = 12, 15
+    plain = np.asarray(tfm.greedy_generate_kv(state.params, cfg, prompt,
+                                              steps))
+    # pick an eos that actually fires for at least one row mid-stream
+    gen = plain[:, 4:]
+    eos = int(gen[0, steps // 2])
+    assert eos != pad
+    out = np.asarray(tfm.greedy_generate_kv(state.params, cfg, prompt,
+                                            steps, eos_id=eos, pad_id=pad))
+    fired = 0
+    for row in range(prompt.shape[0]):
+      stops = np.where(gen[row] == eos)[0]
+      stop = (int(stops[0]) + 1) if len(stops) else steps
+      np.testing.assert_array_equal(out[row, :4 + stop],
+                                    plain[row, :4 + stop])
+      assert (out[row, 4 + stop:] == pad).all(), (row, out[row])
+      fired += bool(len(stops))
+    assert fired >= 1, "chosen eos never fired; test proves nothing"
+
+  def test_eos_pad_collision_rejected(self):
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=16, num_layers=1, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=16,
+                                remat=False)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=8)
+    with pytest.raises(ValueError, match="eos_id and pad_id"):
+      tfm.greedy_generate_kv(state.params, cfg,
+                             jnp.asarray([[1, 2]], jnp.int32), 4,
+                             eos_id=0, pad_id=0)
+
+  def test_chunked_prefill_into_warm_cache_matches(self):
+    """The idx > 0 chunked-prefill decode path: pushing a prompt through
+    the cache in two apply calls (fresh-cache chunk, then a warm-cache
+    insert) produces the same last-position logits and the same
+    subsequent greedy stream as one whole-prompt prefill."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=16, num_layers=2, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=32,
+                                remat=False, dtype=jnp.float32)
+    state = tfm.create_state(jax.random.PRNGKey(3), cfg, seq_len=16)
+    model = tfm.Transformer(cfg)
+    prompt = jnp.asarray([[5, 9, 2, 11, 4, 1, 8, 14, 2, 6, 0, 12]],
+                         jnp.int32)
+
+    whole, _ = model.apply(
+        {"params": state.params, "cache": tfm._zero_cache(model, 1)},
+        prompt, decode=True, mutable=["cache"])
+    l1, mut = model.apply(
+        {"params": state.params, "cache": tfm._zero_cache(model, 1)},
+        prompt[:, :8], decode=True, mutable=["cache"])
+    l2, mut = model.apply({"params": state.params, "cache": mut["cache"]},
+                          prompt[:, 8:], decode=True, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(l2[:, -1]),
+                               np.asarray(whole[:, -1]),
+                               atol=1e-4, rtol=1e-4)
+    # greedy continuation from the chunk-filled cache matches the
+    # single-prefill serving decode stream
+    cache, toks = mut["cache"], []
+    tok = jnp.argmax(l2[:, -1], -1).astype(jnp.int32)
+    toks.append(int(tok[0]))
+    for _ in range(5):
+      lg, mut = model.apply({"params": state.params, "cache": cache},
+                            tok[:, None], decode=True, mutable=["cache"])
+      cache = mut["cache"]
+      tok = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+      toks.append(int(tok[0]))
+    ref = np.asarray(tfm.greedy_generate_kv(state.params, cfg, prompt,
+                                            6))[0, prompt.shape[1]:]
+    np.testing.assert_array_equal(np.asarray(toks, np.int32), ref)
+
   def test_moe_transformer_learns(self):
     """MoE layers inside the flagship model: trains, and the aux loss is
     exposed through intermediates."""

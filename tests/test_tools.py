@@ -75,6 +75,40 @@ class TestBenchWatchParse:
       assert bw.parse_bench_tail(tail) == (0.0, False, None)
 
 
+class TestServeBenchCompareSmoke:
+  def test_compare_smoke_runs_and_holds_parity(self):
+    """`serve_bench --compare --smoke` drives the REAL continuous-batching
+    engine vs the static fixed-batch loop on CPU: the bench path is
+    tier-1-covered (like feed_bench), and the engine's bit-parity with
+    single-request decodes is re-verified on every CI run. The speedup
+    itself is a chip/shape question the full run answers — the smoke
+    shape is dispatch-dominated, so only parity and shape are asserted."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "serve_bench.py"),
+         "--compare", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "serving_continuous_vs_static_tokens_per_sec"
+    assert result["parity_ok"] is True
+    assert result["continuous"]["parity_mismatches"] == 0
+    assert result["continuous"]["tok_s"] > 0
+    assert result["static"]["tok_s"] > 0
+    assert 0.0 < result["continuous"]["occupancy"] <= 1.0
+    # static really is the fixed-steps loop: every batch decodes the max
+    # budget DRAWN for this workload (a member of the option set — the
+    # largest option need not be drawn at every seed)
+    assert result["static"]["fixed_steps"] in result["workload"]["budgets"]
+
+
 class TestFeedBenchSmoke:
   def test_smoke_runs_end_to_end(self):
     """`feed_bench --smoke` drives the REAL feed plane (hub + ring + jitted

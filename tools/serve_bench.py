@@ -9,7 +9,18 @@ lever is grouped-query attention: the cache and its per-step HBM reads
 shrink num_heads/num_kv_heads×. Measures MHA vs GQA at the bench model
 shape and prints ONE JSON line.
 
+`--compare` measures the OTHER serving lever — request-level
+(continuous) batching: a seeded mixed-length (Zipf-ish) workload is
+replayed through (a) the static fixed-batch loop, where a batch of
+`--slots` requests decodes to the slowest member's budget and the next
+batch waits, and (b) `serving.ServingEngine`, where a finished slot is
+refilled immediately. Reports aggregate tokens/sec (useful tokens only
+— pads don't count), slot occupancy, and p50/p99 request latency, and
+verifies every engine output is BIT-IDENTICAL to the single-request
+decode of the same prompt. `--smoke` shrinks the shapes for CI.
+
 Usage: python tools/serve_bench.py [--batch 8] [--prompt 128] [--steps 128]
+       python tools/serve_bench.py --compare [--smoke] [--json-out f.json]
 """
 
 import argparse
@@ -98,6 +109,233 @@ def measure(cfg_kwargs, batch, prompt_len, steps):
   return tok_s, dt_one * 1e3
 
 
+# --- continuous vs static batching (--compare) ------------------------------
+
+#: compare-mode model/workload shapes: (full, smoke). The claim under
+#: test is SCHEDULING-level (slot-steps reclaimed from finished rows),
+#: so a small model keeps the CPU run honest and fast; chip-scale decode
+#: rates ride the existing per-config modes above.
+_COMPARE_FULL = dict(layers=2, heads=4, d_model=128, d_ff=256, vocab=512,
+                     requests=48, slots=4, plens=(4, 8, 12, 16),
+                     budgets=(8, 16, 32, 64, 96), max_seq=112, horizon=8)
+_COMPARE_SMOKE = dict(layers=2, heads=2, d_model=32, d_ff=64, vocab=64,
+                      requests=8, slots=3, plens=(4, 6, 8),
+                      budgets=(4, 8), max_seq=24, horizon=4)
+
+
+def _zipf_pick(rng, options, a=1.3):
+  """Zipf-ish draw over ``options`` sorted ascending: small values
+  common, large values rare — the mixed-length traffic shape that makes
+  fixed-batch decode waste slot-steps."""
+  ranks = 1.0 / (1.0 + __import__("numpy").arange(len(options))) ** a
+  p = ranks / ranks.sum()
+  return options[rng.choice(len(options), p=p)]
+
+
+def make_workload(shape, seed):
+  """Seeded mixed-length request list: (prompt ndarray, budget) pairs."""
+  import numpy as np
+  rng = np.random.RandomState(seed)
+  reqs = []
+  for _ in range(shape["requests"]):
+    plen = _zipf_pick(rng, sorted(shape["plens"]))
+    budget = _zipf_pick(rng, sorted(shape["budgets"]))
+    prompt = rng.randint(0, shape["vocab"], (plen,)).astype(np.int32)
+    reqs.append((prompt, int(budget)))
+  return reqs
+
+
+def _reference_streams(params, cfg, workload, eos_id):
+  """Per-request single-request greedy decode, truncated at the stop —
+  the parity oracle AND the definition of 'useful tokens' both modes are
+  scored by."""
+  import numpy as np
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+  streams = []
+  for prompt, budget in workload:
+    out = np.asarray(tfm.greedy_generate_kv(
+        params, cfg, jnp.asarray(prompt)[None], budget,
+        eos_id=eos_id, pad_id=0))[0]
+    gen = out[len(prompt):]
+    stops = np.where(gen == eos_id)[0]
+    stop = (int(stops[0]) + 1) if len(stops) else budget
+    streams.append(gen[:stop])
+  return streams
+
+
+def _static_groups(workload, slots):
+  """Arrival-order batching under the fixed-shape loop's constraint:
+  a batch holds EQUAL-length prompts (stacking is the only thing the
+  fixed-shape path can do — padding mixed lengths would corrupt
+  outputs), flushing at ``slots`` same-length members."""
+  open_groups, order = {}, []
+  for i, (prompt, _) in enumerate(workload):
+    g = open_groups.setdefault(len(prompt), [])
+    g.append((i, prompt))
+    if len(g) >= slots:
+      order.append(open_groups.pop(len(prompt)))
+  order.extend(g for g in open_groups.values() if g)
+  # completion order: a group finishes when its LAST member arrived
+  order.sort(key=lambda g: g[-1][0])
+  return order
+
+
+def run_static_pass(params, cfg, groups, num_steps, eos_id):
+  """One static pass; returns (wall_s, per-request latencies)."""
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+
+  def run_group(group):
+    prompts = jnp.asarray(np.stack([p for _, p in group]))
+    return tfm.greedy_generate_kv(params, cfg, prompts, num_steps,
+                                  eos_id=eos_id, pad_id=0)
+
+  t0 = time.perf_counter()
+  latencies = []
+  for g in groups:
+    jax.block_until_ready(run_group(g))
+    done_at = time.perf_counter() - t0
+    latencies.extend([done_at] * len(g))
+  return time.perf_counter() - t0, latencies
+
+
+def run_continuous_pass(eng, workload):
+  """One engine pass; returns (wall_s, latencies, outputs, stat deltas)."""
+  base = dict(eng.stats)
+  t0 = time.perf_counter()
+  rids = [eng.submit(p, max_new_tokens=b) for p, b in workload]
+  reqs = [eng.request(r) for r in rids]
+  outs = [eng.result(r, timeout=600) for r in rids]
+  wall = time.perf_counter() - t0
+  delta = {k: eng.stats[k] - base[k] for k in base}
+  return wall, [r.latency for r in reqs], outs, delta
+
+
+def measure_compare(params, cfg, workload, slots, eos_id, useful, horizon,
+                    reps):
+  """Paired static/continuous reps (the feed_bench methodology: this box
+  throttles minute-to-minute, so each rep measures both modes
+  back-to-back and the MEDIAN-speedup rep is reported)."""
+  import numpy as np
+  from tensorflowonspark_tpu.serving import ServingEngine
+
+  num_steps = max(b for _, b in workload)
+  groups = _static_groups(workload, slots)
+  total_useful = float(sum(len(s) for s in useful))
+
+  # warm every shape once: static group shapes, engine prefill buckets +
+  # fused step (the SAME engine serves every timed rep)
+  run_static_pass(params, cfg, groups, num_steps, eos_id)
+  eng = ServingEngine(params, cfg, num_slots=slots, eos_id=eos_id,
+                      pad_id=0, horizon=horizon).start()
+  rows = []
+  try:
+    run_continuous_pass(eng, workload)
+    for _ in range(reps):
+      s_wall, s_lat = run_static_pass(params, cfg, groups, num_steps,
+                                      eos_id)
+      c_wall, c_lat, outs, delta = run_continuous_pass(eng, workload)
+      mismatches = 0
+      for (prompt, _), out, ref in zip(workload, outs, useful):
+        if not np.array_equal(out, np.concatenate([prompt, ref])):
+          mismatches += 1
+      rows.append({
+          "static": {
+              "tok_s": round(total_useful / s_wall, 2),
+              "wall_s": round(s_wall, 3),
+              "fixed_steps": num_steps,
+              "p50_s": round(float(np.percentile(s_lat, 50)), 3),
+              "p99_s": round(float(np.percentile(s_lat, 99)), 3),
+              "batches": len(groups),
+          },
+          "continuous": {
+              "tok_s": round(total_useful / c_wall, 2),
+              "wall_s": round(c_wall, 3),
+              "occupancy": round(
+                  delta["live_slot_steps"]
+                  / float(max(1, delta["steps"]) * slots), 3),
+              "p50_s": round(float(np.percentile(c_lat, 50)), 3),
+              "p99_s": round(float(np.percentile(c_lat, 99)), 3),
+              "decode_steps": delta["steps"],
+              "horizon": horizon,
+              "parity_mismatches": mismatches,
+          },
+          "speedup": round((total_useful / c_wall)
+                           / max(1e-9, total_useful / s_wall), 2),
+      })
+  finally:
+    eng.stop()
+  rows.sort(key=lambda r: r["speedup"])
+  median = rows[len(rows) // 2]
+  median = dict(median, per_rep_speedups=[r["speedup"] for r in rows],
+                parity_ok=all(r["continuous"]["parity_mismatches"] == 0
+                              for r in rows))
+  return median
+
+
+def run_compare(args):
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  from tensorflowonspark_tpu.models import transformer as tfm
+
+  shape = _COMPARE_SMOKE if args.smoke else _COMPARE_FULL
+  if args.requests:
+    shape = dict(shape, requests=args.requests)
+  if args.slots:
+    shape = dict(shape, slots=args.slots)
+  cfg = tfm.TransformerConfig(
+      vocab_size=shape["vocab"], num_layers=shape["layers"],
+      num_heads=shape["heads"], d_model=shape["d_model"],
+      d_ff=shape["d_ff"], max_seq_len=shape["max_seq"], remat=False,
+      dtype=jnp.float32)   # f32: the bit-parity check must be exact
+  state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+  eos_id = 2               # whatever the random model emits; both modes
+  workload = make_workload(shape, args.seed)       # share the stop rule
+
+  useful = _reference_streams(state.params, cfg, workload, eos_id)
+  reps = args.reps if args.reps else (1 if args.smoke else 3)
+  median = measure_compare(state.params, cfg, workload, shape["slots"],
+                           eos_id, useful, shape["horizon"], reps)
+  result = {
+      "metric": "serving_continuous_vs_static_tokens_per_sec",
+      "mode": "smoke" if args.smoke else "full",
+      "seed": args.seed,
+      "reps": reps,
+      "workload": {
+          "requests": shape["requests"], "slots": shape["slots"],
+          "prompt_lens": list(shape["plens"]),
+          "budgets": list(shape["budgets"]),
+          "useful_tokens": int(sum(len(s) for s in useful)),
+      },
+      "model": {k: shape[k] for k in ("layers", "heads", "d_model",
+                                      "d_ff", "vocab", "max_seq")},
+      "static": median["static"],
+      "continuous": median["continuous"],
+      "speedup": median["speedup"],
+      "per_rep_speedups": median["per_rep_speedups"],
+      "parity_ok": median["parity_ok"],
+      "note": "same slot count, same seeded Zipf-ish mixed-length "
+              "workload; tokens/sec counts each request's useful tokens "
+              "(truncated at its own EOS/budget). static = the "
+              "fixed-shape make_serving_predict_fn loop: equal-length "
+              "batches, fixed num_steps = max budget, batch-at-a-time — "
+              "finished rows burn their remaining slot-steps as padding; "
+              "continuous = serving.ServingEngine refilling freed slots "
+              "mid-flight; engine outputs verified bit-identical to "
+              "per-request single decodes",
+  }
+  line = json.dumps(result)
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+  print(line)
+  return 0 if result["parity_ok"] else 3
+
+
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument("--batch", type=int, default=8)
@@ -107,7 +345,30 @@ def main():
                   help="comma list of config names to measure (default: "
                        "all) — one config per subprocess fits a short "
                        "claim window (tools/micro_capture.py)")
+  ap.add_argument("--compare", action="store_true",
+                  help="continuous (serving.ServingEngine) vs static "
+                       "batching on a seeded mixed-length workload")
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny --compare shapes for CI")
+  ap.add_argument("--requests", type=int, default=0,
+                  help="--compare workload size override")
+  ap.add_argument("--slots", type=int, default=0,
+                  help="--compare slot count override")
+  ap.add_argument("--seed", type=int, default=0)
+  ap.add_argument("--reps", type=int, default=0,
+                  help="--compare paired reps (default 3; smoke 1) — "
+                       "median-speedup rep reported")
+  ap.add_argument("--json-out", default=None,
+                  help="also write the --compare JSON line here")
   args = ap.parse_args()
+  if args.compare:
+    sys.exit(run_compare(args))
+  if args.smoke:
+    # the per-config modes take their MODEL shape from bench.py, which
+    # is fixed at import by TOS_BENCH_SMOKE — a flag can't shrink it
+    # retroactively, so refuse a misleading half-smoke
+    sys.exit("--smoke shrinks --compare; for the per-config decode "
+             "modes set TOS_BENCH_SMOKE=1 instead")
   if os.environ.get("TOS_BENCH_SMOKE"):
     args.batch, args.prompt, args.steps = 2, 16, 16
   wanted = (set(c.strip() for c in args.configs.split(",") if c.strip())
