@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test lint analyze check native bench serve-bench dryrun \
-	mosaic-gate validate clean chaos
+	mosaic-gate validate clean chaos obs-smoke
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -23,9 +23,19 @@ lint:
 analyze:
 	$(PY) -m tools.analyze --all
 
-# fast pre-commit gate: static analysis + style + the fast test subset
+# end-to-end observability-plane plumbing check: a 2-process LocalEngine
+# train+inference run with TOS_OBS=1, merged into one Chrome trace
+# (spans from driver + both executors on one aligned timeline). env
+# sanitized like `dryrun`: a multi-process drive must never claim the
+# remote TPU via the sitecustomize plugin
+obs-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/obs_report.py --smoke
+
+# fast pre-commit gate: static analysis + style + the fast test subset +
+# the obs plumbing smoke
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
-check: analyze
+check: analyze obs-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 

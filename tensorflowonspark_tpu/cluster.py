@@ -18,6 +18,7 @@ abstraction (Spark or the built-in LocalEngine) and re-targeted at JAX/TPU:
 """
 
 import collections.abc
+import contextlib
 import logging
 import os
 import random
@@ -29,6 +30,8 @@ from typing import Dict, List, Optional, Sequence
 from tensorflowonspark_tpu import node as node_mod
 from tensorflowonspark_tpu.control import feedhub, rendezvous
 from tensorflowonspark_tpu.engine.base import Engine, is_executor_lost
+from tensorflowonspark_tpu.obs import metrics as obs_metrics
+from tensorflowonspark_tpu.obs import spans as obs_spans
 
 logger = logging.getLogger(__name__)
 
@@ -91,6 +94,16 @@ class ClusterSupervisor(object):
     self._idle = threading.Event()
     self._idle.set()
     self._thread: Optional[threading.Thread] = None
+    # obs seam: recovery events mirror into driver-side counters
+    # (cluster.detected_dead / relaunched / recovered / gave_up /
+    # skipped_background) and each recovery records a span
+    self._obs_reg = obs_metrics.active()
+    self._obs_rec = obs_spans.active()
+
+  def _event(self, kind: str, **fields) -> None:
+    self.events.append(dict(fields, kind=kind, t=time.monotonic()))
+    if self._obs_reg is not None:
+      self._obs_reg.counter("cluster." + kind.replace("-", "_")).inc()
 
   # -- lifecycle -------------------------------------------------------------
 
@@ -147,7 +160,11 @@ class ClusterSupervisor(object):
           return
         self._idle.clear()
         try:
-          self._recover(eid)
+          if self._obs_rec is not None:
+            with self._obs_rec.span("cluster.recover", executor_id=eid):
+              self._recover(eid)
+          else:
+            self._recover(eid)
         except Exception:  # noqa: BLE001 - supervisor must survive anything
           logger.exception("recovery of executor %d failed", eid)
         finally:
@@ -157,8 +174,7 @@ class ClusterSupervisor(object):
 
   def _recover(self, eid: int) -> None:
     attempt = self._attempts.get(eid, 0)
-    self.events.append({"executor_id": eid, "kind": "detected-dead",
-                        "attempt": attempt, "t": time.monotonic()})
+    self._event("detected-dead", executor_id=eid, attempt=attempt)
     try:
       job_name, _ = node_mod._role_of(eid, self.cluster_meta["cluster_template"])
     except ValueError:
@@ -175,8 +191,7 @@ class ClusterSupervisor(object):
              "relaunched; failure will surface at shutdown)"
              % (job_name, eid))
       logger.error(msg)
-      self.events.append({"executor_id": eid, "kind": "skipped-background",
-                          "t": time.monotonic()})
+      self._event("skipped-background", executor_id=eid)
       if self.tf_status.get("error") is None:
         self.tf_status["error"] = msg
       return
@@ -186,8 +201,7 @@ class ClusterSupervisor(object):
              "restart budget (max_restarts=%d) exhausted"
              % (eid, attempt, self.max_restarts))
       logger.error(msg)
-      self.events.append({"executor_id": eid, "kind": "gave-up",
-                          "t": time.monotonic()})
+      self._event("gave-up", executor_id=eid)
       # the node task may have completed OK long ago (ENGINE mode: the
       # bring-up task returns before the background fn dies) — make sure
       # shutdown still raises
@@ -224,14 +238,12 @@ class ClusterSupervisor(object):
     self.engine.relaunch_task(self.node_job, task_id,
                               payload={"executor_id": eid,
                                        "restart": attempt + 1})
-    self.events.append({"executor_id": eid, "kind": "relaunched",
-                        "attempt": attempt + 1, "t": time.monotonic()})
+    self._event("relaunched", executor_id=eid, attempt=attempt + 1)
 
     reregistered = self._await_reregistration(eid, attempt + 1)
     if reregistered:
       self.restarts[eid] = attempt + 1
-      self.events.append({"executor_id": eid, "kind": "recovered",
-                          "t": time.monotonic()})
+      self._event("recovered", executor_id=eid)
     else:
       # liveness/ExecutorLost will re-fire and consume another attempt,
       # or the task error (a non-restartable bring-up failure) propagates
@@ -364,6 +376,19 @@ class TPUCluster(object):
     self.queues = cluster_meta["queues"]
     self.driver_ps_procs = list(driver_ps_procs)
     self.supervisor = supervisor
+    #: the driver-side obs aggregation (obs.collector.ObsSink) when the
+    #: obs plane is on (TOS_OBS=1) — executors ship metric/span deltas
+    #: here through the rendezvous OBS verb; None when off. getattr:
+    #: tests (and embedders) hand in stand-in servers without the field
+    self.obs_sink = getattr(server, "obs_sink", None)
+
+  @staticmethod
+  def _span(name: str, **attrs):
+    """Driver-side span, or a null context when the obs plane is off."""
+    rec = obs_spans.active()
+    if rec is None:
+      return contextlib.nullcontext()
+    return rec.span(name, **attrs)
 
   # -- data plane ------------------------------------------------------------
 
@@ -417,7 +442,8 @@ class TPUCluster(object):
         stream.count()
       return
     parts = self._replicate(parts, epochs)
-    self.engine.foreach_partition(parts, fn).wait()
+    with self._span("cluster.train_feed", epochs=epochs):
+      self.engine.foreach_partition(parts, fn).wait()
 
   def train_stream(self, batch_stream, feed_timeout: float = 600,
                    qname: str = "input") -> int:
@@ -539,7 +565,8 @@ class TPUCluster(object):
                                     feed_timeout=feed_timeout, qname=qname)
     data_partitions = self._wrap_lazy(data_partitions)
     if collect:
-      return self.engine.map_partitions(data_partitions, fn)
+      with self._span("cluster.inference_feed"):
+        return self.engine.map_partitions(data_partitions, fn)
     return self.engine.map_partitions_lazy(data_partitions, fn,
                                            timeout=feed_timeout)
 
@@ -558,11 +585,28 @@ class TPUCluster(object):
       old = signal.signal(signal.SIGALRM, _watchdog)
       signal.alarm(int(timeout))
     try:
-      self._shutdown_inner(grace_secs)
+      with self._span("cluster.shutdown"):
+        self._shutdown_inner(grace_secs)
     finally:
       if timeout and in_main:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+      # offline-log plane: the driver's own spans + metrics land in the
+      # same per-process JSONL scheme the executors use, so
+      # tools/obs_report.py merges one run from one directory
+      self._dump_driver_obs_log()
+
+  def _dump_driver_obs_log(self) -> None:
+    if not obs_metrics.enabled():
+      return
+    from tensorflowonspark_tpu.obs import export as obs_export
+    rec = obs_spans.active()
+    reg = obs_metrics.active()
+    log = obs_export.ProcessLog(label="driver", executor_id=0,
+                                clock=rec.clock if rec is not None else None)
+    if rec is not None:
+      log.append_spans(rec.drain(None))
+    log.close(metrics_snapshot=reg.snapshot() if reg is not None else None)
 
   def _shutdown_inner(self, grace_secs: float) -> None:
     workers = [n for n in self.cluster_info
@@ -777,6 +821,11 @@ def run(engine: Engine, main_fn, tf_args=None,
   server = rendezvous.Server(num_executors,
                              heartbeat_interval=heartbeat_interval,
                              startup_grace=reservation_timeout)
+  if obs_metrics.enabled():
+    # the driver end of the obs plane: executors ship metric/span deltas
+    # through the rendezvous OBS verb into this bounded sink
+    from tensorflowonspark_tpu.obs import collector as obs_collector
+    server.obs_sink = obs_collector.ObsSink()
   server_addr = server.start()
 
   cluster_meta = {
@@ -872,8 +921,9 @@ def run(engine: Engine, main_fn, tf_args=None,
         backoff_cap=restart_backoff_cap).start()
 
   try:
-    cluster_info.extend(server.await_reservations(
-        timeout=reservation_timeout, status=tf_status))
+    with TPUCluster._span("cluster.assemble", nodes=num_executors):
+      cluster_info.extend(server.await_reservations(
+          timeout=reservation_timeout, status=tf_status))
   except Exception:
     if supervisor is not None:
       supervisor.stop()

@@ -23,6 +23,14 @@ marks clean departure) and ``HEALTH`` (snapshot of the liveness table) —
 the reference had no liveness detection at all: a hung executor stalled the
 job until the 3-day shutdown watchdog fired (TFCluster.py:136-144).
 
+Observability rides the same wire: ``OBS`` ships bounded metric/span
+deltas from executors into the driver's ``Server.obs_sink``
+(``obs.collector.ObsSink``; without a sink the verb is acked and
+dropped), and ``BEAT``/``OBS`` replies carry ``server_time`` (the
+driver's monotonic clock) so clients can estimate their clock offset
+NTP-style and per-executor traces land on one timeline
+(``obs.spans.ClockOffset``).
+
 Env overrides (parity with reservation.py:25-26,190-206):
 ``TOS_TPU_SERVER_HOST`` pins the server bind/advertise host;
 ``TOS_TPU_SERVER_PORT`` pins the port, accepting either ``"9000"`` or a range
@@ -41,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 
 import msgpack
 
+from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.utils import chaos
 
 logger = logging.getLogger(__name__)
@@ -262,14 +271,22 @@ class HeartbeatSender(object):
   ``set_progress`` attaches an application-level progress value (e.g. the
   training step) to subsequent beats — surfaced via ``HEALTH`` for
   observability and future stall detection.
+
+  Each beat doubles as a TIME exchange: the reply's ``server_time``
+  (driver monotonic) plus the beat's local send/receive timestamps feed
+  ``clock`` — an :class:`obs.spans.ClockOffset` estimating this
+  process's offset to the driver's clock. The obs shipper shares this
+  estimator, so span timestamps anchor without extra round-trips.
   """
 
   def __init__(self, server_addr: Tuple[str, int], executor_id: int,
-               interval: float = 5.0, max_failures: int = 5):
+               interval: float = 5.0, max_failures: int = 5,
+               clock: Optional[obs_spans.ClockOffset] = None):
     self.server_addr = (server_addr[0], int(server_addr[1]))
     self.executor_id = executor_id
     self.interval = float(interval)
     self.max_failures = max_failures
+    self.clock = clock if clock is not None else obs_spans.ClockOffset()
     self._progress = None
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
@@ -301,7 +318,14 @@ class HeartbeatSender(object):
         msg["bye"] = True
       if self._progress is not None:
         msg["progress"] = self._progress
-      self._client._request(msg)
+      t0 = time.monotonic()
+      resp = self._client._request(msg)
+      t1 = time.monotonic()
+      if "server_time" in resp:
+        # NTP-style offset sample piggybacked on the beat round-trip;
+        # a chaos/load-delayed beat just yields a high-RTT sample the
+        # min-RTT estimator ignores
+        self.clock.update(t0, resp["server_time"], t1)
       self._failures = 0
       return True
     except Exception as e:  # noqa: BLE001 - the heartbeat thread must
@@ -378,6 +402,10 @@ class Server(MessageSocket):
     # stop signal retry against ECONNREFUSED for its whole reservation
     # timeout and fail the node (the train_stream shutdown flake).
     self.stop_requested = threading.Event()
+    #: driver-attached ``obs.collector.ObsSink`` consuming OBS deltas;
+    #: None (the default) acks-and-drops so the obs plane is never a
+    #: prerequisite for the control plane
+    self.obs_sink = None
     self._listener: Optional[socket.socket] = None
     self.addr: Optional[Tuple[str, int]] = None
     # round -> set of arrived task ids; sets make re-sent arrivals (client
@@ -506,7 +534,23 @@ class Server(MessageSocket):
     elif mtype == "BEAT":
       self.liveness.beat(msg["executor_id"], departing=msg.get("bye", False),
                          progress=msg.get("progress"))
-      self.send(sock, {"type": "OK"})
+      # server_time turns every beat into a TIME exchange (clock-offset
+      # estimation for the obs plane — see HeartbeatSender.clock)
+      self.send(sock, {"type": "OK", "server_time": time.monotonic()})
+    elif mtype == "OBS":
+      sink = self.obs_sink
+      accepted = False
+      if sink is not None:
+        # ingest is bounded and swallows its own malformed-payload cases;
+        # a sink bug must not kill the serve loop, so the failure is
+        # reported to the SENDER (accepted=False) instead of raised here
+        try:
+          accepted = bool(sink.ingest(msg))
+        except Exception as e:  # noqa: BLE001 - reported via accepted flag
+          accepted = False
+          logger.warning("obs sink rejected a delta: %s", e)
+      self.send(sock, {"type": "OK", "accepted": accepted,
+                       "server_time": time.monotonic()})
     elif mtype == "HEALTH":
       snap = {str(k): v for k, v in self.liveness.snapshot().items()}
       self.send(sock, {"type": "HEALTH", "data": snap})

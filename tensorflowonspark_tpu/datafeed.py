@@ -38,6 +38,8 @@ from typing import Dict, List, Optional, Sequence
 
 from tensorflowonspark_tpu.control import chunkcodec
 from tensorflowonspark_tpu.control.marker import EndPartition, Marker
+from tensorflowonspark_tpu.obs import metrics as obs_metrics
+from tensorflowonspark_tpu.obs import spans as obs_spans
 
 logger = logging.getLogger(__name__)
 
@@ -197,9 +199,46 @@ class DataFeed(object):
     self._pipeline_depth = max(0, pipeline_depth)
     self._pipeline: Optional[_FetchPipeline] = None
     #: per-stage accounting (seconds / counts), filled on the hot path —
-    #: tools/feed_bench.py reads this for its breakdown
+    #: tools/feed_bench.py reads this for its breakdown (snapshot it with
+    #: :meth:`stats_snapshot`, never by zeroing: the fetch thread keeps
+    #: read-modify-writing these entries)
     self.stats = {"fetch_s": 0.0, "decode_s": 0.0, "assemble_s": 0.0,
                   "chunks": 0, "columnar_chunks": 0}
+    # obs seam (docs/OBSERVABILITY.md): cached once so the disabled case
+    # is one None check per batch
+    self._rec = obs_spans.active()
+    reg = obs_metrics.active()
+    self._obs_m = None if reg is None else {
+        "batches": reg.counter("feed.batches"),
+        "rows": reg.counter("feed.rows"),
+        "fetch_s": reg.gauge("feed.fetch_s"),
+        "decode_s": reg.gauge("feed.decode_s"),
+        "assemble_s": reg.gauge("feed.assemble_s"),
+        "chunks": reg.gauge("feed.chunks"),
+        "batch_ms": reg.histogram("feed.batch_ms"),
+    }
+
+  def stats_snapshot(self) -> obs_metrics.StatsSnapshot:
+    """Subtraction baseline over the LIVE ``stats`` dict — the one safe
+    way to read steady-state stage deltas while the fetch thread keeps
+    mutating them (obs.metrics.StatsSnapshot)."""
+    return obs_metrics.snapshot_stats(self.stats)
+
+  def _obs_batch(self, t0: float, n: int) -> None:
+    """Record one delivered batch into the obs plane (active only)."""
+    dt = time.monotonic() - t0
+    if self._rec is not None:
+      self._rec.record_span("feed.batch", t0, dt, rows=n)
+    m = self._obs_m
+    if m is not None:
+      m["batches"].inc()
+      if n:
+        m["rows"].inc(n)
+      m["batch_ms"].observe(dt * 1e3)
+      m["fetch_s"].set(self.stats["fetch_s"])
+      m["decode_s"].set(self.stats["decode_s"])
+      m["assemble_s"].set(self.stats["assemble_s"])
+      m["chunks"].set(self.stats["chunks"])
 
   # -- fetch plane -----------------------------------------------------------
 
@@ -444,6 +483,18 @@ class DataFeed(object):
     from the error queue) instead of blocking forever when the producer
     side has died; see ``liveness_timeout``.
     """
+    if self._rec is None and self._obs_m is None:
+      return self._next_batch_impl(batch_size)
+    t0 = time.monotonic()
+    out = self._next_batch_impl(batch_size)
+    if isinstance(out, dict):
+      n = len(next(iter(out.values()))) if out else 0
+    else:
+      n = len(out)
+    self._obs_batch(t0, n)
+    return out
+
+  def _next_batch_impl(self, batch_size: int):
     if self.input_tensors is not None:
       cols = self._assemble_columns(batch_size)
       if cols is not None:
@@ -546,12 +597,18 @@ class DataFeed(object):
     input_mapping return one array; with a mapping, a dict of arrays).
     Row/heterogeneous chunks fall back to the historical stack."""
     import numpy as np
+    obs_on = self._rec is not None or self._obs_m is not None
+    t0 = time.monotonic() if obs_on else 0.0
     cols = self._assemble_columns(
         batch_size, dtype=dtype, require_single=self.input_tensors is None)
     if cols is not None:
+      if obs_on:
+        self._obs_batch(t0, len(cols[0]))
       if self.input_tensors is None:
         return cols[0]
       return dict(zip(self.input_tensors, cols))
+    # the row fallback delegates to next_batch, which records its own
+    # obs batch — no double counting
     batch = self.next_batch(batch_size)
     if isinstance(batch, dict):
       return {k: np.asarray(v, dtype=dtype) for k, v in batch.items()}

@@ -248,6 +248,27 @@ def _spawn_tensorboard(log_dir: str) -> Optional[dict]:
   return {"pid": proc.pid, "url": url}
 
 
+def _start_obs_shipper(server_addr, executor_id: int, sender):
+  """Executor-side obs plane bring-up (None when ``TOS_OBS`` is off).
+
+  The shipper shares the HeartbeatSender's clock estimator — the BEAT
+  round-trip is the TIME exchange — so span timestamps anchor to the
+  driver's monotonic clock without extra control-plane traffic; the
+  process recorder adopts the same estimator for its JSONL exports.
+  """
+  from tensorflowonspark_tpu.obs import metrics as obs_metrics
+  if not (obs_metrics.enabled() and server_addr):
+    return None
+  from tensorflowonspark_tpu.obs import collector as obs_collector
+  from tensorflowonspark_tpu.obs import spans as obs_spans
+  clock = sender.clock if sender is not None else None
+  rec = obs_spans.active()
+  if rec is not None and clock is not None:
+    rec.clock = clock
+  return obs_collector.ObsShipper(tuple(server_addr), executor_id,
+                                  clock=clock, label="exec").start()
+
+
 def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
                        hub_addr, authkey: bytes, server_addr=None,
                        heartbeat_interval=None):
@@ -267,6 +288,8 @@ def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
     sender = rendezvous.HeartbeatSender(
         tuple(server_addr), ctx_kwargs["executor_id"],
         interval=heartbeat_interval).start()
+  shipper = _start_obs_shipper(server_addr, ctx_kwargs["executor_id"],
+                               sender)
   ctx = TPUNodeContext(hub=hub, heartbeat=sender, **ctx_kwargs)
   try:
     fn = cloudpickle.loads(fn_bytes)
@@ -285,7 +308,9 @@ def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
         # executor's inherited stderr is the last channel that still works
         os.write(2, ("background main fn failed:\n%s" % tb).encode())
   finally:
-    if sender is not None:
+    if shipper is not None:
+      shipper.stop()           # final delta flush + JSONL close first,
+    if sender is not None:     # so the driver hears it before the bye
       sender.stop()
     try:
       hub.set("state", "stopped")
@@ -527,6 +552,7 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
         sender = rendezvous.HeartbeatSender(
             tuple(meta["server_addr"]), executor_id,
             interval=hb_interval).start()
+      shipper = _start_obs_shipper(meta["server_addr"], executor_id, sender)
       ctx = TPUNodeContext(hub=hub, tmp_socket=tmp_sock, heartbeat=sender,
                            **ctx_kwargs)
       try:
@@ -541,6 +567,8 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
           pass
         raise
       finally:
+        if shipper is not None:
+          shipper.stop()
         if sender is not None:
           sender.stop()
       return [executor_id]

@@ -1,0 +1,27 @@
+"""Cluster-wide observability plane.
+
+Four modules, one measurement story:
+
+- ``metrics``   — process-local counters/gauges/fixed-bucket histograms
+                  (lock-cheap hot path), snapshot/delta arithmetic, and
+                  the live-stats snapshot-subtract helper the benches use
+- ``spans``     — monotonic-clock span/event recording (bounded, never
+                  blocking) + driver-anchored clock-offset estimation
+                  piggybacked on rendezvous round-trips
+- ``collector`` — executor-side delta shipper (the rendezvous ``OBS``
+                  verb) and the driver-side ``ObsSink`` aggregation
+- ``export``    — per-process JSONL event logs, Prometheus text
+                  exposition, merged Chrome-trace (Perfetto) JSON
+- ``profiler``  — JAX trace plumbing, ``StepTimer`` (feeds the registry)
+                  and MFU accounting, moved from ``utils/profiler.py``
+
+Everything is off (and near-free: one cached None check per seam) until
+``TOS_OBS=1``. See docs/OBSERVABILITY.md for the metric catalogue, span
+naming convention and overhead budget.
+
+NOTE: only the dependency-free core (``metrics``, ``spans``) is imported
+here — ``collector`` reaches into the rendezvous control plane, which
+itself imports ``obs.spans``, so eager re-export would cycle.
+"""
+
+from tensorflowonspark_tpu.obs import metrics, spans  # noqa: F401

@@ -1,0 +1,240 @@
+"""Export plane: per-process JSONL logs, Prometheus text, Chrome traces.
+
+Three consumers, three formats:
+
+- **JSONL event logs** (``TOS_OBS_DIR``): every obs-enabled process
+  appends its spans (plus a meta header, its final clock offset and a
+  final metrics snapshot) to ``obs-<label><id>-<pid>.jsonl``. Crash-safe
+  by construction: each line is self-contained, a truncated tail loses
+  only the last line.
+- **Prometheus text exposition** (:func:`prometheus_text`): the registry
+  snapshot (or the driver sink's per-executor totals) rendered in the
+  standard ``# TYPE`` format for scrape endpoints / file-based collection.
+- **Chrome trace JSON** (:func:`chrome_trace`): the merged per-node spans
+  as a ``traceEvents`` array loadable in Perfetto / chrome://tracing,
+  one process track per JSONL log, timestamps driver-anchored via each
+  process's estimated clock offset (``obs.spans.ClockOffset``).
+
+``tools/obs_report.py`` is the CLI over :func:`merge_jsonl` +
+:func:`chrome_trace`.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: directory for per-process JSONL event logs; unset disables the offline
+#: log plane (env registry: TOS008)
+ENV_OBS_DIR = "TOS_OBS_DIR"
+
+
+def log_dir() -> Optional[str]:
+  return os.environ.get(ENV_OBS_DIR) or None
+
+
+class ProcessLog(object):
+  """Append-only JSONL log for one process (no-op when no dir is set).
+
+  Files are opened per append batch (open/write/close under ``with``):
+  the log must survive SIGKILL mid-run with everything already appended,
+  and a held-open fd in a long-lived executor is a leak class (TOS006).
+  """
+
+  def __init__(self, directory: Optional[str] = None, label: str = "proc",
+               executor_id: int = 0, clock=None):
+    self.directory = directory if directory is not None else log_dir()
+    self.label = label
+    self.executor_id = int(executor_id)
+    self.clock = clock
+    self.path = None
+    if self.directory:
+      self.path = os.path.join(
+          self.directory,
+          "obs-%s%d-%d.jsonl" % (label, self.executor_id, os.getpid()))
+    self._lock = threading.Lock()
+    self._meta_written = False
+
+  def _append(self, records: List[dict]) -> None:
+    if self.path is None or not records:
+      return
+    with self._lock:
+      lines = []
+      if not self._meta_written:
+        self._meta_written = True
+        lines.append(json.dumps({
+            "kind": "meta", "label": self.label,
+            "executor_id": self.executor_id, "pid": os.getpid(),
+            "t_wall": time.time(), "t_mono": time.monotonic()}))
+      lines.extend(json.dumps(r) for r in records)
+      try:
+        os.makedirs(self.directory, exist_ok=True)
+        with open(self.path, "a") as f:
+          f.write("\n".join(lines) + "\n")
+      except OSError:
+        # an unwritable obs dir must not take down the process being
+        # observed; the merged report will simply miss this log
+        self.path = None
+
+  def append_spans(self, spans: List[dict]) -> None:
+    self._append([dict(rec, kind="span") for rec in spans])
+
+  def close(self, metrics_snapshot: Optional[dict] = None) -> None:
+    """Stamp the final clock offset + metrics snapshot (merge anchors on
+    the LAST clock line — the best estimate the process ever had)."""
+    tail: List[dict] = []
+    if self.clock is not None:
+      tail.append(dict(self.clock.snapshot(), kind="clock"))
+    if metrics_snapshot is not None:
+      tail.append({"kind": "metrics", "data": metrics_snapshot})
+    if not tail and not self._meta_written:
+      return   # nothing was ever logged; leave no empty file behind
+    self._append(tail)
+
+
+# -- merge + chrome trace -----------------------------------------------------
+
+
+def find_logs(directory: str) -> List[str]:
+  return sorted(glob.glob(os.path.join(directory, "obs-*.jsonl")))
+
+
+def merge_jsonl(paths: List[str]) -> List[dict]:
+  """Parse per-process logs into proc dicts:
+  ``{"path", "meta", "spans", "metrics", "clock"}`` (malformed lines are
+  skipped and counted in ``"skipped"``)."""
+  procs = []
+  for path in paths:
+    proc = {"path": path, "meta": {}, "spans": [], "metrics": {},
+            "clock": {}, "skipped": 0}
+    try:
+      with open(path) as f:
+        lines = f.read().splitlines()
+    except OSError as e:
+      # unreadable log: surfaced in the report (never raised — a partial
+      # merge beats no merge), counted so the gap is visible
+      proc["error"] = str(e)
+      procs.append(proc)
+      continue
+    for line in lines:
+      if not line.strip():
+        continue
+      try:
+        rec = json.loads(line)
+        kind = rec.get("kind")
+      except (ValueError, AttributeError):
+        proc["skipped"] += 1
+        continue
+      if kind == "meta":
+        proc["meta"] = rec
+      elif kind == "span":
+        proc["spans"].append(rec)
+      elif kind == "clock":
+        proc["clock"] = rec   # last one wins: the final (best) estimate
+      elif kind == "metrics":
+        proc["metrics"] = rec.get("data") or {}
+      else:
+        proc["skipped"] += 1
+    procs.append(proc)
+  return procs
+
+
+def anchored_window(proc: dict) -> Optional[tuple]:
+  """(first_start, last_end) of a proc's spans on the DRIVER timeline."""
+  offset = float(proc.get("clock", {}).get("offset") or 0.0)
+  spans = proc.get("spans") or []
+  if not spans:
+    return None
+  starts = [s["t0"] + offset for s in spans]
+  ends = [s["t0"] + s.get("dur", 0.0) + offset for s in spans]
+  return min(starts), max(ends)
+
+
+def chrome_trace(procs: List[dict]) -> dict:
+  """Perfetto/chrome://tracing JSON from merged proc logs.
+
+  One trace "process" per log (pid = the real pid, disambiguated on
+  collision), timestamps anchored with each proc's clock offset so every
+  track shares the driver's monotonic timeline.
+  """
+  events = []
+  used_pids = set()
+  for proc in procs:
+    meta = proc.get("meta") or {}
+    pid = int(meta.get("pid") or 0)
+    while pid in used_pids:
+      pid += 1000000   # same-pid logs (a respawn reusing a pid) split
+    used_pids.add(pid)
+    label = "%s%s" % (meta.get("label", "proc"),
+                      meta.get("executor_id", ""))
+    offset = float(proc.get("clock", {}).get("offset") or 0.0)
+    events.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                   "args": {"name": label}})
+    tids: Dict[str, int] = {}
+    for rec in proc.get("spans") or []:
+      tname = rec.get("tid") or "main"
+      tid = tids.get(tname)
+      if tid is None:
+        tid = tids[tname] = len(tids) + 1
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": tname}})
+      ts_us = (rec["t0"] + offset) * 1e6
+      ev = {"name": rec.get("name", "?"), "pid": pid, "tid": tid,
+            "ts": ts_us, "cat": rec.get("name", "?").split(".")[0]}
+      if rec.get("ph") == "i":
+        ev["ph"] = "i"
+        ev["s"] = "t"
+      else:
+        ev["ph"] = "X"
+        ev["dur"] = rec.get("dur", 0.0) * 1e6
+      if rec.get("attrs"):
+        ev["args"] = rec["attrs"]
+      events.append(ev)
+  return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- prometheus text ----------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+  out = []
+  for ch in name:
+    out.append(ch if ch.isalnum() or ch == "_" else "_")
+  base = "".join(out)
+  return base if base.startswith("tos_") else "tos_" + base
+
+
+def _prom_labels(labels: Optional[Dict[str, str]], extra: str = "") -> str:
+  parts = ['%s="%s"' % (k, v) for k, v in sorted((labels or {}).items())]
+  if extra:
+    parts.append(extra)
+  return "{%s}" % ",".join(parts) if parts else ""
+
+
+def prometheus_text(snapshot: Dict[str, dict],
+                    labels: Optional[Dict[str, str]] = None) -> str:
+  """Render a registry snapshot in Prometheus text exposition format."""
+  lines: List[str] = []
+  for name in sorted(snapshot):
+    m = snapshot[name]
+    pname = _prom_name(name)
+    kind = m.get("type")
+    if kind in ("counter", "gauge"):
+      lines.append("# TYPE %s %s" % (pname, kind))
+      lines.append("%s%s %s" % (pname, _prom_labels(labels), m["value"]))
+    elif kind == "histogram":
+      lines.append("# TYPE %s histogram" % pname)
+      cum = 0
+      for bound, cnt in zip(m["bounds"], m["counts"]):
+        cum += cnt
+        lines.append("%s_bucket%s %d" % (
+            pname, _prom_labels(labels, 'le="%g"' % bound), cum))
+      cum += m["counts"][-1]
+      lines.append("%s_bucket%s %d" % (
+          pname, _prom_labels(labels, 'le="+Inf"'), cum))
+      lines.append("%s_sum%s %s" % (pname, _prom_labels(labels), m["sum"]))
+      lines.append("%s_count%s %d" % (pname, _prom_labels(labels),
+                                      m["count"]))
+  return "\n".join(lines) + ("\n" if lines else "")

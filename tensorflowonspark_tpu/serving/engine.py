@@ -31,6 +31,7 @@ All waits are timeout-bounded (TOS001) and the loop thread is a daemon
 ``TOS_SERVE_SLOTS``, ``TOS_SERVE_BUCKETS``, ``TOS_SERVE_POLL``.
 """
 
+import contextlib
 import logging
 import os
 import queue as std_queue
@@ -40,6 +41,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from tensorflowonspark_tpu.obs import metrics as obs_metrics
+from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.serving import scheduler as sched
 from tensorflowonspark_tpu.serving import slots as slots_lib
 
@@ -103,6 +106,26 @@ class ServingEngine(object):
     self._loop_error: Optional[BaseException] = None
     self.stats = {"steps": 0, "live_slot_steps": 0, "emitted_tokens": 0,
                   "prefills": 0, "completed": 0}
+    # obs seam (docs/OBSERVABILITY.md): cached handles; disabled = one
+    # None check per decode dispatch
+    self._rec = obs_spans.active()
+    reg = obs_metrics.active()
+    self._obs_m = None if reg is None else {
+        "tokens": reg.counter("serve.tokens"),
+        "completed": reg.counter("serve.completed"),
+        "prefills": reg.counter("serve.prefills"),
+        "steps": reg.counter("serve.steps"),
+        "occupancy": reg.gauge("serve.occupancy"),
+        "queue_depth": reg.gauge("serve.queue_depth"),
+        "slots_active": reg.gauge("serve.slots_active"),
+        "decode_ms": reg.histogram("serve.decode_ms"),
+    }
+
+  def stats_snapshot(self) -> obs_metrics.StatsSnapshot:
+    """Subtraction baseline over the LIVE ``stats`` dict — the safe way
+    to read per-pass deltas while the loop thread keeps mutating it
+    (obs.metrics.StatsSnapshot; serve_bench uses this)."""
+    return obs_metrics.snapshot_stats(self.stats)
 
   # -- lifecycle ------------------------------------------------------------
 
@@ -288,9 +311,15 @@ class ServingEngine(object):
       if req is None:
         return
       req.started_at = time.monotonic()
-      row_cache, first = self.decoder.prefill(self.params, req.prompt,
-                                              self.buckets)
+      cm = self._rec.span("serve.prefill", rid=req.rid,
+                          prompt_len=len(req.prompt), slot=slot) \
+          if self._rec is not None else contextlib.nullcontext()
+      with cm:
+        row_cache, first = self.decoder.prefill(self.params, req.prompt,
+                                                self.buckets)
       self.stats["prefills"] += 1
+      if self._obs_m is not None:
+        self._obs_m["prefills"].inc()
       req.emit(first)
       self.stats["emitted_tokens"] += 1
       if self._finished(req, first):
@@ -308,6 +337,8 @@ class ServingEngine(object):
 
   def _complete(self, req: sched.Request) -> None:
     self.stats["completed"] += 1
+    if self._obs_m is not None:
+      self._obs_m["completed"].inc()
     req.finish(None)
 
   def _decode_once(self) -> None:
@@ -318,6 +349,9 @@ class ServingEngine(object):
     num_slots]`` token matrix, so the two views cannot diverge. A lane
     that stops mid-horizon idles (frozen) for the remaining scan steps —
     the bounded price of amortizing dispatch over the horizon."""
+    obs_on = self._rec is not None or self._obs_m is not None
+    t0 = time.monotonic() if obs_on else 0.0
+    tokens_before = self.stats["emitted_tokens"]
     active = np.asarray([r is not None for r in self._slots], bool)
     remaining = np.asarray(
         [0 if r is None else r.max_new_tokens - len(r.tokens)
@@ -344,3 +378,18 @@ class ServingEngine(object):
           break
       else:
         self._last[slot] = int(toks[self.horizon - 1, slot])
+    if obs_on:
+      dt = time.monotonic() - t0
+      live = sum(1 for r in self._slots if r is not None)
+      if self._rec is not None:
+        self._rec.record_span("serve.decode", t0, dt,
+                              horizon=self.horizon,
+                              active=int(active.sum()))
+      m = self._obs_m
+      if m is not None:
+        m["steps"].inc(self.horizon)
+        m["tokens"].inc(self.stats["emitted_tokens"] - tokens_before)
+        m["decode_ms"].observe(dt * 1e3)
+        m["occupancy"].set(self.occupancy)
+        m["queue_depth"].set(len(self._queue))
+        m["slots_active"].set(live)

@@ -1,150 +1,27 @@
-"""Profiling/tracing helpers: the JAX-native TensorBoard story.
+"""Deprecated shim: the profiler moved into the observability plane.
 
-The reference's only tracing facility was launching TensorBoard as a
-subprocess on chief/worker:0 (reference TFSparkNode.py:292-329 — that part
-lives in node.py here). This module adds what TPU users actually profile
-with: the JAX profiler — a programmatic trace context writing XProf/
-perfetto data TensorBoard can render, and an on-demand capture server.
+``utils/profiler.py`` grew into the measurement plane's training-loop
+seam (StepTimer feeds the metrics registry) and now lives at
+``tensorflowonspark_tpu.obs.profiler``. This module re-exports the full
+old surface so existing imports keep working; new code should import
+from ``obs.profiler`` (or use the higher-level ``obs`` plane directly).
 """
 
-import contextlib
-import logging
-import os
-from typing import Optional
+import warnings
 
-logger = logging.getLogger(__name__)
+from tensorflowonspark_tpu.obs.profiler import (  # noqa: F401
+    PEAK_BF16_FLOPS,
+    StepTimer,
+    annotate,
+    device_memory_stats,
+    mfu,
+    resolve_chip_generation,
+    start_server,
+    trace,
+    transformer_flops_per_token,
+)
 
-_server = None
-
-
-def start_server(port: int = 9999):
-  """Start the JAX profiler capture server (connect with TensorBoard's
-  profile tab or `xprof`); idempotent per process."""
-  global _server
-  if _server is None:
-    import jax
-    _server = jax.profiler.start_server(port)
-    logger.info("JAX profiler server listening on port %d", port)
-  return _server
-
-
-@contextlib.contextmanager
-def trace(log_dir: str, host_tracer_level: int = 2):
-  """Trace a region into ``log_dir`` (viewable in TensorBoard).
-
-  Usage::
-
-      with profiler.trace("/tmp/tb"):
-          state, loss = train_step(state, batch)
-          jax.block_until_ready(loss)
-  """
-  import jax
-  os.makedirs(log_dir, exist_ok=True)
-  with jax.profiler.trace(log_dir):
-    yield
-  logger.info("profile trace written to %s", log_dir)
-
-
-def annotate(name: str):
-  """Named region annotation for traces (shows up on the timeline)."""
-  import jax
-  return jax.profiler.TraceAnnotation(name)
-
-
-# --- step timing / throughput ------------------------------------------------
-
-
-class StepTimer(object):
-  """Wall-clock step statistics with warmup exclusion.
-
-  Usage::
-
-      timer = StepTimer(warmup=2)
-      for batch in data:
-          with timer.step(items=batch_size):
-              state, loss = train_step(state, batch)
-              jax.block_until_ready(loss)
-      print(timer.summary())   # {steps, mean_ms, p50_ms, p90_ms, items/s}
-
-  The context manager blocks on nothing itself — callers must
-  ``block_until_ready`` inside the region or the async dispatch makes every
-  step look instant.
-  """
-
-  def __init__(self, warmup: int = 2):
-    self.warmup = warmup
-    self._durations = []
-    self._items = []
-    self._seen = 0
-
-  @contextlib.contextmanager
-  def step(self, items: int = 0):
-    import time
-    t0 = time.perf_counter()
-    yield
-    dt = time.perf_counter() - t0
-    self._seen += 1
-    if self._seen > self.warmup:
-      self._durations.append(dt)
-      self._items.append(items)
-
-  def summary(self) -> dict:
-    d = sorted(self._durations)
-    if not d:
-      return {"steps": 0}
-    total = sum(self._durations)
-    out = {
-        "steps": len(d),
-        "mean_ms": 1e3 * total / len(d),
-        "p50_ms": 1e3 * d[len(d) // 2],
-        "p90_ms": 1e3 * d[min(len(d) - 1, int(len(d) * 0.9))],
-    }
-    if any(self._items):
-      out["items_per_sec"] = sum(self._items) / total
-    return out
-
-
-# --- MFU accounting ----------------------------------------------------------
-
-# bf16 peak FLOP/s per chip by TPU generation (public spec sheets)
-PEAK_BF16_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
-
-
-def resolve_chip_generation(hint: str = "") -> Optional[str]:
-  """Map a generation hint / device_kind string to a PEAK_BF16_FLOPS key."""
-  text = (hint or "").lower()
-  for alias, g in (("v5 lite", "v5e"), ("v5lite", "v5e"), ("v6 lite", "v6e"),
-                   ("v6lite", "v6e")):
-    if alias in text:
-      return g
-  # longest key first so "v5p" isn't shadowed by a hypothetical "v5"
-  for g in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
-    if g in text:
-      return g
-  return None
-
-
-def transformer_flops_per_token(n_params: int, num_layers: int,
-                                d_model: int, seq_len: int) -> float:
-  """Training FLOPs/token, PaLM-style accounting: ``6N`` for the fwd+bwd
-  matmuls plus the attention term ``12·L·d_model·S``."""
-  return 6.0 * n_params + 12.0 * num_layers * d_model * seq_len
-
-
-def mfu(flops_per_item: float, items_per_sec: float,
-        peak_flops: float) -> float:
-  """Model FLOPs utilization against one chip's peak."""
-  return flops_per_item * items_per_sec / peak_flops
-
-
-def device_memory_stats() -> dict:
-  """Per-device memory stats (bytes) where the backend reports them."""
-  import jax
-  out = {}
-  for d in jax.devices():
-    stats = getattr(d, "memory_stats", lambda: None)()
-    if stats:
-      out[str(d.id)] = {k: stats[k] for k in
-                        ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
-                        if k in stats}
-  return out
+warnings.warn(
+    "tensorflowonspark_tpu.utils.profiler moved to "
+    "tensorflowonspark_tpu.obs.profiler; this shim will be removed",
+    DeprecationWarning, stacklevel=2)

@@ -152,6 +152,34 @@ class TestServerClient:
       s.stop()
     assert s.done.is_set() and s.stopping()
 
+  def test_health_snapshot_payload_shape(self):
+    """The HEALTH verb's wire contract: msgpack STRING executor keys,
+    each entry exactly {state, age, progress} — the shape the driver's
+    supervisor/observability consumers parse."""
+    s = Server(2, heartbeat_interval=0.5)
+    addr = s.start()
+    try:
+      c = Client(addr)
+      c.register(_meta(0))
+      c._request({"type": "BEAT", "executor_id": 0, "progress": 7})
+      resp = c._request({"type": "HEALTH"})
+      assert resp["type"] == "HEALTH"
+      snap = resp["data"]
+      assert set(snap) == {"0"}            # string keys survive msgpack
+      entry = snap["0"]
+      assert set(entry) == {"state", "age", "progress"}
+      assert entry["state"] == "live"
+      assert entry["age"] >= 0.0
+      assert entry["progress"] == 7
+      # a departing beat flips the state, progress persists
+      c._request({"type": "BEAT", "executor_id": 0, "bye": True})
+      snap = c._request({"type": "HEALTH"})["data"]
+      assert snap["0"]["state"] == "departed"
+      assert snap["0"]["progress"] == 7
+      c.close()
+    finally:
+      s.stop()
+
   def test_concurrent_clients(self):
     n = 8
     s = Server(n)

@@ -91,6 +91,10 @@ EXTRA_ROOT_PATTERNS = [
     "*.control.shmring.RingQueueAdapter.*",
     "*.control.shmring.ShmRing.*",
     "*.utils.chaos.*",
+    # the observability plane runs inside executors (shipper thread, the
+    # registry/tracer seams in user main fns) — analyze all of it as
+    # executor-reachable
+    "*.obs.*",
 ]
 
 

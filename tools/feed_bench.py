@@ -252,8 +252,9 @@ def run_transport(transport, steps, batch, chunk, mode="columnar"):
       # stages report STEADY STATE: snapshot the warmup batch's totals
       # (jit-compile window + feeder startup wait) and subtract at report
       # time — the live fetch thread keeps accumulating into feed.stats,
-      # so zeroing the dict here would race with its read-modify-writes
-      base = dict(feed.stats)
+      # so zeroing the dict here would race with its read-modify-writes.
+      # One shared snapshot-subtract implementation: obs.metrics
+      snap = feed.stats_snapshot()
       base_host = host_s[0]
 
       done = 1
@@ -265,20 +266,19 @@ def run_transport(transport, steps, batch, chunk, mode="columnar"):
         if done >= steps:
           break
       dt = time.perf_counter() - t0
+      d = snap.delta()
       stages = {
           # transport wait + RPC (overlapped when the fetch pipeline is on)
-          "fetch_s": round(feed.stats["fetch_s"] - base["fetch_s"], 4),
-          "decode_s": round(feed.stats["decode_s"] - base["decode_s"], 4),
-          "assemble_s": round(feed.stats["assemble_s"]
-                              - base["assemble_s"], 4),
+          "fetch_s": round(d["fetch_s"], 4),
+          "decode_s": round(d["decode_s"], 4),
+          "assemble_s": round(d["assemble_s"], 4),
           # consumer-visible host-batch time (what the step loop waits on,
           # INCLUDING any un-hidden pipeline wait) — steady state only
           "host_batch_s": round(host_s[0] - base_host, 4),
           "wall_s": round(dt, 4),
           "batches": done - 1,
-          "columnar_chunks": feed.stats["columnar_chunks"]
-          - base["columnar_chunks"],
-          "chunks": feed.stats["chunks"] - base["chunks"],
+          "columnar_chunks": d["columnar_chunks"],
+          "chunks": d["chunks"],
       }
       return (done - 1) / dt, stages, None
     finally:

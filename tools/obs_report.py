@@ -1,0 +1,237 @@
+"""Merge per-node obs JSONL logs into one Chrome trace + summary table.
+
+Every obs-enabled process (driver and executors, ``TOS_OBS=1`` +
+``TOS_OBS_DIR``) appends spans, its final clock-offset estimate and a
+final metrics snapshot to its own ``obs-<label><id>-<pid>.jsonl``. This
+tool merges a directory of those logs into:
+
+- a Chrome-trace JSON (``--trace``) loadable in Perfetto /
+  chrome://tracing: one process track per log, timestamps anchored onto
+  the DRIVER's monotonic clock via each process's estimated offset
+  (``obs.spans.ClockOffset``, fed by the BEAT/OBS TIME exchange);
+- a Prometheus text file (``--prom``) of the per-process final metric
+  snapshots;
+- a summary table (stderr) + ONE JSON line (stdout, repo bench
+  convention).
+
+``--smoke`` is the end-to-end plumbing check (tier-1-covered): it drives
+a REAL 2-process LocalEngine cluster through a train feed round and an
+inference round with the obs plane on, then merges the logs and asserts
+that spans from the driver AND both executors landed on one aligned
+timeline.
+
+Usage:  python tools/obs_report.py DIR [--trace out.json] [--prom out.prom]
+        python tools/obs_report.py --smoke [--keep DIR]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: driver-window slack for the alignment check (seconds): executor spans
+#: must land inside the driver's first-to-last-span window plus this
+_ALIGN_MARGIN = 2.0
+
+
+# --- smoke main fns (top level: they cross the engine pickle boundary) -------
+
+
+def _smoke_train_main(args, ctx):
+  from tensorflowonspark_tpu.obs.profiler import StepTimer
+  feed = ctx.get_data_feed(train_mode=True)
+  timer = StepTimer(warmup=1)
+  total = 0
+  step = 0
+  while not feed.should_stop():
+    batch = feed.next_batch(32)
+    if not batch:
+      continue
+    with timer.step(items=len(batch)):
+      total += sum(x * x for x in batch)
+    step += 1
+    ctx.report_progress(step)
+  with open("obs_smoke_train.txt", "w") as f:
+    f.write("%d %d" % (step, total))
+
+
+def _smoke_infer_main(args, ctx):
+  feed = ctx.get_data_feed(train_mode=False)
+  while not feed.should_stop():
+    batch = feed.next_batch(32)
+    if batch:
+      feed.batch_results([x * x for x in batch])
+
+
+# --- merge + report ----------------------------------------------------------
+
+
+def build_report(obs_dir, trace_path=None, prom_path=None):
+  """Merge ``obs_dir``'s logs; returns (result dict, procs)."""
+  from tensorflowonspark_tpu.obs import export
+
+  paths = export.find_logs(obs_dir)
+  procs = export.merge_jsonl(paths)
+  trace = export.chrome_trace(procs)
+  if trace_path:
+    with open(trace_path, "w") as f:
+      json.dump(trace, f)
+
+  if prom_path:
+    chunks = []
+    for proc in procs:
+      meta = proc.get("meta") or {}
+      labels = {"proc": "%s%s" % (meta.get("label", "proc"),
+                                  meta.get("executor_id", "")),
+                "pid": str(meta.get("pid", 0))}
+      chunks.append(export.prometheus_text(proc.get("metrics") or {},
+                                           labels))
+    with open(prom_path, "w") as f:
+      f.write("".join(chunks))
+
+  # driver window (driver offset is 0 by definition: it IS the anchor)
+  driver_windows = [export.anchored_window(p) for p in procs
+                    if (p.get("meta") or {}).get("label") == "driver"]
+  driver_windows = [w for w in driver_windows if w]
+  d0 = min(w[0] for w in driver_windows) if driver_windows else None
+  d1 = max(w[1] for w in driver_windows) if driver_windows else None
+
+  span_counts = {}
+  by_name = {}
+  aligned = bool(driver_windows)
+  exec_procs = 0
+  for proc in procs:
+    meta = proc.get("meta") or {}
+    label = "%s%s" % (meta.get("label", "proc"), meta.get("executor_id", ""))
+    spans = proc.get("spans") or []
+    span_counts[label] = span_counts.get(label, 0) + len(spans)
+    for s in spans:
+      by_name[s.get("name", "?")] = by_name.get(s.get("name", "?"), 0) + 1
+    if meta.get("label") == "exec":
+      exec_procs += 1
+      w = export.anchored_window(proc)
+      if w is None or d0 is None:
+        aligned = False
+      elif w[0] < d0 - _ALIGN_MARGIN or w[1] > d1 + _ALIGN_MARGIN:
+        aligned = False
+
+  result = {
+      "metric": "obs_report",
+      "obs_dir": obs_dir,
+      "logs": len(procs),
+      "exec_procs": exec_procs,
+      "driver_procs": sum(
+          1 for p in procs
+          if (p.get("meta") or {}).get("label") == "driver"),
+      "spans_per_proc": span_counts,
+      "spans_by_name": by_name,
+      "trace_events": len(trace["traceEvents"]),
+      "aligned": aligned,
+      "clock_offsets": {
+          "%s%s" % ((p.get("meta") or {}).get("label", "?"),
+                    (p.get("meta") or {}).get("executor_id", "")):
+          (p.get("clock") or {}).get("offset")
+          for p in procs},
+  }
+  return result, procs
+
+
+def print_summary(result, procs):
+  sys.stderr.write("%-14s %-8s %7s  top spans\n" % ("proc", "pid", "spans"))
+  for proc in procs:
+    meta = proc.get("meta") or {}
+    label = "%s%s" % (meta.get("label", "proc"), meta.get("executor_id", ""))
+    names = {}
+    for s in proc.get("spans") or []:
+      names[s.get("name", "?")] = names.get(s.get("name", "?"), 0) + 1
+    top = ", ".join("%s×%d" % (n, c) for n, c in
+                    sorted(names.items(), key=lambda kv: -kv[1])[:4])
+    sys.stderr.write("%-14s %-8s %7d  %s\n"
+                     % (label, meta.get("pid", "?"),
+                        len(proc.get("spans") or []), top))
+
+
+# --- the smoke run -----------------------------------------------------------
+
+
+def run_smoke(keep_dir=None):
+  obs_dir = keep_dir or tempfile.mkdtemp(prefix="tos_obs_smoke_")
+  os.environ["TOS_OBS"] = "1"
+  os.environ["TOS_OBS_DIR"] = obs_dir
+  os.environ.setdefault("TOS_OBS_INTERVAL", "0.25")
+
+  from tensorflowonspark_tpu import cluster as tos_cluster
+  from tensorflowonspark_tpu.cluster import InputMode
+  from tensorflowonspark_tpu.engine import LocalEngine
+
+  data = list(range(400))
+  engine = LocalEngine(num_executors=2)
+  try:
+    # round 1: train feed through the columnar feed plane, StepTimer in
+    # the loop (the registry/tracer seam)
+    c = tos_cluster.run(engine, _smoke_train_main,
+                        input_mode=InputMode.ENGINE, reservation_timeout=60,
+                        heartbeat_interval=0.5)
+    c.train([data[i::8] for i in range(8)], num_epochs=1, feed_timeout=120)
+    c.shutdown(timeout=600)
+    # round 2: inference round-trip (per-partition result alignment)
+    c = tos_cluster.run(engine, _smoke_infer_main,
+                        input_mode=InputMode.ENGINE, reservation_timeout=60,
+                        heartbeat_interval=0.5)
+    results = c.inference([data[i::8] for i in range(8)], feed_timeout=120)
+    c.shutdown(timeout=600)
+  finally:
+    engine.stop()
+
+  if len(results) != len(data) or sum(results) != sum(x * x for x in data):
+    sys.stderr.write("smoke cluster produced wrong inference results\n")
+    return 2
+
+  trace_path = os.path.join(obs_dir, "trace.json")
+  result, procs = build_report(obs_dir, trace_path=trace_path,
+                               prom_path=os.path.join(obs_dir, "metrics.prom"))
+  print_summary(result, procs)
+  result["metric"] = "obs_report_smoke"
+  result["trace_path"] = trace_path
+
+  ok = (result["driver_procs"] >= 1
+        and result["exec_procs"] >= 2
+        and all(result["spans_per_proc"].get("exec%d" % e, 0) > 0
+                for e in (0, 1))
+        and result["spans_per_proc"].get("driver0", 0) > 0
+        and result["aligned"])
+  result["ok"] = ok
+  print(json.dumps(result))
+  return 0 if ok else 2
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("obs_dir", nargs="?", default=None,
+                  help="directory of obs-*.jsonl logs (TOS_OBS_DIR)")
+  ap.add_argument("--trace", default=None,
+                  help="write the merged Chrome trace JSON here")
+  ap.add_argument("--prom", default=None,
+                  help="write Prometheus text exposition here")
+  ap.add_argument("--smoke", action="store_true",
+                  help="drive a 2-process LocalEngine train+inference run "
+                       "end-to-end and report on its merged trace")
+  ap.add_argument("--keep", default=None,
+                  help="--smoke: keep logs/trace in this directory")
+  args = ap.parse_args()
+  if args.smoke:
+    sys.exit(run_smoke(keep_dir=args.keep))
+  if not args.obs_dir:
+    ap.error("obs_dir is required (or use --smoke)")
+  result, procs = build_report(args.obs_dir, trace_path=args.trace,
+                               prom_path=args.prom)
+  print_summary(result, procs)
+  print(json.dumps(result))
+  sys.exit(0 if result["logs"] else 1)
+
+
+if __name__ == "__main__":
+  main()

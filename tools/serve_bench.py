@@ -203,14 +203,17 @@ def run_static_pass(params, cfg, groups, num_steps, eos_id):
 
 
 def run_continuous_pass(eng, workload):
-  """One engine pass; returns (wall_s, latencies, outputs, stat deltas)."""
-  base = dict(eng.stats)
+  """One engine pass; returns (wall_s, latencies, outputs, stat deltas).
+
+  The stats dict is mutated by the engine's loop thread while we read it
+  — deltas go through the one snapshot-subtract helper (obs.metrics)."""
+  snap = eng.stats_snapshot()
   t0 = time.perf_counter()
   rids = [eng.submit(p, max_new_tokens=b) for p, b in workload]
   reqs = [eng.request(r) for r in rids]
   outs = [eng.result(r, timeout=600) for r in rids]
   wall = time.perf_counter() - t0
-  delta = {k: eng.stats[k] - base[k] for k in base}
+  delta = snap.delta()
   return wall, [r.latency for r in reqs], outs, delta
 
 
