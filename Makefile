@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test lint analyze check native bench serve-bench dryrun \
-	mosaic-gate validate clean chaos obs-smoke
+	mosaic-gate validate clean chaos obs-smoke obs-top-smoke bench-check
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -32,10 +32,22 @@ obs-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  $(PY) tools/obs_report.py --smoke
 
+# live-monitor plumbing check: a 2-process LocalEngine train run polled
+# OUT-OF-PROCESS-style through the rendezvous HEALTH wire while it
+# trains (per-executor metrics + step rates + the alert ring end to end)
+obs-top-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/obs_top.py --smoke
+
+# bench trajectory gate: newest history.jsonl record per series vs the
+# trailing median (tools/bench_history.py; benches append on --json-out)
+bench-check:
+	$(PY) tools/bench_history.py --check
+
 # fast pre-commit gate: static analysis + style + the fast test subset +
-# the obs plumbing smoke
+# the obs plumbing smokes
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
-check: analyze obs-smoke
+check: analyze obs-smoke obs-top-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 

@@ -335,6 +335,16 @@ class ClusterSupervisor(object):
                      len(rows), qname, e)
 
 
+def _driver_obs_log(recorder=None):
+  """The driver's per-process obs JSONL (anchored by the recorder's
+  clock when one is live) — shared between the detector's per-alert
+  appends and the shutdown span/metrics dump."""
+  from tensorflowonspark_tpu.obs import export as obs_export
+  return obs_export.ProcessLog(
+      label="driver", executor_id=0,
+      clock=recorder.clock if recorder is not None else None)
+
+
 class InputMode(object):
   """How the cluster gets training data (parity: TFCluster.py:43-46).
 
@@ -365,7 +375,8 @@ class TPUCluster(object):
   def __init__(self, engine: Engine, cluster_info: List[dict],
                cluster_meta: dict, server: rendezvous.Server,
                input_mode: int, node_job, tf_status: dict,
-               driver_ps_procs: Sequence = (), supervisor=None):
+               driver_ps_procs: Sequence = (), supervisor=None,
+               detector=None):
     self.engine = engine
     self.cluster_info = cluster_info
     self.cluster_meta = cluster_meta
@@ -381,6 +392,29 @@ class TPUCluster(object):
     #: here through the rendezvous OBS verb; None when off. getattr:
     #: tests (and embedders) hand in stand-in servers without the field
     self.obs_sink = getattr(server, "obs_sink", None)
+    #: the driver-side detector loop (obs.anomaly.AnomalyDetector)
+    #: evaluating the sink online; None when the plane (or the detector,
+    #: TOS_OBS_DETECT=0) is off
+    self.detector = detector
+
+  def alerts(self, max_items: int = 64) -> List[dict]:
+    """Newest-first structured alerts from the online detector loop
+    (empty when the obs plane / detector is off)."""
+    if self.detector is None:
+      return []
+    return self.detector.recent_alerts(max_items)
+
+  def obs_summary(self) -> dict:
+    """The in-process equivalent of the HEALTH verb's obs payload:
+    liveness snapshot + per-executor metric state + live alerts — the
+    driver summary ``tools/obs_top.py`` renders when embedded."""
+    out = {"data": {str(k): v for k, v in
+                    self.server.liveness.snapshot().items()}}
+    if self.obs_sink is not None:
+      out["obs"] = self.obs_sink.top_summary()
+    if self.detector is not None:
+      out["alerts"] = self.detector.recent_alerts()
+    return out
 
   @staticmethod
   def _span(name: str, **attrs):
@@ -599,11 +633,11 @@ class TPUCluster(object):
   def _dump_driver_obs_log(self) -> None:
     if not obs_metrics.enabled():
       return
-    from tensorflowonspark_tpu.obs import export as obs_export
     rec = obs_spans.active()
     reg = obs_metrics.active()
-    log = obs_export.ProcessLog(label="driver", executor_id=0,
-                                clock=rec.clock if rec is not None else None)
+    # reuse the detector's log when one exists: same file, ONE meta header
+    log = self.detector.jsonl if self.detector is not None \
+        and self.detector.jsonl is not None else _driver_obs_log(rec)
     if rec is not None:
       log.append_spans(rec.drain(None))
     log.close(metrics_snapshot=reg.snapshot() if reg is not None else None)
@@ -679,6 +713,12 @@ class TPUCluster(object):
           break
         self.node_job.wait(raise_on_error=False)
       self.supervisor.stop()
+    if self.detector is not None:
+      # stand the loop down FIRST (stop joins the thread), then one last
+      # pass so late-arriving deltas (executors final-flush on exit) are
+      # evaluated — the other order races the thread's own poll
+      self.detector.stop()
+      self.detector.poll()
     self.server.stop()
     err = self.node_job.first_error() or self.tf_status.get("error")
     if err:
@@ -825,7 +865,11 @@ def run(engine: Engine, main_fn, tf_args=None,
     # the driver end of the obs plane: executors ship metric/span deltas
     # through the rendezvous OBS verb into this bounded sink
     from tensorflowonspark_tpu.obs import collector as obs_collector
+    from tensorflowonspark_tpu.obs import device as obs_device
     server.obs_sink = obs_collector.ObsSink()
+    # compile/device tier, driver side: the driver jits too (sharded
+    # init, serving warm-up) and its compiles belong on the timeline
+    obs_device.install(None)
   server_addr = server.start()
 
   cluster_meta = {
@@ -920,25 +964,44 @@ def run(engine: Engine, main_fn, tf_args=None,
         tf_status, max_restarts=max_restarts, backoff=restart_backoff,
         backoff_cap=restart_backoff_cap).start()
 
+  # the online consumer of the obs plane: a driver thread evaluating the
+  # sink's rolling windows (stragglers, feed stalls, recompile storms,
+  # serving saturation, memory slope). Alerts are counted + mirrored into
+  # the supervisor event stream + JSONL'd + served over HEALTH — never
+  # raised. Starts before the reservation wait so bring-up is covered.
+  detector = None
+  if server.obs_sink is not None:
+    from tensorflowonspark_tpu.obs import anomaly as obs_anomaly
+    if obs_anomaly.detect_enabled():
+      # ONE driver ProcessLog, shared with the shutdown span/metrics dump
+      # (TPUCluster._driver_obs_log) — two instances would write two meta
+      # headers into the same obs-driver0-<pid>.jsonl
+      rec = obs_spans.active()
+      detector = obs_anomaly.AnomalyDetector(
+          server.obs_sink, supervisor=supervisor,
+          jsonl=_driver_obs_log(rec)).start()
+      server.alert_source = detector
+
+  def _abort_cleanup():
+    if supervisor is not None:
+      supervisor.stop()
+    if detector is not None:
+      detector.stop()
+    server.stop()
+    for p in driver_ps_procs:
+      p.terminate()
+
   try:
     with TPUCluster._span("cluster.assemble", nodes=num_executors):
       cluster_info.extend(server.await_reservations(
           timeout=reservation_timeout, status=tf_status))
   except Exception:
-    if supervisor is not None:
-      supervisor.stop()
-    server.stop()
-    for p in driver_ps_procs:
-      p.terminate()
+    _abort_cleanup()
     raise
 
   # duplicate-node sanity check (parity: TFCluster.py:357-372)
   if server.reservations.duplicates:
-    if supervisor is not None:
-      supervisor.stop()
-    server.stop()
-    for p in driver_ps_procs:
-      p.terminate()
+    _abort_cleanup()
     raise RuntimeError(
         "duplicate node reservations detected (reused executors?): %r"
         % server.reservations.duplicates)
@@ -948,4 +1011,4 @@ def run(engine: Engine, main_fn, tf_args=None,
                for n in cluster_info])
   return TPUCluster(engine, cluster_info, cluster_meta, server, input_mode,
                     node_job, tf_status, driver_ps_procs=driver_ps_procs,
-                    supervisor=supervisor)
+                    supervisor=supervisor, detector=detector)

@@ -406,6 +406,13 @@ class Server(MessageSocket):
     #: None (the default) acks-and-drops so the obs plane is never a
     #: prerequisite for the control plane
     self.obs_sink = None
+    #: driver-attached ``obs.anomaly.AnomalyDetector`` (or anything with
+    #: ``recent_alerts(max_items)``): HEALTH replies then carry the live
+    #: alert ring so out-of-process monitors (tools/obs_top.py) see what
+    #: the driver's detector loop sees. None = no ``alerts`` field.
+    self.alert_source = None
+    #: HEALTH obs/alert enrichment failures (counted, never raised)
+    self.health_obs_failures = 0
     self._listener: Optional[socket.socket] = None
     self.addr: Optional[Tuple[str, int]] = None
     # round -> set of arrived task ids; sets make re-sent arrivals (client
@@ -553,7 +560,27 @@ class Server(MessageSocket):
                        "server_time": time.monotonic()})
     elif mtype == "HEALTH":
       snap = {str(k): v for k, v in self.liveness.snapshot().items()}
-      self.send(sock, {"type": "HEALTH", "data": snap})
+      reply = {"type": "HEALTH", "data": snap,
+               "server_time": time.monotonic()}
+      # the obs extension of the liveness snapshot: per-executor metric
+      # state + the detector's alert ring. Both bounded, both best-effort
+      # — a telemetry bug must never break a HEALTH poll.
+      sink = self.obs_sink
+      if sink is not None:
+        try:
+          reply["obs"] = sink.top_summary()
+        except Exception as e:  # noqa: BLE001 - reply stays liveness-only;
+          # counted so a chronically failing summary is diagnosable
+          self.health_obs_failures += 1
+          logger.warning("obs summary for HEALTH failed: %s", e)
+      alerts = self.alert_source
+      if alerts is not None:
+        try:
+          reply["alerts"] = alerts.recent_alerts()
+        except Exception as e:  # noqa: BLE001 - reply stays alert-free
+          self.health_obs_failures += 1
+          logger.warning("alert ring for HEALTH failed: %s", e)
+      self.send(sock, reply)
     elif mtype == "QINFO":
       self.send(sock, {"type": "COUNT",
                        "registered": self.reservations.required -

@@ -207,6 +207,7 @@ class DataFeed(object):
     # obs seam (docs/OBSERVABILITY.md): cached once so the disabled case
     # is one None check per batch
     self._rec = obs_spans.active()
+    self._obs_stage_t = 0.0   # last empty-poll stage-gauge mirror
     reg = obs_metrics.active()
     self._obs_m = None if reg is None else {
         "batches": reg.counter("feed.batches"),
@@ -224,6 +225,14 @@ class DataFeed(object):
     mutating them (obs.metrics.StatsSnapshot)."""
     return obs_metrics.snapshot_stats(self.stats)
 
+  def _obs_stages(self) -> None:
+    """Mirror the live stage seconds into the registry gauges."""
+    m = self._obs_m
+    m["fetch_s"].set(self.stats["fetch_s"])
+    m["decode_s"].set(self.stats["decode_s"])
+    m["assemble_s"].set(self.stats["assemble_s"])
+    m["chunks"].set(self.stats["chunks"])
+
   def _obs_batch(self, t0: float, n: int) -> None:
     """Record one delivered batch into the obs plane (active only)."""
     dt = time.monotonic() - t0
@@ -235,10 +244,7 @@ class DataFeed(object):
       if n:
         m["rows"].inc(n)
       m["batch_ms"].observe(dt * 1e3)
-      m["fetch_s"].set(self.stats["fetch_s"])
-      m["decode_s"].set(self.stats["decode_s"])
-      m["assemble_s"].set(self.stats["assemble_s"])
-      m["chunks"].set(self.stats["chunks"])
+      self._obs_stages()
 
   # -- fetch plane -----------------------------------------------------------
 
@@ -253,6 +259,15 @@ class DataFeed(object):
       got = _fetch_chunk(self._queue_in, DEFAULT_FETCH_ROWS,
                          timeout=timeout, stats=self.stats)
     if got is None:
+      # a STALLED consumer delivers no batches, so batch-boundary gauge
+      # mirroring freezes exactly when the feed-stall detector needs the
+      # stage seconds to keep moving — mirror them on empty polls too
+      # (throttled: the poll loop can spin at sub-second cadence)
+      if self._obs_m is not None:
+        now = time.monotonic()
+        if now - self._obs_stage_t >= 0.5:
+          self._obs_stage_t = now
+          self._obs_stages()
       return False
     kind, payload = got
     if kind == "marker":

@@ -24,6 +24,7 @@ from jax import lax
 import flax.linen as nn
 
 from tensorflowonspark_tpu import ops
+from tensorflowonspark_tpu.obs import device as obs_device
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 from tensorflowonspark_tpu.parallel import ring_attention as ra
 
@@ -871,6 +872,10 @@ def _generate_fn(cfg: TransformerConfig, plen: int, num_steps: int):
   model = Transformer(cfg)
 
   def decode(params, buf):
+    # recompile sentinel seam (obs/device.py): one trace = one jit-cache
+    # entry; steady-state generation must never bump this post-warmup
+    obs_device.note_trace("transformer.generate")
+
     def step(i, buf):
       logits = model.apply({"params": params}, buf)     # [b, total, V]
       pos = plen + i - 1
@@ -935,6 +940,7 @@ def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
   model = Transformer(cfg, mesh=mesh)
 
   def decode(params, prompt, rng):
+    obs_device.note_trace("transformer.kv_generate")
     variables = {"params": params, "cache": _zero_cache(model, batch)}
     logits, mutated = model.apply(variables, prompt, decode=True,
                                   mutable=["cache"])

@@ -260,13 +260,19 @@ def _start_obs_shipper(server_addr, executor_id: int, sender):
   if not (obs_metrics.enabled() and server_addr):
     return None
   from tensorflowonspark_tpu.obs import collector as obs_collector
+  from tensorflowonspark_tpu.obs import device as obs_device
   from tensorflowonspark_tpu.obs import spans as obs_spans
   clock = sender.clock if sender is not None else None
   rec = obs_spans.active()
   if rec is not None and clock is not None:
     rec.clock = clock
-  return obs_collector.ObsShipper(tuple(server_addr), executor_id,
-                                  clock=clock, label="exec").start()
+  shipper = obs_collector.ObsShipper(tuple(server_addr), executor_id,
+                                     clock=clock, label="exec")
+  # compile/device tier: jax.monitoring recompile sentinel + a device-
+  # memory sampler on the shipper cadence, so compile counts and memory
+  # watermarks ride the normal OBS wire to the driver's detector loop
+  obs_device.install(shipper)
+  return shipper.start()
 
 
 def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,

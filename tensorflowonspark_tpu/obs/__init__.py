@@ -1,6 +1,6 @@
 """Cluster-wide observability plane.
 
-Four modules, one measurement story:
+One measurement story:
 
 - ``metrics``   — process-local counters/gauges/fixed-bucket histograms
                   (lock-cheap hot path), snapshot/delta arithmetic, and
@@ -14,6 +14,14 @@ Four modules, one measurement story:
                   exposition, merged Chrome-trace (Perfetto) JSON
 - ``profiler``  — JAX trace plumbing, ``StepTimer`` (feeds the registry)
                   and MFU accounting, moved from ``utils/profiler.py``
+- ``device``    — compile/device tier: jax.monitoring recompile
+                  sentinel (+ per-seam trace counters), HLO cost
+                  capture, device-memory gauges on the shipper cadence
+- ``anomaly``   — the driver-side DETECTOR loop consuming the sink
+                  online: straggler / feed-stall / recompile-storm /
+                  serving-saturation / memory-slope alerts, fanned out
+                  to the registry, the supervisor event stream, the
+                  driver JSONL and the rendezvous HEALTH verb
 
 Everything is off (and near-free: one cached None check per seam) until
 ``TOS_OBS=1``. See docs/OBSERVABILITY.md for the metric catalogue, span

@@ -1,0 +1,438 @@
+"""Driver-side online anomaly & straggler detection over the ObsSink.
+
+PR 7's plane records and ships telemetry; nothing consumed it online — a
+recompile storm, a stalled feed stage, a straggling executor or a
+device-memory creep was only visible after the run, in a Chrome trace a
+human had to open. The :class:`AnomalyDetector` is the consumer: a
+bounded, timeout-bounded driver thread that samples each executor's
+cumulative totals from the :class:`obs.collector.ObsSink` on a fixed
+cadence, keeps a rolling window per executor, and evaluates the detector
+catalogue every pass:
+
+==================  =========================================================
+``straggler``       executor step rate below the cluster-median rate by more
+                    than ``TOS_OBS_STRAGGLER_PCT`` percent (the tf.data /
+                    TPU-concurrency papers' step-time-variance signal)
+``feed_stall``      the consumer spent more than ``TOS_OBS_FEED_STALL_FRAC``
+                    of the window blocked in the feed plane, with per-stage
+                    attribution (fetch vs decode vs assemble — the tf.data
+                    paper's input-bound diagnosis)
+``recompile_storm`` ``xla.compiles`` still advancing after the executor's
+                    ``TOS_OBS_COMPILE_WARMUP`` grace (a jit seam keying on
+                    data-dependent shapes; obs.device is the source)
+``serving_saturated`` request queue depth at/over ``TOS_OBS_QUEUE_SAT`` with
+                    slot occupancy ~1: the engine is goodput-bound, admit
+                    fewer or add slots
+``mem_slope``       ``device.bytes_in_use`` grew monotonically by more than
+                    ``TOS_OBS_MEM_SLOPE_PCT`` percent across the window (a
+                    leak-shaped creep toward OOM)
+==================  =========================================================
+
+Every alert is a plain msgpack/json-safe dict (see :func:`make_alert`)
+and is fanned out four ways, none of which can block the detector:
+counted into the driver registry (``obs.alerts``, ``obs.alerts.<kind>``),
+mirrored into the ClusterSupervisor's event stream (``alert-<kind>`` —
+alerts land next to recoveries in ``supervisor.events``), appended to
+the driver's obs JSONL (crash-safe post-mortem for
+``tools/obs_report.py --alerts``), and kept in a bounded ring the
+rendezvous HEALTH verb serves to out-of-process monitors
+(``tools/obs_top.py``).
+
+Invariants (PR 7's contract): zero work when ``TOS_OBS=0`` (the cluster
+never constructs a detector), every buffer bounded, every wait
+timeout-bounded, detector failures counted (``eval_failures``) not
+raised, and alerts are COUNTED, never raised — the detector diagnoses,
+the supervisor (and the operator) decide.
+"""
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from tensorflowonspark_tpu.obs import metrics as metrics_mod
+from tensorflowonspark_tpu.obs import spans as spans_mod
+
+logger = logging.getLogger(__name__)
+
+#: detector-loop master gate (default ON with ``TOS_OBS=1``; ``0`` keeps
+#: the plane shipping without online evaluation) — env registry: TOS008
+ENV_OBS_DETECT = "TOS_OBS_DETECT"
+#: seconds between detector passes (TOS008)
+ENV_OBS_DETECT_INTERVAL = "TOS_OBS_DETECT_INTERVAL"
+#: rolling evaluation window in seconds (TOS008)
+ENV_OBS_WINDOW = "TOS_OBS_WINDOW"
+#: straggler threshold: percent below the cluster-median step rate (TOS008)
+ENV_OBS_STRAGGLER_PCT = "TOS_OBS_STRAGGLER_PCT"
+#: recompile storm FIRES AT/ABOVE this many compiles per window after
+#: warmup (i.e. up to limit−1 are tolerated) — TOS008
+ENV_OBS_RECOMPILE_LIMIT = "TOS_OBS_RECOMPILE_LIMIT"
+#: seconds after an executor is first seen before compiles count (TOS008)
+ENV_OBS_COMPILE_WARMUP = "TOS_OBS_COMPILE_WARMUP"
+#: feed stall: fraction of the window spent inside feed stages (TOS008)
+ENV_OBS_FEED_STALL_FRAC = "TOS_OBS_FEED_STALL_FRAC"
+#: serving saturation: queue depth at/over this with occupancy ~1 (TOS008)
+ENV_OBS_QUEUE_SAT = "TOS_OBS_QUEUE_SAT"
+#: memory slope: percent in-use growth across the window that fires (TOS008)
+ENV_OBS_MEM_SLOPE_PCT = "TOS_OBS_MEM_SLOPE_PCT"
+#: per-(kind, executor) refire suppression in seconds (TOS008)
+ENV_OBS_ALERT_COOLDOWN = "TOS_OBS_ALERT_COOLDOWN"
+
+_DEFAULT_INTERVAL = 2.0
+_DEFAULT_WINDOW = 20.0
+_DEFAULT_STRAGGLER_PCT = 50.0
+_DEFAULT_RECOMPILE_LIMIT = 3
+_DEFAULT_COMPILE_WARMUP = 120.0
+_DEFAULT_FEED_STALL_FRAC = 0.6
+_DEFAULT_QUEUE_SAT = 8
+_DEFAULT_MEM_SLOPE_PCT = 10.0
+_DEFAULT_COOLDOWN = 30.0
+
+#: bounded alert ring (driver memory; the JSONL keeps the full history)
+MAX_ALERTS = 256
+#: a straggler verdict needs the median executor to have made at least
+#: this many steps inside the window — below it, rates are noise
+MIN_WINDOW_STEPS = 5
+#: memory slope needs at least this many samples across the window
+MIN_MEM_SAMPLES = 3
+
+#: the cumulative/gauge metric names one detector pass reads per executor
+_SAMPLED = ("train.steps", "feed.batches", "feed.fetch_s", "feed.decode_s",
+            "feed.assemble_s", "xla.compiles", "serve.queue_depth",
+            "serve.occupancy", "device.bytes_in_use")
+
+
+def detect_enabled() -> bool:
+  """True when the obs plane is on and the detector loop isn't opted out."""
+  return metrics_mod.enabled() and \
+      os.environ.get(ENV_OBS_DETECT, "1") not in ("0",)
+
+
+def _env_float(name: str, default: float) -> float:
+  try:
+    return float(os.environ.get(name, default))
+  except ValueError:
+    return default
+
+
+def make_alert(kind: str, executor_id: int, window_s: float,
+               evidence: Dict, message: str, t: Optional[float] = None
+               ) -> dict:
+  """One structured alert record. ``alert`` (not ``kind``) carries the
+  detector name so the record can ride the obs JSONL, whose per-line
+  ``kind`` field is the record-type discriminator."""
+  return {"alert": kind, "executor_id": int(executor_id),
+          "t": time.monotonic() if t is None else t,
+          "window_s": round(float(window_s), 3),
+          "evidence": {k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in evidence.items()},
+          "message": message}
+
+
+class AnomalyDetector(object):
+  """Rolling-window detector loop over a driver-side ObsSink.
+
+  ``sink`` needs only ``metrics(eid) -> {name: snapshot}`` per executor
+  and ``executors`` keys — tests drive synthetic sinks. ``supervisor``
+  (optional) receives each alert via its ``_event`` stream;
+  ``jsonl`` (optional, an ``obs.export.ProcessLog``) gets crash-safe
+  per-alert appends. ``time_fn`` injects a clock for deterministic tests.
+  """
+
+  def __init__(self, sink, supervisor=None, jsonl=None,
+               interval: Optional[float] = None,
+               window: Optional[float] = None,
+               registry=None, recorder=None, time_fn=time.monotonic):
+    self.sink = sink
+    self.supervisor = supervisor
+    self.jsonl = jsonl
+    self.interval = max(0.05, interval if interval is not None else
+                        _env_float(ENV_OBS_DETECT_INTERVAL,
+                                   _DEFAULT_INTERVAL))
+    self.window = max(2 * self.interval, window if window is not None else
+                      _env_float(ENV_OBS_WINDOW, _DEFAULT_WINDOW))
+    self.straggler_pct = _env_float(ENV_OBS_STRAGGLER_PCT,
+                                    _DEFAULT_STRAGGLER_PCT)
+    self.recompile_limit = _env_float(ENV_OBS_RECOMPILE_LIMIT,
+                                      _DEFAULT_RECOMPILE_LIMIT)
+    self.compile_warmup = _env_float(ENV_OBS_COMPILE_WARMUP,
+                                     _DEFAULT_COMPILE_WARMUP)
+    self.feed_stall_frac = _env_float(ENV_OBS_FEED_STALL_FRAC,
+                                      _DEFAULT_FEED_STALL_FRAC)
+    self.queue_sat = _env_float(ENV_OBS_QUEUE_SAT, _DEFAULT_QUEUE_SAT)
+    self.mem_slope_pct = _env_float(ENV_OBS_MEM_SLOPE_PCT,
+                                    _DEFAULT_MEM_SLOPE_PCT)
+    self.cooldown = _env_float(ENV_OBS_ALERT_COOLDOWN, _DEFAULT_COOLDOWN)
+    #: detectors only evaluate once a window's sample span reaches this —
+    #: sub-second startup windows turn executor launch skew into phantom
+    #: stragglers (seen in the bring-up drive: a 0.2 s window where one
+    #: executor had stepped and the other hadn't yet)
+    self.min_span = max(2 * self.interval, 0.5 * self.window)
+    self._time = time_fn
+    self._reg = registry if registry is not None else metrics_mod.active()
+    self._rec = recorder if recorder is not None else spans_mod.active()
+    # eid -> deque[(t, {name: float})]; capped well past window/interval
+    self._samples: Dict[int, deque] = {}
+    self._first_seen: Dict[int, float] = {}
+    self._last_fired: Dict[tuple, float] = {}
+    self._poll_lock = threading.Lock()
+    self._cond = threading.Condition()
+    self._alerts: deque = deque(maxlen=MAX_ALERTS)
+    self.alerts_total = 0
+    self.counts_by_kind: Dict[str, int] = {}
+    self.eval_failures = 0
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+
+  # -- sampling --------------------------------------------------------------
+
+  @staticmethod
+  def _extract(metrics_snapshot: Dict[str, dict]) -> Dict[str, float]:
+    vals = {}
+    for name in _SAMPLED:
+      m = metrics_snapshot.get(name)
+      if m is not None and "value" in m:
+        vals[name] = float(m["value"])
+    return vals
+
+  def _sample(self, now: float) -> None:
+    for eid in list(getattr(self.sink, "executors", {})):
+      try:
+        vals = self._extract(self.sink.metrics(eid))
+      except Exception:  # noqa: BLE001 - a sink hiccup skips one sample
+        self.eval_failures += 1
+        continue
+      dq = self._samples.setdefault(int(eid), deque(maxlen=4096))
+      self._first_seen.setdefault(int(eid), now)
+      dq.append((now, vals))
+      # retire samples older than the window, always keeping the newest
+      # pre-window sample as the delta baseline
+      while len(dq) >= 2 and dq[1][0] <= now - self.window:
+        dq.popleft()
+
+  @staticmethod
+  def _delta(dq, name: str) -> Optional[float]:
+    first, last = dq[0][1].get(name), dq[-1][1].get(name)
+    if first is None or last is None:
+      return None
+    return last - first
+
+  # -- evaluation ------------------------------------------------------------
+
+  def poll(self, now: Optional[float] = None) -> List[dict]:
+    """One sample + evaluate pass; returns the alerts fired by THIS pass
+    (they are also recorded/fanned out). The thread loop calls this; so
+    do tests, with an injected ``now``."""
+    if now is None:
+      now = self._time()
+    new: List[dict] = []
+    # one evaluator at a time: a caller-driven poll (tests, the shutdown
+    # final pass) must not interleave with the loop thread's — the
+    # cooldown's check-then-set isn't atomic on its own
+    with self._poll_lock:
+      new.extend(self._poll_locked(now))
+    return new
+
+  def _poll_locked(self, now: float) -> List[dict]:
+    new: List[dict] = []
+    try:
+      self._sample(now)
+      windows = {}
+      for eid, dq in self._samples.items():
+        span = dq[-1][0] - dq[0][0]
+        if len(dq) >= 2 and span >= self.min_span:
+          windows[eid] = (dq, span)
+      new.extend(self._check_stragglers(windows, now))
+      for eid, (dq, span) in windows.items():
+        new.extend(self._check_feed_stall(eid, dq, span, now))
+        new.extend(self._check_recompiles(eid, dq, span, now))
+        new.extend(self._check_serving(eid, dq, span, now))
+        new.extend(self._check_mem_slope(eid, dq, span, now))
+    except Exception:  # noqa: BLE001 - the detector must outlive any
+      # single evaluation bug; failures are counted and visible
+      self.eval_failures += 1
+      logger.exception("anomaly evaluation pass failed")
+    return new
+
+  def _check_stragglers(self, windows, now) -> List[dict]:
+    rates = {}
+    for eid, (dq, span) in windows.items():
+      d = self._delta(dq, "train.steps")
+      if d is not None:
+        rates[eid] = d / span
+    # a lone executor has no cluster to straggle behind
+    if len(rates) < 2:
+      return []
+    ordered = sorted(rates.values())
+    median = ordered[len(ordered) // 2]
+    span = max(s for _, s in windows.values())
+    if median * span < MIN_WINDOW_STEPS:
+      return []   # the cluster itself is barely stepping: rates are noise
+    out = []
+    threshold = median * (1.0 - self.straggler_pct / 100.0)
+    for eid, rate in rates.items():
+      if rate < threshold:
+        out.extend(self._fire(
+            "straggler", eid, windows[eid][1], now,
+            {"rate": rate, "cluster_median": median,
+             "pct_behind": 100.0 * (1.0 - rate / median) if median else 0.0},
+            "executor %d steps at %.2f/s vs cluster median %.2f/s "
+            "(>%g%% behind)" % (eid, rate, median, self.straggler_pct)))
+    return out
+
+  def _check_feed_stall(self, eid, dq, span, now) -> List[dict]:
+    stages = {s: self._delta(dq, "feed.%s" % s) or 0.0
+              for s in ("fetch_s", "decode_s", "assemble_s")}
+    total = sum(stages.values())
+    batches = self._delta(dq, "feed.batches")
+    if batches is None:   # no DataFeed on this executor (FILES mode)
+      return []
+    if dq[-1][1].get("feed.batches", 0.0) <= 0:
+      return []   # never delivered anything: bring-up, not a stall
+    if batches > 0:
+      return []   # fresh batches landed: the feed kept up. (The fetch
+      # PIPELINE thread accrues fetch_s even while batches flow — stage
+      # seconds alone cannot distinguish healthy overlap from a stall.)
+    steps = self._delta(dq, "train.steps")
+    if steps is not None and steps > 0:
+      return []   # consumer progressed on buffered data: not starved yet
+    if total < self.feed_stall_frac * span:
+      return []
+    stage = max(stages, key=stages.get)
+    return self._fire(
+        "feed_stall", eid, span, now,
+        dict(stages, batches=batches, frac=total / span, stage=stage),
+        "executor %d starved: zero fresh batches over %.0fs while the "
+        "feed plane ran %.0f%% of it (dominant stage: %s) — input-bound "
+        "or upstream stopped feeding" % (eid, span, 100 * total / span,
+                                         stage))
+
+  def _check_recompiles(self, eid, dq, span, now) -> List[dict]:
+    if now - self._first_seen.get(eid, now) < self.compile_warmup:
+      return []
+    d = self._delta(dq, "xla.compiles")
+    if d is None or d < self.recompile_limit:
+      return []
+    return self._fire(
+        "recompile_storm", eid, span, now,
+        {"compiles": d, "total": dq[-1][1].get("xla.compiles", 0.0)},
+        "executor %d compiled %d time(s) in the last %.0fs, past its "
+        "%.0fs warmup — a jit seam is keying on data-dependent shapes"
+        % (eid, int(d), span, self.compile_warmup))
+
+  def _check_serving(self, eid, dq, span, now) -> List[dict]:
+    depth = dq[-1][1].get("serve.queue_depth")
+    occ = dq[-1][1].get("serve.occupancy")
+    if depth is None or occ is None:
+      return []
+    if depth < self.queue_sat or occ < 0.9:
+      return []
+    return self._fire(
+        "serving_saturated", eid, span, now,
+        {"queue_depth": depth, "occupancy": occ},
+        "executor %d serving at occupancy %.2f with %d queued request(s) "
+        "— goodput-bound; add slots or shed load" % (eid, occ, int(depth)))
+
+  def _check_mem_slope(self, eid, dq, span, now) -> List[dict]:
+    series = [(t, v["device.bytes_in_use"]) for t, v in dq
+              if "device.bytes_in_use" in v]
+    if len(series) < MIN_MEM_SAMPLES:
+      return []
+    values = [v for _, v in series]
+    first, last = values[0], values[-1]
+    if first <= 0 or last <= first or last < max(values):
+      return []   # flat, shrinking, or already peaked — not a creep
+    growth_pct = 100.0 * (last - first) / first
+    if growth_pct < self.mem_slope_pct:
+      return []
+    return self._fire(
+        "mem_slope", eid, span, now,
+        {"first_bytes": first, "last_bytes": last,
+         "growth_pct": growth_pct,
+         "slope_bytes_per_s": (last - first) / span},
+        "executor %d device memory grew %.1f%% over %.0fs (%.0f B/s) — "
+        "leak-shaped creep" % (eid, growth_pct, span,
+                               (last - first) / span))
+
+  # -- alert fan-out ---------------------------------------------------------
+
+  def _fire(self, kind, eid, span, now, evidence, message) -> List[dict]:
+    key = (kind, int(eid))
+    last = self._last_fired.get(key)
+    if last is not None and now - last < self.cooldown:
+      return []
+    self._last_fired[key] = now
+    alert = make_alert(kind, eid, span, evidence, message, t=now)
+    logger.warning("obs alert: %s", message)
+    with self._cond:
+      self._alerts.append(alert)
+      self.alerts_total += 1
+      self.counts_by_kind[kind] = self.counts_by_kind.get(kind, 0) + 1
+      self._cond.notify_all()
+    if self._reg is not None:
+      self._reg.counter("obs.alerts").inc()
+      self._reg.counter("obs.alerts." + kind).inc()
+    if self._rec is not None:
+      self._rec.event("obs.alert", alert=kind, executor_id=int(eid))
+    if self.supervisor is not None:
+      try:
+        # same stream as detected-dead/relaunched/recovered: the alert
+        # IS a cluster event, and tests/operators already read this list
+        self.supervisor._event("alert-" + kind, executor_id=int(eid),
+                               message=message)
+      except Exception:  # noqa: BLE001 - a supervisor in teardown must
+        self.eval_failures += 1   # not take the detector with it
+    if self.jsonl is not None:
+      self.jsonl.append_alerts([alert])
+    return [alert]
+
+  # -- read plane ------------------------------------------------------------
+
+  def recent_alerts(self, max_items: int = 64) -> List[dict]:
+    """Newest-first bounded slice for HEALTH replies / obs_top."""
+    with self._cond:
+      items = list(self._alerts)[-max_items:]
+    return list(reversed(items))
+
+  def wait_alert(self, timeout: float, kind: Optional[str] = None
+                 ) -> Optional[dict]:
+    """Block (bounded) until an alert exists — newest matching one, or
+    None on timeout. Named into the analyzer's blocking-verb set
+    (TOS001): callers must pass an explicit ``timeout``."""
+    deadline = time.monotonic() + timeout
+    with self._cond:
+      while True:
+        for a in reversed(self._alerts):
+          if kind is None or a["alert"] == kind:
+            return dict(a)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+          return None
+        self._cond.wait(timeout=min(remaining, 0.25))
+
+  def summary(self) -> dict:
+    with self._cond:
+      return {"alerts_total": self.alerts_total,
+              "by_kind": dict(self.counts_by_kind),
+              "eval_failures": self.eval_failures,
+              "interval": self.interval, "window": self.window}
+
+  # -- lifecycle -------------------------------------------------------------
+
+  def _run(self) -> None:
+    while not self._stop.wait(self.interval):
+      self.poll()
+
+  def start(self) -> "AnomalyDetector":
+    self._thread = threading.Thread(target=self._run, daemon=True,
+                                    name="tos-obs-anomaly")
+    self._thread.start()
+    return self
+
+  def stop(self, timeout: float = 5.0) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=timeout)
+      self._thread = None

@@ -89,8 +89,48 @@ class ObsShipper(object):
     self.ship_failures = 0
     self.ships_acked = 0
     self.spans_lost = 0
+    self.sampler_failures = 0
+    # pre-ship samplers (device-memory watermarks, …): run once per ship
+    # round so gauges ride the normal delta wire on the shipper cadence
+    self._samplers: List = []
+    self._clock_gauges = None
+    self._clock_last = None
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
+
+  def add_sampler(self, fn) -> None:
+    """Register a zero-arg callable run before every ship's snapshot
+    (``obs.device.make_memory_sampler`` is the canonical one). Sampler
+    exceptions are counted (``sampler_failures``), never raised."""
+    self._samplers.append(fn)
+
+  def _run_samplers(self) -> None:
+    for fn in self._samplers:
+      try:
+        fn()
+      except Exception:  # noqa: BLE001 - a broken sampler must not stop
+        # the metric deltas that do work from shipping
+        self.sampler_failures += 1
+    if self.registry is not None:
+      # clock-offset QUALITY rides the registry too (satellite of the
+      # device tier): rtt_ms bounds the offset error (±rtt/2), samples
+      # counts the TIME exchanges feeding the estimate — surfaced in
+      # Prometheus exposition and obs_report without ad-hoc plumbing.
+      # Gauges only move when the ELECTED estimate moves: every acked
+      # ship is itself a TIME exchange, so per-sample updates would ship
+      # a delta every round and the idle-wire short-circuit could never
+      # fire again.
+      snap = self.clock.snapshot()
+      if snap["samples"] and \
+          (snap["offset"], snap["rtt"]) != self._clock_last:
+        self._clock_last = (snap["offset"], snap["rtt"])
+        if self._clock_gauges is None:
+          self._clock_gauges = (self.registry.gauge("clock.offset_ms"),
+                                self.registry.gauge("clock.rtt_ms"),
+                                self.registry.gauge("clock.samples"))
+        self._clock_gauges[0].set(snap["offset"] * 1e3)
+        self._clock_gauges[1].set((snap["rtt"] or 0.0) * 1e3)
+        self._clock_gauges[2].set(snap["samples"])
 
   # -- wire ------------------------------------------------------------------
 
@@ -143,6 +183,7 @@ class ObsShipper(object):
     """Snapshot, subtract, drain, send. True when the driver acked."""
     if timeout is None:
       timeout = max(0.5, 2 * self.interval)
+    self._run_samplers()
     cur = self.registry.snapshot() if self.registry is not None else {}
     delta = metrics_mod.snapshot_delta(cur, self._last_acked)
     spans: List[dict] = []
@@ -153,8 +194,13 @@ class ObsShipper(object):
         else {}
     drops["spans_lost"] = self.spans_lost
     drops["ship_failures"] = self.ship_failures
-    if not delta and not spans and self.ships_acked > 0:
-      return True   # idle: nothing to say, keep the wire quiet
+    if not spans and self.ships_acked > 0 and \
+        all(k.startswith("clock.") for k in delta):
+      # idle: nothing to say, keep the wire quiet. Clock-quality gauges
+      # alone never wake the wire — every acked ship is a TIME exchange,
+      # so they'd otherwise ship a delta forever; they piggyback on the
+      # next real delta instead (the baseline deliberately not advanced)
+      return True
     self._seq += 1
     msg = {"type": "OBS", "executor_id": self.executor_id,
            "label": self.label, "pid": os.getpid(), "seq": self._seq,
@@ -295,6 +341,42 @@ class ObsSink(object):
         if m and "value" in m:
           total += m["value"]
     return total
+
+  #: the compact metric set the HEALTH verb / obs_top surface per
+  #: executor: cumulative counters the poller turns into rates, plus the
+  #: last-written gauges. Bounded and msgpack-safe by construction.
+  TOP_METRICS = (
+      "train.steps", "train.items",
+      "feed.batches", "feed.rows", "feed.fetch_s", "feed.decode_s",
+      "feed.assemble_s",
+      "serve.tokens", "serve.completed", "serve.occupancy",
+      "serve.queue_depth", "serve.slots_active",
+      "xla.compiles",
+      "device.bytes_in_use", "device.peak_bytes", "device.bytes_limit",
+      "clock.offset_ms", "clock.rtt_ms", "clock.samples",
+      "obs.alerts",
+  )
+
+  def top_summary(self) -> Dict[str, dict]:
+    """{executor_id(str): compact per-executor state} for the HEALTH
+    reply and the live monitor — string keys because this rides msgpack
+    on the rendezvous wire (the HEALTH ``data`` convention)."""
+    now = time.monotonic()
+    out: Dict[str, dict] = {}
+    with self._cond:
+      for eid, e in self.executors.items():
+        vals = {}
+        for name in self.TOP_METRICS:
+          m = e["metrics"].get(name)
+          if m is not None and "value" in m:
+            vals[name] = m["value"]
+        out[str(eid)] = {
+            "label": e["label"], "pid": e["pid"], "ships": e["ships"],
+            "last_seen_age": now - e.get("last_seen", now),
+            "clock": dict(e["clock"]), "drops": dict(e["drops"]),
+            "metrics": vals,
+        }
+    return out
 
   def summary(self) -> dict:
     now = time.monotonic()

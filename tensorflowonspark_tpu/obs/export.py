@@ -81,6 +81,12 @@ class ProcessLog(object):
   def append_spans(self, spans: List[dict]) -> None:
     self._append([dict(rec, kind="span") for rec in spans])
 
+  def append_alerts(self, alerts: List[dict]) -> None:
+    """Structured detector alerts (``obs.anomaly``), appended as they
+    fire so a post-mortem (``obs_report --alerts``) survives a driver
+    crash — each line is self-contained like every other record here."""
+    self._append([dict(rec, kind="alert") for rec in alerts])
+
   def close(self, metrics_snapshot: Optional[dict] = None) -> None:
     """Stamp the final clock offset + metrics snapshot (merge anchors on
     the LAST clock line — the best estimate the process ever had)."""
@@ -103,12 +109,12 @@ def find_logs(directory: str) -> List[str]:
 
 def merge_jsonl(paths: List[str]) -> List[dict]:
   """Parse per-process logs into proc dicts:
-  ``{"path", "meta", "spans", "metrics", "clock"}`` (malformed lines are
-  skipped and counted in ``"skipped"``)."""
+  ``{"path", "meta", "spans", "alerts", "metrics", "clock"}`` (malformed
+  lines are skipped and counted in ``"skipped"``)."""
   procs = []
   for path in paths:
-    proc = {"path": path, "meta": {}, "spans": [], "metrics": {},
-            "clock": {}, "skipped": 0}
+    proc = {"path": path, "meta": {}, "spans": [], "alerts": [],
+            "metrics": {}, "clock": {}, "skipped": 0}
     try:
       with open(path) as f:
         lines = f.read().splitlines()
@@ -131,6 +137,8 @@ def merge_jsonl(paths: List[str]) -> List[dict]:
         proc["meta"] = rec
       elif kind == "span":
         proc["spans"].append(rec)
+      elif kind == "alert":
+        proc["alerts"].append(rec)
       elif kind == "clock":
         proc["clock"] = rec   # last one wins: the final (best) estimate
       elif kind == "metrics":
@@ -192,6 +200,15 @@ def chrome_trace(procs: List[dict]) -> dict:
       if rec.get("attrs"):
         ev["args"] = rec["attrs"]
       events.append(ev)
+    for rec in proc.get("alerts") or []:
+      # detector alerts land as GLOBAL instants: on the trace they mark
+      # the moment the driver called the run unhealthy, across all tracks
+      events.append({"name": "alert:%s" % rec.get("alert", "?"),
+                     "pid": pid, "tid": 0, "ph": "i", "s": "g",
+                     "ts": (rec.get("t", 0.0) + offset) * 1e6,
+                     "cat": "alert",
+                     "args": {k: v for k, v in rec.items()
+                              if k not in ("kind", "t")}})
   return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

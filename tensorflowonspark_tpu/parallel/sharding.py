@@ -15,6 +15,7 @@ names onto mesh axes.
 import logging
 from typing import Callable, Tuple
 
+from tensorflowonspark_tpu.obs import device as obs_device
 from tensorflowonspark_tpu.parallel import mesh as mesh_lib
 
 logger = logging.getLogger(__name__)
@@ -156,6 +157,9 @@ def make_train_step(loss_fn: Callable,
   batch_shard = batch_sharding(mesh, batch_extra_axes)
 
   def _step(state, batch):
+    # recompile sentinel seam (obs/device.py): a steady-state train loop
+    # must never re-trace this — pinned by the recompile-sentinel test
+    obs_device.note_trace("train.step")
     loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
     return state.apply_gradients(grads=grads), loss
 
@@ -163,7 +167,23 @@ def make_train_step(loss_fn: Callable,
   if state_sharding is not None:
     kw = dict(in_shardings=(state_sharding, batch_shard),
               out_shardings=(state_sharding, replicated(mesh)))
-  return jax.jit(_step, donate_argnums=(0,) if donate_state else (), **kw)
+  step = jax.jit(_step, donate_argnums=(0,) if donate_state else (), **kw)
+  if not obs_device.device_tier_enabled():
+    return step
+
+  # device tier on: capture the train step's HLO cost (flops / bytes
+  # accessed) at first call. The wrapper adds one dict check per step and
+  # keeps the jit's AOT surface (.lower) for mosaic_gate-style callers.
+  pending = {"capture": True}
+
+  def step_with_cost(state, batch):
+    if pending["capture"]:
+      pending["capture"] = False
+      obs_device.capture_cost("train.step", step, state, batch)
+    return step(state, batch)
+
+  step_with_cost.lower = step.lower
+  return step_with_cost
 
 
 def shard_batch(batch, mesh, extra_axes: Tuple[str, ...] = ()):

@@ -40,6 +40,7 @@ from jax import lax
 from jax.tree_util import tree_map_with_path
 
 from tensorflowonspark_tpu.models import transformer as tfm
+from tensorflowonspark_tpu.obs import device as obs_device
 
 #: prompt-chunk sizes for bucketed prefill, largest-first. The compiled
 #: prefill cache holds at most one entry per size, so arbitrary prompt
@@ -114,6 +115,9 @@ class SlotDecoder(object):
   # -- prefill (single row, bucketed chunks) --------------------------------
 
   def _prefill_impl(self, params, cache, tokens):
+    # recompile sentinel seam: fires once per (re)trace — the prefill jit
+    # cache must stay bounded by the bucket set (obs/device.py)
+    obs_device.note_trace("serve.prefill")
     logits, mutated = self.model.apply(
         {"params": params, "cache": cache}, tokens, decode=True,
         mutable=["cache"])
@@ -150,6 +154,8 @@ class SlotDecoder(object):
   # -- slot insert ----------------------------------------------------------
 
   def _insert_impl(self, slabs, row, slot):
+    obs_device.note_trace("serve.insert")
+
     def ins(s, r):
       if r.ndim == s.ndim:        # [1, ...] row leaf into [S, ...] slab
         return lax.dynamic_update_slice(
@@ -186,6 +192,7 @@ class SlotDecoder(object):
     return new_cache, nxt
 
   def _step_impl(self, params, slabs, tok, active):
+    obs_device.note_trace("serve.step")
     return self._one_step(params, slabs, tok, active)
 
   def step(self, params, slabs, last_tokens, active):
@@ -212,6 +219,8 @@ class SlotDecoder(object):
     fn = self._step_many_jits.get(horizon)
     if fn is None:
       def impl(params, slabs, tok, active, remaining, _h=horizon):
+        obs_device.note_trace("serve.step_many")
+
         def body(carry, _):
           slabs, tok, active, remaining = carry
           slabs, nxt = self._one_step(params, slabs, tok, active)
@@ -228,6 +237,16 @@ class SlotDecoder(object):
         return slabs, toks, active, remaining
 
       fn = self._step_many_jits[horizon] = jax.jit(impl)
+      # the serving step's HLO cost (flops / bytes accessed), captured
+      # once per horizon at first use — rides the OBS wire as gauges.
+      # The horizon must live in the LABEL: it is a closed-over scan
+      # length, invisible to the arg-shape fingerprint, and two horizons
+      # have genuinely different costs
+      obs_device.capture_cost(
+          "serve.step_many.h%d" % horizon, fn, params, slabs,
+          jnp.asarray(last_tokens, jnp.int32),
+          jnp.asarray(active, jnp.bool_),
+          jnp.asarray(remaining, jnp.int32))
     return fn(params, slabs, jnp.asarray(last_tokens, jnp.int32),
               jnp.asarray(active, jnp.bool_),
               jnp.asarray(remaining, jnp.int32))

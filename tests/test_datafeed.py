@@ -377,3 +377,34 @@ class TestLiveness:
     # size=1 degrades to plain device_put per batch
     out1 = list(prefetch_to_device(iter(batches), size=1))
     assert len(out1) == 5
+
+
+class TestStalledFeedGaugeMirroring:
+  def test_stage_gauges_keep_moving_during_a_stall(self, hub):
+    """THE feed-stall-detector prerequisite: a consumer delivering ZERO
+    batches must still mirror its live stage seconds into the registry
+    gauges (batch-boundary mirroring alone freezes exactly when the
+    detector needs fetch_s to keep moving)."""
+    from tensorflowonspark_tpu.obs import metrics as obs_metrics
+    reg = obs_metrics.activate()
+    try:
+      feed = DataFeed(hub, train_mode=True, pipeline_depth=0)
+      assert feed._obs_m is not None
+      # nothing enqueued: the fetch attempt comes back empty — a stall
+      feed._obs_stage_t = 0.0
+      assert feed._fetch(timeout=0.05) is False
+      mirrored = reg.snapshot()["feed.fetch_s"]["value"]
+      assert mirrored == pytest.approx(feed.stats["fetch_s"])
+      assert mirrored > 0.0
+      # throttled: an immediate second empty poll does not re-mirror
+      feed.stats["fetch_s"] += 100.0
+      assert feed._fetch(timeout=0.01) is False
+      assert reg.snapshot()["feed.fetch_s"]["value"] == \
+          pytest.approx(mirrored)
+      # past the throttle window it catches up
+      feed._obs_stage_t = 0.0
+      assert feed._fetch(timeout=0.01) is False
+      assert reg.snapshot()["feed.fetch_s"]["value"] == \
+          pytest.approx(feed.stats["fetch_s"])
+    finally:
+      obs_metrics.deactivate()

@@ -442,3 +442,82 @@ class TestStepTimerRegistrySeam:
     assert shim.StepTimer is new.StepTimer
     assert shim.mfu is new.mfu
     assert shim.annotate is new.annotate
+
+
+class TestShipperSamplersAndTopSummary:
+  def test_samplers_run_per_ship_and_clock_gauges_land(self):
+    """Pre-ship samplers (the device-memory seat) run once per round and
+    their gauges — plus the clock-quality gauges — ride the normal delta
+    wire into the sink's top summary."""
+    sink = collector.ObsSink()
+    srv = _SinkServer(sink)
+    reg = metrics.MetricsRegistry()
+    shipper = collector.ObsShipper(srv.addr, 3, registry=reg,
+                                   recorder=spans.SpanRecorder(capacity=4),
+                                   interval=60, label="exec")
+    calls = []
+    shipper.add_sampler(lambda: calls.append(1))
+
+    def broken():
+      raise RuntimeError("boom")
+
+    shipper.add_sampler(broken)
+    from tensorflowonspark_tpu.obs import device as obs_device
+    shipper.add_sampler(obs_device.make_memory_sampler(
+        reg, stats_fn=lambda: {"0": {"bytes_in_use": 42,
+                                     "peak_bytes_in_use": 64}}))
+    try:
+      assert shipper.ship(timeout=10)        # ship 1: a TIME exchange too
+      # clock-quality gauges PIGGYBACK on real deltas (alone they must
+      # not wake the wire); give ship 2 one real counter delta to ride
+      reg.counter("work").inc()
+      assert shipper.ship(timeout=10)
+      assert len(calls) == 2
+      assert shipper.sampler_failures == 2   # broken counted, not raised
+      top = sink.top_summary()
+      entry = top["3"]
+      assert entry["label"] == "exec"
+      assert entry["metrics"]["device.bytes_in_use"] == 42
+      assert entry["metrics"]["device.peak_bytes"] == 64
+      assert entry["metrics"]["clock.samples"] >= 1
+      assert "clock.rtt_ms" in entry["metrics"]
+    finally:
+      shipper.stop(timeout=2)
+      srv.close()
+
+  def test_health_reply_carries_obs_summary_and_alert_ring(self):
+    """The HEALTH verb's PR-8 extension: with a sink and an alert source
+    attached, replies carry the per-executor obs summary and the live
+    alert ring — the wire tools/obs_top.py monitors through."""
+    from tensorflowonspark_tpu.obs import anomaly
+    sink = collector.ObsSink()
+    sink.ingest({"executor_id": 4, "label": "exec", "pid": 1, "seq": 1,
+                 "metrics": {"train.steps": {"type": "counter",
+                                             "value": 9.0}},
+                 "spans": [], "drops": {}, "clock": {}})
+    srv = _SinkServer(sink)
+    det = anomaly.AnomalyDetector(sink, registry=metrics.MetricsRegistry(),
+                                  recorder=None, interval=1.0, window=4.0)
+    det._fire("straggler", 4, 4.0, 100.0, {"rate": 0.0}, "synthetic")
+    srv.server.alert_source = det
+    try:
+      c = rendezvous.Client(srv.addr, timeout=10)
+      reply = c._request({"type": "HEALTH"})
+      c.close()
+      assert reply["type"] == "HEALTH"
+      assert reply["obs"]["4"]["metrics"]["train.steps"] == 9.0
+      assert [a["alert"] for a in reply["alerts"]] == ["straggler"]
+      # json/msgpack-safe end to end (obs_top --once --json prints it)
+      json.dumps(reply)
+    finally:
+      srv.close()
+
+  def test_health_reply_without_obs_stays_liveness_only(self):
+    srv = _SinkServer(None)
+    try:
+      c = rendezvous.Client(srv.addr, timeout=10)
+      reply = c._request({"type": "HEALTH"})
+      c.close()
+      assert "obs" not in reply and "alerts" not in reply
+    finally:
+      srv.close()

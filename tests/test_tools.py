@@ -192,3 +192,86 @@ class TestFeedBenchSmoke:
         assert stages[stage] >= 0.0
       # the production path actually went columnar
       assert stages["columnar_chunks"] == stages["chunks"] > 0
+
+
+class TestObsTopSmoke:
+  def test_smoke_monitors_live_cluster_through_health_wire(self, tmp_path):
+    """`obs_top --smoke` drives a REAL 2-process LocalEngine train run
+    and polls it the way an out-of-process monitor would — through the
+    rendezvous HEALTH verb: per-executor metrics, a live step rate, and
+    the detector's alert ring on the wire."""
+    import json
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    keep = str(tmp_path / "frames.txt")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "obs_top.py"),
+         "--smoke", "--keep", keep],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-3000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "obs_top_smoke"
+    assert result["ok"] is True
+    assert result["polls"] >= 2
+    last = result["last"]
+    assert last["has_obs"] and last["has_alert_ring"]
+    for eid in ("0", "1"):
+      assert last["executors"][eid]["metrics"]["train.steps"] > 0
+    # the rendered frames carried the per-executor table
+    frames = open(keep).read()
+    assert "steps/s" in frames and "exec" in frames
+
+
+class TestBenchHistory:
+  def test_append_check_roundtrip_flags_regression(self, tmp_path):
+    from tools import bench_history as bh
+    path = str(tmp_path / "history.jsonl")
+    for v in (100.0, 102.0, 98.0, 101.0):
+      assert bh.append_record("feed_bench", v, "shm-b64", path=path)
+    verdicts, regressions = bh.check(path=path, threshold_pct=15.0)
+    assert regressions == []
+    assert verdicts[0]["verdict"] == "ok"
+    # a 30% drop against the trailing median flags
+    bh.append_record("feed_bench", 70.0, "shm-b64", path=path)
+    verdicts, regressions = bh.check(path=path, threshold_pct=15.0)
+    assert len(regressions) == 1
+    assert regressions[0]["fingerprint"] == "shm-b64"
+    assert regressions[0]["delta_pct"] < -15.0
+    # records carry the provenance the satellite asks for
+    rec = bh.load(path)[-1]
+    assert {"t", "bench", "value", "fingerprint", "rev"} <= set(rec)
+
+  def test_series_are_isolated_by_fingerprint_and_bench(self, tmp_path):
+    from tools import bench_history as bh
+    path = str(tmp_path / "history.jsonl")
+    bh.append_record("feed_bench", 100.0, "shm-b64", path=path)
+    bh.append_record("feed_bench", 100.0, "queue-b64", path=path)
+    bh.append_record("serve_bench", 50.0, "full-r48", path=path)
+    # a huge drop in a DIFFERENT series must not contaminate this one
+    bh.append_record("feed_bench", 20.0, "queue-b64", path=path)
+    verdicts, regressions = bh.check(path=path, bench="serve_bench")
+    assert regressions == []
+    assert all(v["bench"] == "serve_bench" for v in verdicts)
+
+  def test_insufficient_history_never_fails(self, tmp_path):
+    from tools import bench_history as bh
+    path = str(tmp_path / "history.jsonl")
+    bh.append_record("feed_bench", 100.0, "solo", path=path)
+    verdicts, regressions = bh.check(path=path)
+    assert regressions == []
+    assert verdicts[0]["verdict"] == "insufficient"
+    # missing file: empty, not an error
+    assert bh.check(path=str(tmp_path / "nope.jsonl")) == ([], [])
+
+  def test_torn_tail_line_is_skipped(self, tmp_path):
+    from tools import bench_history as bh
+    path = str(tmp_path / "history.jsonl")
+    bh.append_record("feed_bench", 100.0, "shm", path=path)
+    with open(path, "a") as f:
+      f.write('{"bench": "feed_bench", "val')   # SIGKILL mid-append
+    assert len(bh.load(path)) == 1

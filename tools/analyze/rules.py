@@ -47,9 +47,12 @@ KNOWN_ENV = set()
 _LOG_RECEIVERS = {"logger", "logging", "log", "_logger"}
 # obs_send/obs_recv: the observability plane's OBS-verb ship/collect
 # calls — blocking by nature (socket round-trip / sink wait), so TOS001
-# demands the same explicit timeout discipline as the feed-queue verbs
+# demands the same explicit timeout discipline as the feed-queue verbs.
+# wait_alert: the anomaly detector's alert wait (obs.anomaly) — same
+# class: it parks on a condition until a detector pass fires.
 _BLOCKING_VERB_QUEUE = ("get", "get_many", "put", "put_many",
-                        "get_chunk", "put_chunk", "obs_send", "obs_recv")
+                        "get_chunk", "put_chunk", "obs_send", "obs_recv",
+                        "wait_alert")
 _SOCKET_BLOCKING = ("recv", "recv_into", "recvfrom", "accept", "connect")
 _SUBPROCESS_BLOCKING = ("run", "call", "check_call", "check_output",
                         "communicate")

@@ -1,0 +1,170 @@
+"""Bench trajectory: append-only history of bench headline rates.
+
+The repo's bench artifacts (`bench_artifacts/*.json`) are one-shot
+snapshots — overwritten per run, so the BENCH trajectory across PRs was
+empty and a silent regression had nothing to trip over. This module
+gives every ``--json-out`` bench run a one-line append into
+``bench_artifacts/history.jsonl``::
+
+    {"t": <wall>, "bench": "feed_bench", "value": 223.4,
+     "fingerprint": "shm-b64-s30-c64", "rev": "8e79eeb", ...}
+
+and a ``--check`` gate comparing the NEWEST record of each
+(bench, fingerprint) series against the trailing median of the previous
+runs: a drop beyond ``--threshold`` percent flags a regression (exit 1).
+Fingerprints pin the workload shape, so only like-for-like runs compare;
+``value`` is always a higher-is-better rate (fed steps/s, tokens/s).
+
+Usage:  python tools/bench_history.py --check [--threshold 15]
+        python tools/bench_history.py --list
+(the appends happen inside tools/feed_bench.py / tools/serve_bench.py)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench_artifacts", "history.jsonl")
+#: how many prior runs the trailing median uses (most recent first)
+DEFAULT_TRAILING = 5
+#: percent drop vs the trailing median that flags a regression. Wide by
+#: default: this 2-vCPU box's throttling gives ±10% per-run noise
+DEFAULT_THRESHOLD = 15.0
+
+
+def _git_rev():
+  try:
+    out = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], timeout=10,
+        capture_output=True, cwd=os.path.dirname(os.path.abspath(__file__)))
+    if out.returncode == 0:
+      return out.stdout.decode().strip()
+  except Exception:  # noqa: BLE001 - history must append without git too
+    pass
+  return "unknown"
+
+
+def append_record(bench, value, fingerprint, extra=None, path=None):
+  """Append one headline record; never raises (a bench run must not fail
+  on a read-only checkout). Returns the record, or None when skipped."""
+  if value is None:
+    return None
+  path = path or DEFAULT_PATH
+  rec = dict(extra or {}, t=round(time.time(), 3), bench=bench,
+             value=round(float(value), 4), fingerprint=fingerprint,
+             rev=_git_rev())
+  try:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+      f.write(json.dumps(rec) + "\n")
+  except OSError as e:
+    sys.stderr.write("bench history append skipped: %s\n" % e)
+    return None
+  return rec
+
+
+def load(path=None):
+  path = path or DEFAULT_PATH
+  records = []
+  try:
+    with open(path) as f:
+      for line in f:
+        line = line.strip()
+        if not line:
+          continue
+        try:
+          records.append(json.loads(line))
+        except ValueError:
+          pass    # a torn tail line loses itself, nothing else
+  except OSError:
+    return []
+  return records
+
+
+def _median(vals):
+  s = sorted(vals)
+  n = len(s)
+  return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def check(path=None, threshold_pct=DEFAULT_THRESHOLD,
+          trailing=DEFAULT_TRAILING, bench=None):
+  """Newest record per (bench, fingerprint) vs the trailing median of its
+  predecessors. Returns (verdicts, regressions) — series with fewer than
+  2 records report ``insufficient`` and never fail the check."""
+  series = {}
+  for rec in load(path):
+    if bench and rec.get("bench") != bench:
+      continue
+    key = (rec.get("bench"), rec.get("fingerprint"))
+    series.setdefault(key, []).append(rec)
+  verdicts = []
+  regressions = []
+  for (b, fp), recs in sorted(series.items()):
+    recs.sort(key=lambda r: r.get("t", 0))
+    if len(recs) < 2:
+      verdicts.append({"bench": b, "fingerprint": fp, "runs": len(recs),
+                       "verdict": "insufficient"})
+      continue
+    newest = recs[-1]
+    prior = [r["value"] for r in recs[:-1][-trailing:]]
+    base = _median(prior)
+    delta_pct = 100.0 * (newest["value"] - base) / base if base else 0.0
+    verdict = {"bench": b, "fingerprint": fp, "runs": len(recs),
+               "newest": newest["value"], "newest_rev": newest.get("rev"),
+               "trailing_median": round(base, 4),
+               "delta_pct": round(delta_pct, 2),
+               "verdict": "regression" if delta_pct < -threshold_pct
+               else "ok"}
+    verdicts.append(verdict)
+    if verdict["verdict"] == "regression":
+      regressions.append(verdict)
+  return verdicts, regressions
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--check", action="store_true",
+                  help="compare newest runs against trailing medians")
+  ap.add_argument("--list", action="store_true",
+                  help="dump the parsed history records")
+  ap.add_argument("--path", default=None, help="history file "
+                  "(default: bench_artifacts/history.jsonl)")
+  ap.add_argument("--bench", default=None,
+                  help="restrict to one bench name")
+  ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                  help="regression threshold in percent below the "
+                       "trailing median")
+  ap.add_argument("--trailing", type=int, default=DEFAULT_TRAILING,
+                  help="how many prior runs feed the median")
+  args = ap.parse_args()
+  if args.list:
+    for rec in load(args.path):
+      if not args.bench or rec.get("bench") == args.bench:
+        print(json.dumps(rec))
+    return 0
+  if not args.check:
+    ap.error("use --check or --list")
+  verdicts, regressions = check(args.path, threshold_pct=args.threshold,
+                                trailing=args.trailing, bench=args.bench)
+  for v in verdicts:
+    sys.stderr.write("%-12s %-28s runs=%-3d %s%s\n" % (
+        v["bench"], v["fingerprint"], v["runs"], v["verdict"],
+        "" if "delta_pct" not in v else
+        " (newest %.2f vs median %.2f, %+.1f%%)"
+        % (v["newest"], v["trailing_median"], v["delta_pct"])))
+  print(json.dumps({"metric": "bench_history_check",
+                    "series": len(verdicts),
+                    "regressions": regressions}))
+  return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

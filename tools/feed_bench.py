@@ -47,6 +47,8 @@ from statistics import median as _median
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tensorflowonspark_tpu.obs import metrics as obs_metrics  # noqa: E402
+
 AUTHKEY = b"feedbench"
 _RING_SEQ = [0]   # unique ring name per run: shmring.open_cached caches by
                   # name, so reusing one name across transports would hand
@@ -327,6 +329,12 @@ def main():
     # warmup batch consumes, zeroing the steady-state stage counters
     args.steps, args.batch, args.chunk, args.reps = 8, 32, 32, 1
   _pin_to_core(0)   # before jax's first use so XLA threads inherit it
+  if obs_metrics.enabled():
+    # the obs-overhead A/B (BENCH_NOTES) must price the device tier too:
+    # hook the compile listener so every jit here pays the same sentinel
+    # cost an obs-enabled cluster process pays
+    from tensorflowonspark_tpu.obs import device as obs_device
+    obs_device.install_compile_listener()
 
   # this box's CPU clock drifts minute-to-minute (throttling): a single
   # global compute baseline makes overhead meaningless. Each transport rep
@@ -398,6 +406,21 @@ def main():
   if args.json_out:
     with open(args.json_out, "w") as f:
       f.write(line + "\n")
+    # bench→history bridge: one line per recorded run so the BENCH
+    # trajectory accumulates (tools/bench_history.py --check flags drops
+    # beyond the trailing median)
+    from tools import bench_history
+    for transport in ("shm", "queue"):
+      rate = (per_transport.get(transport) or {}).get("fed_steps_per_sec")
+      if rate is not None:
+        bench_history.append_record(
+            "feed_bench", rate,
+            "%s-b%d-s%d-c%d" % (transport, args.batch, args.steps,
+                                args.chunk),
+            extra={"overhead_pct":
+                   per_transport[transport].get("feed_overhead_pct"),
+                   "obs": int(obs_metrics.enabled())})
+        break
 
 
 if __name__ == "__main__":

@@ -101,6 +101,9 @@ def build_report(obs_dir, trace_path=None, prom_path=None):
 
   span_counts = {}
   by_name = {}
+  alerts = []
+  alerts_by_kind = {}
+  device_memory = {}
   aligned = bool(driver_windows)
   exec_procs = 0
   for proc in procs:
@@ -108,6 +111,14 @@ def build_report(obs_dir, trace_path=None, prom_path=None):
     label = "%s%s" % (meta.get("label", "proc"), meta.get("executor_id", ""))
     spans = proc.get("spans") or []
     span_counts[label] = span_counts.get(label, 0) + len(spans)
+    for a in proc.get("alerts") or []:
+      alerts.append(a)
+      k = a.get("alert", "?")
+      alerts_by_kind[k] = alerts_by_kind.get(k, 0) + 1
+    mem = {k: (proc.get("metrics") or {}).get(k, {}).get("value")
+           for k in ("device.bytes_in_use", "device.peak_bytes")}
+    if any(v for v in mem.values()):
+      device_memory[label] = mem
     for s in spans:
       by_name[s.get("name", "?")] = by_name.get(s.get("name", "?"), 0) + 1
     if meta.get("label") == "exec":
@@ -130,6 +141,9 @@ def build_report(obs_dir, trace_path=None, prom_path=None):
       "spans_by_name": by_name,
       "trace_events": len(trace["traceEvents"]),
       "aligned": aligned,
+      "alerts_total": len(alerts),
+      "alerts_by_kind": alerts_by_kind,
+      "device_memory": device_memory,
       "clock_offsets": {
           "%s%s" % ((p.get("meta") or {}).get("label", "?"),
                     (p.get("meta") or {}).get("executor_id", "")):
@@ -137,6 +151,27 @@ def build_report(obs_dir, trace_path=None, prom_path=None):
           for p in procs},
   }
   return result, procs
+
+
+def print_alerts(procs):
+  """Post-mortem alert table from the merged JSONL (the detector appends
+  each alert as it fires, so this survives a driver crash)."""
+  rows = []
+  for proc in procs:
+    rows.extend(proc.get("alerts") or [])
+  rows.sort(key=lambda a: a.get("t", 0.0))
+  if not rows:
+    sys.stderr.write("no alerts recorded\n")
+    return
+  sys.stderr.write("%-18s %4s %10s %8s  evidence\n"
+                   % ("alert", "exec", "t_mono", "window"))
+  for a in rows:
+    ev = a.get("evidence") or {}
+    ev_text = " ".join("%s=%s" % (k, ev[k]) for k in sorted(ev))
+    sys.stderr.write("%-18s %4s %10.2f %7.1fs  %s\n"
+                     % (a.get("alert", "?"), a.get("executor_id", "?"),
+                        a.get("t", 0.0), a.get("window_s", 0.0),
+                        ev_text[:120]))
 
 
 def print_summary(result, procs):
@@ -152,6 +187,11 @@ def print_summary(result, procs):
     sys.stderr.write("%-14s %-8s %7d  %s\n"
                      % (label, meta.get("pid", "?"),
                         len(proc.get("spans") or []), top))
+  if result.get("alerts_total"):
+    sys.stderr.write("alerts: %d (%s) — details via --alerts\n"
+                     % (result["alerts_total"],
+                        ", ".join("%s×%d" % kv for kv in
+                                  sorted(result["alerts_by_kind"].items()))))
 
 
 # --- the smoke run -----------------------------------------------------------
@@ -216,6 +256,9 @@ def main():
                   help="write the merged Chrome trace JSON here")
   ap.add_argument("--prom", default=None,
                   help="write Prometheus text exposition here")
+  ap.add_argument("--alerts", action="store_true",
+                  help="render the recorded detector alerts as a "
+                       "post-mortem table")
   ap.add_argument("--smoke", action="store_true",
                   help="drive a 2-process LocalEngine train+inference run "
                        "end-to-end and report on its merged trace")
@@ -228,6 +271,8 @@ def main():
     ap.error("obs_dir is required (or use --smoke)")
   result, procs = build_report(args.obs_dir, trace_path=args.trace,
                                prom_path=args.prom)
+  if args.alerts:
+    print_alerts(procs)
   print_summary(result, procs)
   print(json.dumps(result))
   sys.exit(0 if result["logs"] else 1)

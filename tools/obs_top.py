@@ -1,0 +1,344 @@
+"""obs-top: a live terminal monitor for a running cluster.
+
+``top`` for the obs plane: polls the rendezvous ``HEALTH`` verb — which
+(PR 8) carries the liveness table, a compact per-executor metric summary
+from the driver's ObsSink, and the anomaly detector's live alert ring —
+and renders per-executor step rate, feed stage breakdown, serving
+occupancy, device-memory watermarks, clock-offset quality and active
+alerts as a plain-ANSI refresh loop (no curses: works over ssh, in CI
+logs, and inside `watch`). Rates are computed monitor-side from the
+deltas between consecutive polls, so the wire stays cumulative-only.
+
+Modes:
+
+- ``obs_top.py HOST:PORT``           live loop (ctrl-C exits)
+- ``obs_top.py HOST:PORT --once --json``  two quick samples, one JSON
+  line on stdout (scripting / health checks)
+- ``obs_top.py --smoke``             end-to-end check: drives a REAL
+  2-process LocalEngine train run with the obs plane on and polls its
+  rendezvous server OUT-OF-PROCESS-style (through the HEALTH wire)
+  while it trains; asserts both executors report metrics and the alerts
+  field is served. Tier-1-covered via tests/test_tools.py and wired
+  into ``make check`` (obs-top-smoke).
+
+The same renderer works in-process over ``TPUCluster.obs_summary()``
+(the driver summary) for embedders that don't want a socket hop.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: default refresh cadence (seconds); also the rate-delta base
+DEFAULT_INTERVAL = 2.0
+
+_ANSI_CLEAR = "\x1b[H\x1b[2J"
+
+
+def _parse_addr(text):
+  host, port = text.rsplit(":", 1)
+  return host, int(port)
+
+
+def poll_health(addr, timeout=5.0, client=None):
+  """One HEALTH round-trip; returns (reply dict, client for reuse)."""
+  from tensorflowonspark_tpu.control import rendezvous
+  if client is None:
+    client = rendezvous.Client(addr, timeout=timeout)
+  reply = client._request({"type": "HEALTH"})
+  return reply, client
+
+
+def _fmt_bytes(n):
+  if not n:
+    return "-"
+  for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+    if abs(n) < 1024.0:
+      return "%.1f%s" % (n, unit)
+    n /= 1024.0
+  return "%.1fPiB" % n
+
+
+def _rate(cur, prev, name, dt):
+  if prev is None or dt <= 0:
+    return None
+  a = prev.get("metrics", {}).get(name)
+  b = cur.get("metrics", {}).get(name)
+  if a is None or b is None:
+    return None
+  return max(0.0, (b - a) / dt)
+
+
+def build_snapshot(reply, prev=None, dt=0.0):
+  """Digest one HEALTH reply (+ the previous poll) into the render/JSON
+  model: per-executor rows with rates where two samples exist."""
+  liveness = reply.get("data") or {}
+  obs = reply.get("obs") or {}
+  alerts = reply.get("alerts")
+  rows = {}
+  for eid in sorted(set(liveness) | set(obs), key=lambda x: int(x)):
+    live = liveness.get(eid) or {}
+    ex = obs.get(eid) or {}
+    m = ex.get("metrics", {})
+    pex = (prev or {}).get("executors", {}).get(eid) if prev else None
+    pobs = {"metrics": (pex or {}).get("metrics", {})}
+    stage_rates = {}
+    for s in ("fetch_s", "decode_s", "assemble_s"):
+      r = _rate({"metrics": m}, pobs, "feed." + s, dt)
+      if r is not None:
+        # seconds-per-second inside the stage = fraction of wall time
+        stage_rates[s] = r
+    rows[eid] = {
+        "state": live.get("state"),
+        "beat_age": live.get("age"),
+        "progress": live.get("progress"),
+        "label": ex.get("label"),
+        "pid": ex.get("pid"),
+        "ships": ex.get("ships"),
+        "metrics": m,
+        "step_rate": _rate({"metrics": m}, pobs, "train.steps", dt),
+        "token_rate": _rate({"metrics": m}, pobs, "serve.tokens", dt),
+        "feed_stage_frac": stage_rates,
+        "occupancy": m.get("serve.occupancy"),
+        "queue_depth": m.get("serve.queue_depth"),
+        "mem_in_use": m.get("device.bytes_in_use"),
+        "mem_peak": m.get("device.peak_bytes"),
+        "compiles": m.get("xla.compiles"),
+        "clock_offset_ms": m.get("clock.offset_ms"),
+        "clock_rtt_ms": m.get("clock.rtt_ms"),
+        "alerts": m.get("obs.alerts"),
+    }
+  return {"t": time.time(), "executors": rows, "alerts": alerts,
+          "has_obs": bool(obs), "has_alert_ring": alerts is not None}
+
+
+def render(snap, clear=True):
+  """ANSI-render one snapshot to a list of lines."""
+  lines = []
+  if clear:
+    lines.append(_ANSI_CLEAR.rstrip("\n"))
+  lines.append("obs-top  %s  executors=%d%s"
+               % (time.strftime("%H:%M:%S"), len(snap["executors"]),
+                  "" if snap["has_obs"] else "  [no obs summary on wire]"))
+  hdr = ("%-4s %-9s %8s %8s %6s %6s %9s %8s %7s %7s"
+         % ("exec", "state", "steps/s", "tok/s", "occ", "queue",
+            "mem", "compile", "clk_ms", "alerts"))
+  lines.append(hdr)
+  lines.append("-" * len(hdr))
+  for eid, row in snap["executors"].items():
+    stages = row["feed_stage_frac"]
+    feed = ""
+    if stages:
+      feed = "  feed[" + " ".join(
+          "%s %.0f%%" % (k.replace("_s", ""), 100 * v)
+          for k, v in stages.items()) + "]"
+    lines.append(
+        "%-4s %-9s %8s %8s %6s %6s %9s %8s %7s %7s%s" % (
+            eid, row["state"] or "?",
+            "%.2f" % row["step_rate"] if row["step_rate"] is not None
+            else "-",
+            "%.1f" % row["token_rate"] if row["token_rate"] is not None
+            else "-",
+            "%.2f" % row["occupancy"] if row["occupancy"] is not None
+            else "-",
+            "%d" % row["queue_depth"] if row["queue_depth"] is not None
+            else "-",
+            _fmt_bytes(row["mem_in_use"]),
+            "%d" % row["compiles"] if row["compiles"] is not None else "-",
+            "%.2f" % row["clock_offset_ms"]
+            if row["clock_offset_ms"] is not None else "-",
+            "%d" % row["alerts"] if row["alerts"] is not None else "-",
+            feed))
+  alerts = snap.get("alerts") or []
+  lines.append("")
+  if alerts:
+    lines.append("ACTIVE ALERTS (newest first):")
+    for a in alerts[:8]:
+      lines.append("  [%s] exec %s: %s"
+                   % (a.get("alert"), a.get("executor_id"),
+                      a.get("message")))
+  else:
+    lines.append("no active alerts" if snap["has_alert_ring"]
+                 else "no alert ring on wire (detector off?)")
+  return lines
+
+
+def run_monitor(addr, interval, once=False, as_json=False,
+                max_polls=None, out=sys.stdout):
+  """The poll/render loop. ``once`` takes two closely-spaced samples (so
+  rates exist) and emits a single frame; ``max_polls`` bounds the live
+  loop for tests."""
+  client = None
+  prev = None
+  polls = 0
+  snap = None
+  while True:
+    try:
+      reply, client = poll_health(addr, client=client)
+    except ConnectionError as e:
+      if once:
+        out.write(json.dumps({"error": str(e)}) + "\n")
+        return 2
+      out.write("rendezvous unreachable: %s\n" % e)
+      return 2
+    # rates divide by MEASURED elapsed time, not the nominal interval:
+    # the HEALTH RTT + render time would otherwise inflate every rate
+    now = time.time()
+    dt = (now - prev["t"]) if prev is not None else 0.0
+    snap = build_snapshot(reply, prev=prev, dt=dt)
+    polls += 1
+    if once and polls == 1:
+      prev = snap
+      time.sleep(max(0.5, min(interval, 1.0)))
+      continue
+    if once:
+      out.write((json.dumps(snap) if as_json
+                 else "\n".join(render(snap, clear=False))) + "\n")
+      return 0
+    out.write("\n".join(render(snap)) + "\n")
+    out.flush()
+    prev = snap
+    if max_polls is not None and polls >= max_polls:
+      return 0
+    time.sleep(interval)
+
+
+# --- the smoke run -----------------------------------------------------------
+
+
+def _smoke_train_main(args, ctx):
+  # executor-side loop: StepTimer feeds train.steps so obs-top has a rate
+  from tensorflowonspark_tpu.obs.profiler import StepTimer
+  timer = StepTimer(warmup=0)
+  feed = ctx.get_data_feed(train_mode=True)
+  step = 0
+  while not feed.should_stop():
+    batch = feed.next_batch(16)
+    if not batch:
+      continue
+    with timer.step(items=len(batch)):
+      sum(x * x for x in batch)
+      time.sleep(0.03)   # keep the run long enough for several polls
+    step += 1
+    ctx.report_progress(step)
+
+
+def run_smoke(keep_path=None):
+  import threading
+
+  os.environ["TOS_OBS"] = "1"
+  os.environ.setdefault("TOS_OBS_INTERVAL", "0.25")
+  os.environ.setdefault("TOS_OBS_DETECT_INTERVAL", "0.25")
+
+  from tensorflowonspark_tpu import cluster as tos_cluster
+  from tensorflowonspark_tpu.cluster import InputMode
+  from tensorflowonspark_tpu.engine import LocalEngine
+
+  data = list(range(3200))
+  engine = LocalEngine(num_executors=2)
+  frames = []
+  snaps = []
+  saw_rate = False
+  try:
+    c = tos_cluster.run(engine, _smoke_train_main,
+                        input_mode=InputMode.ENGINE, reservation_timeout=60,
+                        heartbeat_interval=0.5)
+    addr = tuple(c.server_addr)
+
+    feeder_err = []
+
+    def _feed():
+      try:
+        c.train([data[i::8] for i in range(8)], num_epochs=1,
+                feed_timeout=120)
+      except Exception as e:  # noqa: BLE001 - surfaced after the polls
+        feeder_err.append(e)
+
+    t = threading.Thread(target=_feed, daemon=True)
+    t.start()
+    # poll through the REAL wire while the cluster trains, like an
+    # out-of-process monitor would
+    client = None
+    prev = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+      reply, client = poll_health(addr, client=client)
+      dt = (time.time() - prev["t"]) if prev is not None else 0.0
+      snap = build_snapshot(reply, prev=prev, dt=dt)
+      snaps.append(snap)
+      frames.append("\n".join(render(snap, clear=False)))
+      prev = snap
+      saw_rate = saw_rate or any(r["step_rate"]
+                                 for r in snap["executors"].values())
+      # done when both executors showed metrics AND a live step rate was
+      # observed in some poll (the run is finite; late polls see deltas
+      # of zero, which is correct — the cluster went idle)
+      if (snap["has_alert_ring"] and saw_rate
+          and all(str(e) in snap["executors"]
+                  and snap["executors"][str(e)]["metrics"].get("train.steps")
+                  for e in (0, 1))):
+        break
+      time.sleep(0.4)
+    if client is not None:
+      client.close()
+    t.join(timeout=120)
+    c.shutdown(timeout=600)
+    if feeder_err:
+      raise feeder_err[0]
+  finally:
+    engine.stop()
+
+  last = snaps[-1] if snaps else {"executors": {}}
+  ok = (len(snaps) >= 2
+        and last["has_obs"]
+        and last["has_alert_ring"]
+        and saw_rate
+        and all(str(e) in last["executors"] for e in (0, 1))
+        and all(last["executors"][str(e)]["metrics"].get("train.steps")
+                for e in (0, 1)))
+  result = {"metric": "obs_top_smoke", "ok": ok, "polls": len(snaps),
+            "last": last}
+  if keep_path:
+    with open(keep_path, "w") as f:
+      f.write("\n\n".join(frames) + "\n")
+  sys.stderr.write(frames[-1] + "\n" if frames else "no frames captured\n")
+  print(json.dumps(result))
+  return 0 if ok else 2
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("addr", nargs="?", default=None,
+                  help="rendezvous server HOST:PORT (TPUCluster.server_addr)")
+  ap.add_argument("--interval", type=float, default=DEFAULT_INTERVAL,
+                  help="refresh/poll cadence in seconds")
+  ap.add_argument("--once", action="store_true",
+                  help="two quick samples, one frame, exit")
+  ap.add_argument("--json", action="store_true",
+                  help="with --once: emit the snapshot as one JSON line")
+  ap.add_argument("--polls", type=int, default=None,
+                  help="exit after N refresh frames (testing)")
+  ap.add_argument("--smoke", action="store_true",
+                  help="drive a 2-process LocalEngine train run and "
+                       "monitor it through the HEALTH wire end to end")
+  ap.add_argument("--keep", default=None,
+                  help="--smoke: also write the captured frames here")
+  args = ap.parse_args()
+  if args.smoke:
+    sys.exit(run_smoke(keep_path=args.keep))
+  if not args.addr:
+    ap.error("addr is required (or use --smoke)")
+  try:
+    sys.exit(run_monitor(_parse_addr(args.addr), args.interval,
+                         once=args.once, as_json=args.json,
+                         max_polls=args.polls))
+  except KeyboardInterrupt:
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+  main()

@@ -284,6 +284,13 @@ def run_compare(args):
   import jax.numpy as jnp
   import numpy as np
   from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.obs import metrics as obs_metrics
+
+  if obs_metrics.enabled():
+    # obs-overhead A/B parity with a real obs-enabled serving process:
+    # the compile listener (device tier) must be priced into the "on" leg
+    from tensorflowonspark_tpu.obs import device as obs_device
+    obs_device.install_compile_listener()
 
   shape = _COMPARE_SMOKE if args.smoke else _COMPARE_FULL
   if args.requests:
@@ -335,6 +342,16 @@ def run_compare(args):
   if args.json_out:
     with open(args.json_out, "w") as f:
       f.write(line + "\n")
+    # bench→history bridge (tools/bench_history.py --check): the engine's
+    # useful tokens/s is the headline rate for the regression gate
+    from tools import bench_history
+    bench_history.append_record(
+        "serve_bench", result["continuous"]["tok_s"],
+        "%s-r%d-s%d-h%d-seed%d" % (result["mode"],
+                                   shape["requests"], shape["slots"],
+                                   shape["horizon"], args.seed),
+        extra={"speedup": result["speedup"],
+               "obs": int(obs_metrics.enabled())})
   print(line)
   return 0 if result["parity_ok"] else 3
 
