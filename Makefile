@@ -4,8 +4,9 @@
 
 PY ?= python
 
-.PHONY: test lint analyze check native bench serve-bench dryrun \
-	mosaic-gate validate clean chaos obs-smoke obs-top-smoke bench-check
+.PHONY: test lint analyze check native bench serve-bench train-bench \
+	train-bench-smoke dryrun mosaic-gate validate clean chaos obs-smoke \
+	obs-top-smoke bench-check
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -44,10 +45,22 @@ obs-top-smoke:
 bench-check:
 	$(PY) tools/bench_history.py --check
 
+# paired per-step vs fused train-loop comparison at the dispatch-
+# dominated harness shape; writes the committed artifact + history line
+train-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/train_bench.py \
+	  --json-out bench_artifacts/train_bench_fused.json
+
+# train-loop fusion plumbing check: tiny paired run, bit-parity asserted
+train-bench-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/train_bench.py --smoke
+
 # fast pre-commit gate: static analysis + style + the fast test subset +
-# the obs plumbing smokes
+# the obs plumbing smokes + the train-loop fusion smoke
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
-check: analyze obs-smoke obs-top-smoke
+check: analyze obs-smoke obs-top-smoke train-bench-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 

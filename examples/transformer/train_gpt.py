@@ -72,6 +72,11 @@ if __name__ == "__main__":
   parser.add_argument("--z_loss", type=float, default=0.0,
                       help="auxiliary logit stabilizer (PaLM/T5X recipe, "
                            "e.g. 1e-4); SPMD path only")
+  parser.add_argument("--unroll", type=int, default=0,
+                      help="fuse K optimizer steps into one dispatch "
+                           "(make_train_loop lax.scan over a [K,B,S] "
+                           "slab; 0 = TOS_TRAIN_UNROLL env, default "
+                           "per-step); SPMD path only")
   args = parser.parse_args()
 
   import time
@@ -202,6 +207,33 @@ if __name__ == "__main__":
           z_loss=args.z_loss)
     return tfm.causal_lm_loss(state.apply_fn({"params": params}, tokens),
                               tokens, z_loss=args.z_loss)
+
+  unroll = SH.resolve_unroll(args.unroll or None)
+  if unroll > 1:
+    # fused multi-step path: K batches stacked into one Slab, K steps
+    # per dispatch, the [K] loss vector fetched once per slab — same
+    # trajectory as per-step (docs/PERFORMANCE.md §Train-loop fusion)
+    import itertools
+    from tensorflowonspark_tpu.data.readers import Slab
+    loop = SH.make_train_loop(loss_fn, mesh, sharding,
+                              batch_extra_axes=(M.AXIS_SEQUENCE,),
+                              unroll=unroll)
+    stream = batch_stream()
+    while loop.steps < args.steps:
+      group = [np.asarray(b, "int32") for b in
+               itertools.islice(stream, min(unroll,
+                                            args.steps - loop.steps))]
+      if not group:
+        break
+      t0 = time.time()
+      # a short tail group still rides the loop (per-step jit entry)
+      state, losses = loop(state, Slab(np.stack(group)))
+      losses = np.asarray(losses)
+      print("steps %d..%d mean loss %.4f (%.0f ms, %d step(s)/dispatch)"
+            % (loop.steps - len(group), loop.steps - 1, losses.mean(),
+               1000 * (time.time() - t0), len(group)))
+    print("done; tokens/step = %d" % (args.batch * args.seq_len))
+    sys.exit(0)
 
   step = SH.make_train_step(loss_fn, mesh, sharding,
                             batch_extra_axes=(M.AXIS_SEQUENCE,))

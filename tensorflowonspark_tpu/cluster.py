@@ -786,7 +786,8 @@ def run(engine: Engine, main_fn, tf_args=None,
         heartbeat_interval: Optional[float] = 5.0,
         supervise: bool = True, max_restarts: int = 2,
         restart_backoff: float = 0.5,
-        restart_backoff_cap: float = 5.0) -> TPUCluster:
+        restart_backoff_cap: float = 5.0,
+        train_unroll: Optional[int] = None) -> TPUCluster:
   """Start a cluster and run ``main_fn(tf_args, ctx)`` on every node.
 
   Signature parity with the reference's ``TFCluster.run``
@@ -805,8 +806,15 @@ def run(engine: Engine, main_fn, tf_args=None,
   backoff between ``restart_backoff`` and ``restart_backoff_cap``
   seconds. Relaunched nodes see ``ctx.restart_count > 0`` and should
   resume via ``ctx.checkpoint_manager(d).restore_or(state)``.
+
+  ``train_unroll=K`` exports ``TOS_TRAIN_UNROLL=K`` into every node so
+  ``parallel.sharding.make_train_loop`` / ``data.readers.slab_batches``
+  default to fusing K optimizer steps per dispatch (1/None = the
+  per-step status quo; see docs/PERFORMANCE.md §Train-loop fusion).
   """
   num_executors = num_executors or engine.num_executors
+  if train_unroll is not None and int(train_unroll) < 1:
+    raise ValueError("train_unroll must be >= 1, got %r" % (train_unroll,))
   if feed_transport == "auto":
     # shared-memory rings require the feeder task and the node to share a
     # host, which only engines with colocated executors guarantee; the
@@ -896,6 +904,10 @@ def run(engine: Engine, main_fn, tf_args=None,
       "feed_chunk_size": feed_chunk_size,
       "shm_capacity": max(shm_capacity, 8 * 1024 * 1024),
       "heartbeat_interval": heartbeat_interval,
+      # fused train loop default: every node exports this as
+      # TOS_TRAIN_UNROLL (node._apply_node_env) so make_train_loop /
+      # slab_batches resolve the cluster's K without per-fn plumbing
+      "train_unroll": int(train_unroll) if train_unroll else None,
   }
 
   # launch node bring-up asynchronously so that (a) feeding can start and

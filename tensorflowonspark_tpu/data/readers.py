@@ -10,9 +10,23 @@ iterator so input never stalls the accelerator.
 
 import itertools
 import logging
-from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+from typing import (Any, Callable, Iterable, Iterator, List, NamedTuple,
+                    Optional, Sequence)
 
 logger = logging.getLogger(__name__)
+
+
+class Slab(NamedTuple):
+  """``unroll`` host batches stacked into one ``[K, B, ...]`` pytree.
+
+  The transport unit of the fused train loop
+  (``parallel.sharding.make_train_loop``): one slab = one jitted
+  ``lax.scan`` dispatch of K optimizer steps. A NamedTuple, so it IS a
+  jax pytree — ``device_prefetch`` / ``jax.device_put`` map straight
+  through it. ``data`` holds the stacked columns (an array, or a dict of
+  arrays under an input_mapping).
+  """
+  data: Any
 
 
 def shard_files(pattern_or_paths, num_shards: int, shard_index: int,
@@ -132,6 +146,52 @@ def feed_batches(feed, batch_size: int, dtype=None) -> Iterator:
         else len(batch)
     if n:
       yield batch
+
+
+def slab_batches(feed, batch_size: int, unroll: Optional[int] = None,
+                 dtype=None) -> Iterator:
+  """Slab generator over a :class:`datafeed.DataFeed` — the fused train
+  loop's canonical source.
+
+  Yields :class:`Slab`\\ s of ``unroll`` stacked ``batch_size`` batches
+  (one columnar assembly + ONE concatenate per column for the whole
+  slab, reshaped for free — ``DataFeed.next_slab_arrays``) until the
+  stream can no longer fill a whole slab; the partial tail (end-of-feed,
+  or a short stretch at an ``EndPartition`` boundary) degrades to plain
+  per-batch yields, which ride the loop's per-step jit entry — batch
+  ORDER is identical to ``feed_batches(feed, batch_size)``, which is
+  what makes the fused trajectory bit-identical to the per-step one.
+  Compose with :func:`device_prefetch` (default ``sharding=None`` —
+  mixed slab/batch items take plain ``device_put``; the jitted loop's
+  ``in_shardings`` place them) so slab k+1 transfers under slab k's
+  compute::
+
+      loop = make_train_loop(loss_fn, mesh, sharding, unroll=K)
+      for item in device_prefetch(slab_batches(feed, B, K), size=2):
+          state, losses = loop(state, item)
+
+  ``unroll=None`` reads ``TOS_TRAIN_UNROLL`` (1 = plain
+  :func:`feed_batches` semantics, wrapped item-for-item).
+  """
+  from tensorflowonspark_tpu.parallel.sharding import resolve_unroll
+  unroll = resolve_unroll(unroll)
+  if unroll <= 1:
+    yield from feed_batches(feed, batch_size, dtype=dtype)
+    return
+  while not feed.should_stop():
+    got = feed.next_slab_arrays(batch_size, unroll, dtype=dtype)
+    if isinstance(got, Slab):
+      yield got
+      continue
+    # partial tail: split into the SAME per-step batches feed_batches
+    # would have produced (full ones first, short remainder last)
+    if isinstance(got, dict):
+      n = len(next(iter(got.values()))) if got else 0
+      for i in range(0, n, batch_size):
+        yield {k: v[i:i + batch_size] for k, v in got.items()}
+    else:
+      for i in range(0, len(got), batch_size):
+        yield got[i:i + batch_size]
 
 
 def device_prefetch(batches: Iterable, size: int = 2,

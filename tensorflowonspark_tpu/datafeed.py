@@ -629,6 +629,42 @@ class DataFeed(object):
       return {k: np.asarray(v, dtype=dtype) for k, v in batch.items()}
     return np.asarray(batch, dtype=dtype)
 
+  def next_slab_arrays(self, batch_size: int, unroll: int, dtype=None):
+    """``unroll`` batches assembled as ONE ``[unroll, batch_size, ...]``
+    slab — the chunk-buffer source of the fused train loop.
+
+    One ``next_batch_arrays(batch_size * unroll)`` call plans the whole
+    stretch over the chunk buffer (still a single concatenate per
+    column; markers keep their exact per-batch semantics — train mode
+    skips ``EndPartition`` inside a slab exactly like per-batch
+    assembly does), and a full stretch reshapes for free into the slab
+    (``data.readers.Slab``). A SHORT stretch (end-of-feed, or an
+    inference-mode partition boundary) returns the flat arrays
+    unchanged, exactly as ``next_batch_arrays`` would — the caller
+    (``data.readers.slab_batches``) splits them back into per-step
+    batches so batch order matches the per-step path bit for bit.
+    """
+    from tensorflowonspark_tpu.data.readers import Slab
+    if unroll <= 1:
+      return self.next_batch_arrays(batch_size, dtype=dtype)
+    want = batch_size * unroll
+    got = self.next_batch_arrays(want, dtype=dtype)
+
+    def _rows(x):
+      if isinstance(x, dict):
+        return len(next(iter(x.values()))) if x else 0
+      return len(x)
+
+    def _stack(arr):
+      # reshape of the freshly-concatenated (contiguous) column: no copy
+      return arr.reshape((unroll, batch_size) + arr.shape[1:])
+
+    if _rows(got) != want:
+      return got
+    if isinstance(got, dict):
+      return Slab({k: _stack(v) for k, v in got.items()})
+    return Slab(_stack(got))
+
 
 def drain_pending_rows(hub, qname: str = "input", settle_rounds: int = 3,
                        settle_timeout: float = 0.1,

@@ -42,6 +42,80 @@ HUB_ADDR_FILE = "hub_addr"
 #: pins the per-node coordinator/collectives port (env registry: TOS008)
 ENV_NODE_PORT = "TOS_TPU_NODE_PORT"
 
+#: directory for JAX's persistent compilation cache, applied at node
+#: bring-up in whichever process runs the user fn — relaunched/persistent
+#: executors then LOAD their jitted programs instead of recompiling them
+#: (cache hits are surfaced as ``xla.cache_hits``, never counted as
+#: fresh compiles — obs/device.py). Unset = no persistent cache.
+#: (env registry: TOS008)
+ENV_COMPILE_CACHE = "TOS_COMPILE_CACHE"
+
+
+def _setup_compile_cache() -> bool:
+  """Point JAX's persistent compilation cache at ``TOS_COMPILE_CACHE``.
+
+  Called at node bring-up in the process that runs the user main fn
+  (both the foreground FILES-mode path and the spawned background
+  runner) BEFORE any jit. Zero work — and no jax import — when the env
+  is unset, so feeder tasks and bare executors never pay it. The
+  min-compile-time / min-entry-size floors drop to 0 so even the small
+  CPU-harness programs cache: the knob's whole point is that a
+  supervised relaunch (or the next run of a persistent executor) skips
+  its recompiles.
+  """
+  cache_dir = os.environ.get(ENV_COMPILE_CACHE)
+  if not cache_dir:
+    return False
+  try:
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+      try:
+        jax.config.update(knob, val)
+      except Exception:  # noqa: BLE001 - knob renamed on this jax
+        pass
+    logger.info("persistent compilation cache at %s", cache_dir)
+    return True
+  except Exception as e:  # noqa: BLE001 - a broken cache dir must not
+    # fail bring-up; the node just compiles as before
+    logger.warning("compilation cache setup failed (%s); continuing "
+                   "without it", e)
+    return False
+
+
+#: env values _apply_node_env exported in THIS (persistent) executor
+#: process — so a later cluster that sets nothing can retract exactly
+#: what a previous cluster exported, while a user's own env pin (a value
+#: we never wrote) still passes through
+_applied_node_env: Dict[str, str] = {}
+
+
+def _apply_node_env(meta: dict) -> None:
+  """Export cluster-level training knobs into this node process's env.
+
+  ``cluster.run(train_unroll=K)`` rides the cluster meta so EVERY node —
+  foreground or spawned background runner (which inherits this env at
+  spawn) — sees the same ``TOS_TRAIN_UNROLL``, which
+  ``parallel.sharding.resolve_unroll`` (and thus
+  ``make_train_loop``/``slab_batches``) reads as its default. An
+  explicit cluster value wins over a stale env; when the cluster sets
+  nothing, an export left behind by a PREVIOUS cluster on this
+  persistent executor is retracted (or run B would silently fuse with
+  run A's K), while a user-set env pin passes through.
+  """
+  from tensorflowonspark_tpu.parallel.sharding import ENV_TRAIN_UNROLL
+  unroll = meta.get("train_unroll")
+  if unroll:
+    _applied_node_env[ENV_TRAIN_UNROLL] = str(int(unroll))
+    os.environ[ENV_TRAIN_UNROLL] = _applied_node_env[ENV_TRAIN_UNROLL]
+  elif _applied_node_env.get(ENV_TRAIN_UNROLL) is not None \
+      and os.environ.get(ENV_TRAIN_UNROLL) == \
+      _applied_node_env[ENV_TRAIN_UNROLL]:
+    os.environ.pop(ENV_TRAIN_UNROLL, None)
+    _applied_node_env.pop(ENV_TRAIN_UNROLL)
+
 
 class TPUNodeContext(object):
   """Per-node metadata handed to the user main fn as ``ctx``.
@@ -288,6 +362,10 @@ def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
   driver's supervisor declares the node dead.
   """
   import cloudpickle
+  # the background runner is the process that jits: point JAX's
+  # persistent compilation cache (TOS_COMPILE_CACHE) here, before the
+  # user fn's first compile
+  _setup_compile_cache()
   hub = feedhub.connect(tuple(hub_addr), authkey)
   sender = None
   if server_addr and heartbeat_interval:
@@ -514,6 +592,10 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
     # 10. run the user main fn per role (parity :417-463)
     if isinstance(tf_args, list):
       sys.argv = [sys.argv[0] if sys.argv else "main"] + list(tf_args)
+    # cluster-level training knobs (train_unroll → TOS_TRAIN_UNROLL)
+    # export here so BOTH the foreground fn and the spawned background
+    # runner (which inherits this env) resolve the same defaults
+    _apply_node_env(meta)
 
     if job_name in BACKGROUND_ROLES or meta["input_mode"] == 1:
       # background execution; foreground either returns (workers, so feeding
@@ -561,6 +643,9 @@ def make_node_fn(main_fn, tf_args, cluster_meta: dict):
       shipper = _start_obs_shipper(meta["server_addr"], executor_id, sender)
       ctx = TPUNodeContext(hub=hub, tmp_socket=tmp_sock, heartbeat=sender,
                            **ctx_kwargs)
+      # foreground workers jit in THIS process: persistent compilation
+      # cache (TOS_COMPILE_CACHE) goes live before the user fn compiles
+      _setup_compile_cache()
       try:
         cloudpickle.loads(fn_bytes)(tf_args, ctx)
         hub.set("state", "stopped")

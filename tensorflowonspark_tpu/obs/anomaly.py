@@ -99,9 +99,9 @@ MIN_WINDOW_STEPS = 5
 MIN_MEM_SAMPLES = 3
 
 #: the cumulative/gauge metric names one detector pass reads per executor
-_SAMPLED = ("train.steps", "feed.batches", "feed.fetch_s", "feed.decode_s",
-            "feed.assemble_s", "xla.compiles", "serve.queue_depth",
-            "serve.occupancy", "device.bytes_in_use")
+_SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
+            "feed.decode_s", "feed.assemble_s", "xla.compiles",
+            "serve.queue_depth", "serve.occupancy", "device.bytes_in_use")
 
 
 def detect_enabled() -> bool:
@@ -273,13 +273,24 @@ class AnomalyDetector(object):
     out = []
     threshold = median * (1.0 - self.straggler_pct / 100.0)
     for eid, rate in rates.items():
-      if rate < threshold:
-        out.extend(self._fire(
-            "straggler", eid, windows[eid][1], now,
-            {"rate": rate, "cluster_median": median,
-             "pct_behind": 100.0 * (1.0 - rate / median) if median else 0.0},
-            "executor %d steps at %.2f/s vs cluster median %.2f/s "
-            "(>%g%% behind)" % (eid, rate, median, self.straggler_pct)))
+      if rate >= threshold:
+        continue
+      # fused-loop burst quantization (make_train_loop): steps arrive K
+      # at a time, so an executor whose slab dispatch straddles the
+      # window edge can show up to one slab (train.unroll) fewer steps
+      # than its healthy peers — being behind by AT MOST one burst is
+      # sampling noise, not straggling
+      dq, span = windows[eid]
+      burst = max(1.0, dq[-1][1].get("train.unroll", 1.0))
+      behind_steps = (median - rate) * span
+      if behind_steps <= burst:
+        continue
+      out.extend(self._fire(
+          "straggler", eid, span, now,
+          {"rate": rate, "cluster_median": median,
+           "pct_behind": 100.0 * (1.0 - rate / median) if median else 0.0},
+          "executor %d steps at %.2f/s vs cluster median %.2f/s "
+          "(>%g%% behind)" % (eid, rate, median, self.straggler_pct)))
     return out
 
   def _check_feed_stall(self, eid, dq, span, now) -> List[dict]:

@@ -60,8 +60,24 @@ COMPILE_MS_BUCKETS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0,
 #: the jax.monitoring duration event one backend compilation emits
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+#: the jax.monitoring instant event one PERSISTENT-cache hit emits
+#: (TOS_COMPILE_CACHE, node._setup_compile_cache). NOTE: jax's
+#: ``_COMPILE_EVENT`` duration event WRAPS compile_or_get_cached, so it
+#: fires on hits too — this instant event fires INSIDE that region, and
+#: each one arms a ``_pending_hits`` discount that absorbs its paired
+#: duration event. Net effect: hits surface as ``xla.cache_hits`` and
+#: never count as fresh compiles (the recompile-storm detector must not
+#: treat a relaunched executor's warm loads as a storm)
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
 _install_lock = threading.Lock()
 _monitoring_hooked = False
+#: persistent-cache hits whose enclosing backend-compile duration event
+#: has not arrived yet: jax's duration event WRAPS compile_or_get_cached,
+#: so it fires for cache hits too — each hit arms one discount so the
+#: paired duration event is counted as a load, not a fresh compile
+_pending_hits = {"n": 0}
+_pending_lock = threading.Lock()
 _cost_seen: set = set()
 _cost_lock = threading.Lock()
 #: sentinel-internal failures (counted, never raised — the tier must not
@@ -86,6 +102,14 @@ def _on_compile_duration(event: str, duration: float, **kwargs) -> None:
   this is one None check per compile — and compiles are rare."""
   if event != _COMPILE_EVENT:
     return
+  with _pending_lock:
+    if _pending_hits["n"] > 0:
+      # this "compile" was a persistent-cache load (the hit event fired
+      # inside the wrapped lookup): already counted as xla.cache_hits,
+      # must not count as a fresh compile or relaunched executors with a
+      # warm TOS_COMPILE_CACHE read as a recompile storm
+      _pending_hits["n"] -= 1
+      return
   reg = metrics_mod.active()
   if reg is None:
     return
@@ -101,12 +125,36 @@ def _on_compile_duration(event: str, duration: float, **kwargs) -> None:
     SENTINEL_ERRORS["count"] += 1
 
 
+def _on_event(event: str, **kwargs) -> None:
+  """jax.monitoring instant-event listener: persistent-cache hits.
+
+  Each hit also arms one compile-duration discount (``_pending_hits``)
+  — the hit fires INSIDE the duration-event region, so the discount is
+  armed before the duration event it must absorb."""
+  if event != _CACHE_HIT_EVENT:
+    return
+  with _pending_lock:
+    _pending_hits["n"] += 1
+  reg = metrics_mod.active()
+  if reg is None:
+    return
+  try:
+    reg.counter("xla.cache_hits").inc()
+  except Exception:  # noqa: BLE001 - telemetry must never break a load
+    SENTINEL_ERRORS["count"] += 1
+
+
 def install_compile_listener() -> bool:
   """Hook jax.monitoring's compile events into the registry (idempotent).
 
-  Returns True when the hook is (already) installed; False when this jax
-  has no usable ``jax.monitoring`` — :func:`note_trace` then counts the
-  global ``xla.compiles`` from our own seams as the fallback.
+  Two listeners: backend-compile durations → ``xla.compiles`` (fresh
+  compiles only — the duration event wraps jax's cache lookup and fires
+  on persistent-cache hits too, so each hit's instant event arms a
+  discount that absorbs its paired duration event) and cache-hit
+  instants → ``xla.cache_hits``.
+  Returns True when the hooks are (already) installed; False when this
+  jax has no usable ``jax.monitoring`` — :func:`note_trace` then counts
+  the global ``xla.compiles`` from our own seams as the fallback.
   """
   global _monitoring_hooked
   with _install_lock:
@@ -115,6 +163,7 @@ def install_compile_listener() -> bool:
     try:
       from jax import monitoring
       monitoring.register_event_duration_secs_listener(_on_compile_duration)
+      monitoring.register_event_listener(_on_event)
     except Exception as e:  # noqa: BLE001 - older jax / stub backends:
       # the tracing-counter fallback still covers our own seams
       logger.info("jax.monitoring unavailable (%s); recompile sentinel "

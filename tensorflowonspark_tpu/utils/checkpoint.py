@@ -68,17 +68,31 @@ class CheckpointManager(object):
     ignored there. For independent single-process nodes (no process
     group), only the chief writes (parity with chief-only export,
     reference compat.py:10-17).
+
+    The save decision is interval-CROSSING, not modulo: a fused train
+    loop calls this once per slab with ``step`` jumping ``unroll`` at a
+    time, and orbax's own ``step % interval == 0`` rule would silently
+    stretch the cadence to the steps' common multiples (``unroll=8``
+    with ``save_interval_steps=5`` would save every 40 steps — or
+    never, for coprime pairs past max step). Here the save fires at the
+    FIRST call whose step reached/passed an interval boundary since the
+    last saved step — step-accurate at slab boundaries, and identical
+    to the old behavior for dense per-step calls.
     """
     import jax
     if not is_chief and jax.process_count() <= 1:
+      return False
+    if not force and not self._due(step):
       return False
     import orbax.checkpoint as ocp
     items = {"state": ocp.args.StandardSave(state)}
     if data_state is not None:
       items["data"] = ocp.args.JsonSave(data_state)
     try:
+      # force=True: the interval decision was made above (orbax's modulo
+      # rule would re-filter boundary-crossing slab steps right back out)
       saved = self._mgr.save(step, args=ocp.args.Composite(**items),
-                             force=force)
+                             force=True)
     except ValueError:
       # a directory written by the pre-composite manager pins orbax to
       # the single-unnamed-item layout; keep appending in that layout
@@ -87,10 +101,29 @@ class CheckpointManager(object):
                        "data_state; saving model state only",
                        self.directory)
       saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                             force=force)
+                             force=True)
     if saved:
       logger.info("checkpoint saved at step %d", step)
     return saved
+
+  def _due(self, step: int) -> bool:
+    """True when ``step`` reached/crossed an interval boundary since the
+    last saved step (always for the first save; never for non-advancing
+    steps). A signalled preemption is always due — taking the interval
+    decision out of orbax's hands must not lose its save-on-preemption
+    behavior for mid-interval steps."""
+    last = self._mgr.latest_step()
+    if last is not None and step <= last:
+      return False
+    # the same call orbax's own should_save made on this path before the
+    # crossing rule replaced it (getattr: older orbax lacks the method)
+    reached = getattr(self._mgr, "reached_preemption", None)
+    if reached is not None and reached(step):
+      return True
+    if last is None:
+      return True
+    interval = max(1, int(self.save_interval_steps))
+    return (step // interval) > (last // interval)
 
   def latest_step(self, refresh: bool = False) -> Optional[int]:
     """Newest checkpointed step, or None.

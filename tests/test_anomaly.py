@@ -491,6 +491,129 @@ class TestDeviceTier:
     assert reg.snapshot()["device.mem_samples"]["value"] == 2
 
 
+class TestStragglerBurstTolerance:
+  """Fused train loops (make_train_loop) land steps K at a time: an
+  executor whose slab dispatch straddles the window edge shows up to one
+  burst fewer steps than its peers — quantization, not straggling. The
+  detector discounts exactly one burst (the shipped ``train.unroll``
+  gauge), no more."""
+
+  def _window(self, det, sink, fast_steps, slow_steps, unroll):
+    sink.set(0, train__steps=0, train__unroll=unroll)
+    sink.set(1, train__steps=0, train__unroll=unroll)
+    det.poll(now=0.0)
+    sink.set(0, train__steps=fast_steps, train__unroll=unroll)
+    sink.set(1, train__steps=slow_steps, train__unroll=unroll)
+    return det.poll(now=10.0)
+
+  def test_behind_by_one_burst_stays_quiet(self):
+    """unroll=8, 10 vs 16 steps: 37.5%... below rate threshold needs
+    >50% behind — use 6 vs 16 (62.5% behind, past the 50% threshold)
+    but with unroll=16 the 10-step gap is within ONE burst: quiet."""
+    sink = FakeSink()
+    det = _detector(sink)
+    assert self._window(det, sink, fast_steps=16, slow_steps=6,
+                        unroll=16) == []
+
+  def test_behind_by_more_than_one_burst_fires(self):
+    """The just-above pair: the same 62.5%-behind executor with
+    unroll=8 is 10 steps behind — more than one burst: fires."""
+    sink = FakeSink()
+    det = _detector(sink)
+    alerts = self._window(det, sink, fast_steps=16, slow_steps=6,
+                          unroll=8)
+    assert [a["alert"] for a in alerts] == ["straggler"]
+    assert alerts[0]["executor_id"] == 1
+
+  def test_no_unroll_metric_means_burst_of_one(self):
+    """Per-step clusters (no train.unroll shipped) keep the old
+    behavior: any >threshold gap beyond a single step fires."""
+    sink = FakeSink()
+    det = _detector(sink)
+    sink.set(0, train__steps=0)
+    sink.set(1, train__steps=0)
+    det.poll(now=0.0)
+    sink.set(0, train__steps=16)
+    sink.set(1, train__steps=6)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["straggler"]
+
+
+class TestFusedLoopDeviceTier:
+  def test_steady_state_fused_loop_zero_postwarmup_compiles(
+      self, clean_active, monkeypatch):
+    """The fused-loop mirror of THE jit-cache pin: slabs + full-size
+    tail batches re-dispatch forever on exactly two cache entries —
+    zero post-warmup compiles globally and at both seams."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    monkeypatch.setenv(metrics.ENV_OBS, "1")
+    reg = metrics.activate()
+    obs_device.install_compile_listener()
+    obs_device.reset_cost_cache()
+    from flax.training import train_state as ts
+    import optax
+    from tensorflowonspark_tpu.data.readers import Slab
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+    from tensorflowonspark_tpu.parallel import sharding
+
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=-1),
+                               devices=jax.devices()[:1])
+
+    def loss_fn(params, batch):
+      pred = batch["x"] @ params["w"]
+      return jnp.mean((pred - batch["y"]) ** 2)
+
+    loop = sharding.make_train_loop(loss_fn, mesh, donate_state=False,
+                                    unroll=4)
+
+    def state():
+      return ts.TrainState.create(apply_fn=None,
+                                  params={"w": jnp.ones((4, 2))},
+                                  tx=optax.sgd(1e-2))
+
+    batch = {"x": np.ones((8, 4), "float32"),
+             "y": np.zeros((8, 2), "float32")}
+    slab = Slab({k: np.stack([v] * 4) for k, v in batch.items()})
+    st = state()
+    st, _ = loop(st, slab)                 # warmup: both entries compile
+    st, _ = loop(st, batch)
+    snap = reg.snapshot()
+    warm_global = snap.get("xla.compiles", {}).get("value", 0)
+    assert snap["xla.compiles.train.loop"]["value"] == 1
+    assert snap["xla.compiles.train.step"]["value"] == 1
+    for _ in range(10):                    # steady state: ZERO compiles
+      st, _ = loop(st, slab)
+      st, losses = loop(st, batch)
+    jax.block_until_ready(losses)
+    snap = reg.snapshot()
+    assert snap.get("xla.compiles", {}).get("value", 0) == warm_global
+    assert snap["xla.compiles.train.loop"]["value"] == 1
+    assert snap["xla.compiles.train.step"]["value"] == 1
+    assert snap["train.steps"]["value"] == 4 + 1 + 10 * 5
+
+  def test_cache_hit_not_counted_as_fresh_compile(self, clean_active):
+    """TOS_COMPILE_CACHE hits fire jax's cache-hit event INSIDE the
+    compile-duration region — the paired duration event must count as a
+    load (xla.cache_hits), never as a fresh compile, or a relaunched
+    executor's warm bring-up reads as a recompile storm."""
+    reg = metrics.activate()
+    # simulate the exact event order jax emits on a persistent-cache hit
+    obs_device._on_event("/jax/compilation_cache/cache_hits")
+    obs_device._on_compile_duration(
+        "/jax/core/compile/backend_compile_duration", 0.01)
+    snap = reg.snapshot()
+    assert snap["xla.cache_hits"]["value"] == 1
+    assert "xla.compiles" not in snap
+    # a duration event WITHOUT a pending hit is a real compile
+    obs_device._on_compile_duration(
+        "/jax/core/compile/backend_compile_duration", 0.01)
+    snap = reg.snapshot()
+    assert snap["xla.compiles"]["value"] == 1
+    assert snap["xla.cache_hits"]["value"] == 1
+
+
 # --- chaos integration -------------------------------------------------------
 
 

@@ -114,6 +114,49 @@ def test_independent_jax_nodes(engine):
     assert float(val) == 14.0
 
 
+def test_train_unroll_knob_reaches_every_node(engine):
+  """cluster.run(train_unroll=K) exports TOS_TRAIN_UNROLL into each node
+  process, so make_train_loop/slab_batches default to the cluster's K
+  without per-fn plumbing (and an explicit argument still wins)."""
+
+  def main_fn(args, ctx):
+    import os as _os
+    from tensorflowonspark_tpu.parallel.sharding import (ENV_TRAIN_UNROLL,
+                                                         resolve_unroll)
+    with open("unroll.txt", "w") as f:
+      f.write("%s|%d|%d" % (_os.environ.get(ENV_TRAIN_UNROLL),
+                            resolve_unroll(), resolve_unroll(2)))
+
+  c = tos_cluster.run(engine, main_fn, input_mode=InputMode.FILES,
+                      reservation_timeout=30, train_unroll=4)
+  assert c.cluster_meta["train_unroll"] == 4
+  c.shutdown(timeout=120)
+  for slot in range(2):
+    path = os.path.join(engine.executor_workdir(slot), "unroll.txt")
+    assert open(path).read() == "4|4|2"
+
+
+def test_train_unroll_validation(engine):
+  with pytest.raises(ValueError):
+    tos_cluster.run(engine, lambda a, c: None, train_unroll=0)
+
+
+def test_apply_node_env_retracts_only_its_own_export(monkeypatch):
+  """A persistent executor must not leak run A's train_unroll into run B
+  (which never opted in) — but a USER-set env pin is not ours to pop."""
+  from tensorflowonspark_tpu import node
+  from tensorflowonspark_tpu.parallel.sharding import ENV_TRAIN_UNROLL
+  monkeypatch.delenv(ENV_TRAIN_UNROLL, raising=False)
+  node._applied_node_env.clear()
+  node._apply_node_env({"train_unroll": 8})       # run A exports
+  assert os.environ[ENV_TRAIN_UNROLL] == "8"
+  node._apply_node_env({"train_unroll": None})    # run B sets nothing
+  assert ENV_TRAIN_UNROLL not in os.environ       # A's export retracted
+  monkeypatch.setenv(ENV_TRAIN_UNROLL, "3")       # user's own pin
+  node._apply_node_env({"train_unroll": None})
+  assert os.environ[ENV_TRAIN_UNROLL] == "3"      # passes through
+
+
 def test_cluster_spec_and_roles(engine):
   def main_fn(args, ctx):
     with open("spec.txt", "w") as f:

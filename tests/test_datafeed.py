@@ -408,3 +408,125 @@ class TestStalledFeedGaugeMirroring:
           pytest.approx(feed.stats["fetch_s"])
     finally:
       obs_metrics.deactivate()
+
+
+class TestSlabFeed:
+  """Slab assembly for the fused train loop: K batches as ONE columnar
+  stretch (still a single concatenate per column), partial tails falling
+  back to flat per-batch arrays, and markers keeping their exact
+  per-batch semantics inside a slab."""
+
+  def _feed_chunks(self, hub, chunks, end=True, **feed_kwargs):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    for chunk in chunks:
+      put_rows_chunk(q, chunk, timeout=5)
+    if end:
+      q.put(None)
+    return DataFeed(hub, pipeline_depth=0, **feed_kwargs)
+
+  def test_slab_spans_chunk_boundaries(self, hub):
+    from tensorflowonspark_tpu.data.readers import Slab
+    chunks = [[(np.full(3, 4 * c + i, np.float32), 4 * c + i)
+               for i in range(4)] for c in range(3)]   # 3 chunks x 4 rows
+    feed = self._feed_chunks(hub, chunks,
+                             input_mapping={"a_x": "x", "b_y": "y"})
+    slab = feed.next_slab_arrays(3, 2)                 # spans chunks 0+1
+    assert isinstance(slab, Slab)
+    assert slab.data["x"].shape == (2, 3, 3)
+    np.testing.assert_array_equal(slab.data["y"],
+                                  np.arange(6).reshape(2, 3))
+    slab = feed.next_slab_arrays(3, 2)                 # chunks 1(tail)+2
+    np.testing.assert_array_equal(slab.data["y"],
+                                  np.arange(6, 12).reshape(2, 3))
+
+  def test_partial_tail_returns_flat_arrays(self, hub):
+    from tensorflowonspark_tpu.data.readers import Slab
+    chunks = [[(np.ones(2, np.float32) * i,) for i in range(10)]]
+    feed = self._feed_chunks(hub, chunks, input_mapping={"only": "x"})
+    slab = feed.next_slab_arrays(2, 4)                 # 8 of 10 rows
+    assert isinstance(slab, Slab) and slab.data["x"].shape == (4, 2, 2)
+    tail = feed.next_slab_arrays(2, 4)                 # 2 rows + marker
+    assert not isinstance(tail, Slab)
+    assert tail["x"].shape == (2, 2)
+    assert feed.should_stop()
+
+  def test_end_partition_inside_slab_skipped_in_train(self, hub):
+    """Train mode skips EndPartition inside a slab stretch exactly like
+    per-batch assembly does."""
+    from tensorflowonspark_tpu.data.readers import Slab
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    put_rows_chunk(q, [(np.float32(i) * np.ones(2),) for i in range(3)],
+                   timeout=5)
+    q.put(EndPartition())
+    put_rows_chunk(q, [(np.float32(10 + i) * np.ones(2),) for i in range(3)],
+                   timeout=5)
+    q.put(None)
+    feed = DataFeed(hub, train_mode=True, pipeline_depth=0,
+                    input_mapping={"only": "x"})
+    slab = feed.next_slab_arrays(3, 2)
+    assert isinstance(slab, Slab)
+    np.testing.assert_array_equal(slab.data["x"][:, :, 0],
+                                  [[0, 1, 2], [10, 11, 12]])
+
+  def test_single_column_no_mapping(self, hub):
+    from tensorflowonspark_tpu.data.readers import Slab
+    chunks = [[np.float32(i) * np.ones(3, np.float32) for i in range(8)]]
+    feed = self._feed_chunks(hub, chunks)
+    slab = feed.next_slab_arrays(2, 4)
+    assert isinstance(slab, Slab)
+    assert isinstance(slab.data, np.ndarray)
+    assert slab.data.shape == (4, 2, 3)
+
+  def test_unroll_one_is_next_batch_arrays(self, hub):
+    chunks = [[(np.float32(i) * np.ones(2),) for i in range(4)]]
+    feed = self._feed_chunks(hub, chunks, input_mapping={"only": "x"})
+    got = feed.next_slab_arrays(2, 1)
+    assert isinstance(got, dict) and got["x"].shape == (2, 2)
+
+  def test_slab_batches_order_matches_feed_batches(self, hub):
+    """slab_batches yields full Slabs then the tail as plain batches —
+    the flattened row order is EXACTLY feed_batches', which is what the
+    fused loop's bit-identical-trajectory contract stands on."""
+    from tensorflowonspark_tpu.data.readers import Slab, slab_batches
+    chunks = [[(np.full(2, 5 * c + i, np.float32), 5 * c + i)
+               for i in range(5)] for c in range(3)]   # 15 rows
+    feed = self._feed_chunks(hub, chunks,
+                             input_mapping={"a_x": "x", "b_y": "y"})
+    items = list(slab_batches(feed, 2, 3))             # 15 rows, B=2, K=3
+    assert [isinstance(i, Slab) for i in items] == \
+        [True, True, False, False]
+    flat = []
+    for item in items:
+      y = item.data["y"] if isinstance(item, Slab) else item["y"]
+      flat.extend(np.asarray(y).reshape(-1).tolist())
+    assert flat == list(range(15))
+    # full slabs of 2x3, then per-batch tail: 2 rows + the 1-row rest
+    assert items[2]["y"].shape == (2,)
+    assert items[3]["y"].shape == (1,)
+
+  def test_slab_batches_unroll_one_passthrough(self, hub):
+    from tensorflowonspark_tpu.data.readers import Slab, slab_batches
+    chunks = [[(np.float32(i) * np.ones(2), i) for i in range(5)]]
+    feed = self._feed_chunks(hub, chunks,
+                             input_mapping={"a_x": "x", "b_y": "y"})
+    items = list(slab_batches(feed, 2, 1))
+    assert all(not isinstance(i, Slab) for i in items)
+    assert [len(i["y"]) for i in items] == [2, 2, 1]
+
+  def test_slab_is_a_pytree_for_device_prefetch(self, hub):
+    """Slab rides device_prefetch/device_put untouched (it IS a jax
+    pytree), so slab k+1 stages under slab k's compute."""
+    import jax
+    from tensorflowonspark_tpu.data.readers import Slab, slab_batches
+    from tensorflowonspark_tpu.datafeed import prefetch_to_device
+    chunks = [[(np.full(2, 4 * c + i, np.float32),)
+               for i in range(4)] for c in range(2)]   # 8 rows
+    feed = self._feed_chunks(hub, chunks, input_mapping={"only": "x"})
+    out = list(prefetch_to_device(slab_batches(feed, 2, 2), size=2))
+    assert len(out) == 2
+    for item in out:
+      assert isinstance(item, Slab)
+      assert isinstance(item.data["x"], jax.Array)
+      assert item.data["x"].shape == (2, 2, 2)

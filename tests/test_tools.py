@@ -194,6 +194,38 @@ class TestFeedBenchSmoke:
       assert stages["columnar_chunks"] == stages["chunks"] > 0
 
 
+class TestTrainBenchSmoke:
+  def test_smoke_runs_and_holds_bit_parity(self):
+    """`train_bench --smoke` drives the REAL fused train loop
+    (make_train_loop + Slab) against the per-step path on CPU: the bench
+    path is tier-1-covered and the fusion's bit-identical-trajectory
+    contract is re-verified on every CI run. The speedup itself is a
+    shape question the full run answers — the smoke shape only asserts
+    parity and result shape."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "train_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "train_fused_speedup"
+    assert result["losses_bit_identical"] is True
+    assert result["per_step_steps_per_sec"] > 0
+    assert result["fused_steps_per_sec"] > 0
+    assert result["speedup_median"] > 0
+    assert len(result["speedup_reps"]) == result["reps"]
+    assert result["unroll"] == 8
+
+
 class TestObsTopSmoke:
   def test_smoke_monitors_live_cluster_through_health_wire(self, tmp_path):
     """`obs_top --smoke` drives a REAL 2-process LocalEngine train run

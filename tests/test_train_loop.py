@@ -1,0 +1,295 @@
+"""Fused multi-step train loop: the dispatch-amortization contract.
+
+The pin this PR exists for: ``make_train_loop(unroll=K)``'s fused
+``lax.scan`` path must produce a BIT-IDENTICAL loss/param trajectory to
+the per-step path given the same batch order — including with
+``optax.MultiSteps`` grad accumulation inside the scan and with the
+state donated. Plus: the partial-final-slab fallback, host-side step
+accounting, the ``TOS_TRAIN_UNROLL`` knob, jit-cache hygiene (exactly
+two entries), and the interval-CROSSING checkpoint cadence that keeps
+``save_interval_steps`` step-accurate at slab boundaries.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tensorflowonspark_tpu.data.readers import Slab  # noqa: E402
+from tensorflowonspark_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from tensorflowonspark_tpu.parallel import sharding  # noqa: E402
+
+
+def _make_problem(grad_accum_steps=1, seed=0):
+  """A tiny learnable regression + TrainState factory (fresh copies per
+  call — the fused path donates its state buffers)."""
+  import optax
+  from flax.training import train_state as ts
+  from tensorflowonspark_tpu import optim
+
+  rng = np.random.RandomState(seed)
+  w_true = rng.rand(4, 2).astype("float32")
+  params0 = {"w": jnp.asarray(rng.rand(4, 2).astype("float32"))}
+  if grad_accum_steps > 1:
+    tx = optim.make_optimizer(learning_rate=0.05, weight_decay=0.0,
+                              grad_accum_steps=grad_accum_steps)
+  else:
+    tx = optax.adam(0.05)
+
+  def fresh_state():
+    return ts.TrainState.create(
+        apply_fn=None, params=jax.tree.map(jnp.array, params0), tx=tx)
+
+  def loss_fn(params, batch):
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+  def make_batches(n, batch_size=8):
+    out = []
+    for _ in range(n):
+      x = rng.rand(batch_size, 4).astype("float32")
+      out.append({"x": x, "y": x @ w_true})
+    return out
+
+  return fresh_state, loss_fn, make_batches
+
+
+def _stack(batches):
+  return Slab({k: np.stack([b[k] for b in batches])
+               for k in batches[0]})
+
+
+def _params_equal(a, b):
+  eq = jax.tree.map(
+      lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))), a, b)
+  return all(jax.tree.leaves(eq))
+
+
+@pytest.fixture()
+def mesh():
+  return mesh_lib.build_mesh(mesh_lib.MeshSpec(data=-1),
+                             devices=jax.devices()[:1])
+
+
+class TestTrajectoryParity:
+  @pytest.mark.parametrize("donate", [True, False])
+  def test_fused_matches_per_step_bitwise(self, mesh, donate):
+    """Same batch order in => bit-identical losses AND params out."""
+    fresh_state, loss_fn, make_batches = _make_problem()
+    batches = make_batches(12)
+
+    loop1 = sharding.make_train_loop(loss_fn, mesh, unroll=1,
+                                     donate_state=donate)
+    state = fresh_state()
+    losses1 = []
+    for b in batches:
+      state, losses = loop1(state, b)
+      losses1.extend(np.asarray(losses).tolist())
+    params1 = jax.tree.map(np.asarray, state.params)
+
+    loopk = sharding.make_train_loop(loss_fn, mesh, unroll=4,
+                                     donate_state=donate)
+    state = fresh_state()
+    lossesk = []
+    for i in range(0, 12, 4):
+      state, losses = loopk(state, _stack(batches[i:i + 4]))
+      lossesk.extend(np.asarray(losses).tolist())
+    assert lossesk == losses1
+    assert _params_equal(state.params, params1)
+    # the trajectory moved (the problem is learnable, not degenerate)
+    assert losses1[-1] < losses1[0]
+
+  def test_grad_accum_multisteps_composes_inside_scan(self, mesh):
+    """optax.MultiSteps accumulates across scanned steps exactly as it
+    does across per-step calls: k scanned micro-steps = one real update,
+    and the whole trajectory stays bit-identical."""
+    fresh_state, loss_fn, make_batches = _make_problem(grad_accum_steps=2)
+    batches = make_batches(8)
+
+    loop1 = sharding.make_train_loop(loss_fn, mesh, unroll=1,
+                                     donate_state=False)
+    state = fresh_state()
+    losses1 = []
+    for b in batches:
+      state, losses = loop1(state, b)
+      losses1.extend(np.asarray(losses).tolist())
+    params1 = jax.tree.map(np.asarray, state.params)
+
+    loopk = sharding.make_train_loop(loss_fn, mesh, unroll=4,
+                                     donate_state=True)
+    state = fresh_state()
+    lossesk = []
+    for i in range(0, 8, 4):
+      state, losses = loopk(state, _stack(batches[i:i + 4]))
+      lossesk.extend(np.asarray(losses).tolist())
+    assert lossesk == losses1
+    assert _params_equal(state.params, params1)
+
+  def test_partial_final_slab_rides_per_step_path(self, mesh):
+    """A stream of 2 full slabs + 3 tail batches (what slab_batches
+    yields at end-of-feed) matches the pure per-step trajectory."""
+    fresh_state, loss_fn, make_batches = _make_problem()
+    batches = make_batches(11)
+
+    loop1 = sharding.make_train_loop(loss_fn, mesh, unroll=1,
+                                     donate_state=False)
+    state = fresh_state()
+    losses1 = []
+    for b in batches:
+      state, losses = loop1(state, b)
+      losses1.extend(np.asarray(losses).tolist())
+    params1 = jax.tree.map(np.asarray, state.params)
+
+    loopk = sharding.make_train_loop(loss_fn, mesh, unroll=4,
+                                     donate_state=False)
+    state = fresh_state()
+    lossesk = []
+    items = [_stack(batches[0:4]), _stack(batches[4:8])] + batches[8:]
+    for item in items:
+      state, losses = loopk(state, item)
+      lossesk.extend(np.asarray(losses).tolist())
+    assert lossesk == losses1
+    assert _params_equal(state.params, params1)
+    assert loopk.steps == 11
+
+
+class TestLoopMechanics:
+  def test_steps_accounting(self, mesh):
+    fresh_state, loss_fn, make_batches = _make_problem()
+    loop = sharding.make_train_loop(loss_fn, mesh, unroll=4,
+                                    donate_state=False)
+    state = fresh_state()
+    state, losses = loop(state, _stack(make_batches(4)))
+    assert losses.shape == (4,)
+    assert loop.steps == 4
+    state, losses = loop(state, make_batches(1)[0])
+    assert losses.shape == (1,)
+    assert loop.steps == 5
+
+  def test_unroll_one_is_per_step(self, mesh):
+    fresh_state, loss_fn, make_batches = _make_problem()
+    loop = sharding.make_train_loop(loss_fn, mesh, unroll=1,
+                                    donate_state=False)
+    assert loop._fused is None
+    state = fresh_state()
+    state, losses = loop(state, make_batches(1)[0])
+    assert losses.shape == (1,)
+
+  def test_mismatched_slab_falls_back(self, mesh):
+    """A slab whose leading dim isn't the loop's unroll unstacks onto
+    the per-step jit entry instead of compiling a new fused shape."""
+    fresh_state, loss_fn, make_batches = _make_problem()
+    loop = sharding.make_train_loop(loss_fn, mesh, unroll=4,
+                                    donate_state=False)
+    state = fresh_state()
+    state, losses = loop(state, _stack(make_batches(2)))
+    assert losses.shape == (2,)
+    assert loop.steps == 2
+
+  def test_resolve_unroll_env_and_validation(self, monkeypatch):
+    monkeypatch.delenv(sharding.ENV_TRAIN_UNROLL, raising=False)
+    assert sharding.resolve_unroll() == 1
+    assert sharding.resolve_unroll(6) == 6
+    monkeypatch.setenv(sharding.ENV_TRAIN_UNROLL, "8")
+    assert sharding.resolve_unroll() == 8
+    assert sharding.resolve_unroll(2) == 2      # explicit beats env
+    monkeypatch.setenv(sharding.ENV_TRAIN_UNROLL, "junk")
+    assert sharding.resolve_unroll() == 1       # malformed -> status quo
+    monkeypatch.setenv(sharding.ENV_TRAIN_UNROLL, "0")
+    assert sharding.resolve_unroll() == 1       # env 0 = per-step (the
+    # CLI "--unroll 0" convention), never a cluster-wide crash
+    with pytest.raises(ValueError):
+      sharding.resolve_unroll(0)                # explicit 0 IS a bug
+
+  def test_jit_cache_stays_at_two_entries(self, mesh, monkeypatch):
+    """Full slabs + full-size tail batches: exactly one fused trace and
+    one per-step trace — the contract that keeps steady-state compiles
+    at zero (obs.device per-seam trace counters are the witness)."""
+    from tensorflowonspark_tpu.obs import metrics
+    monkeypatch.setenv(metrics.ENV_OBS, "1")
+    reg = metrics.activate()
+    try:
+      fresh_state, loss_fn, make_batches = _make_problem()
+      loop = sharding.make_train_loop(loss_fn, mesh, unroll=4,
+                                      donate_state=False)
+      state = fresh_state()
+      for _ in range(3):
+        state, _ = loop(state, _stack(make_batches(4)))
+      for b in make_batches(3):
+        state, losses = loop(state, b)
+      jax.block_until_ready(losses)
+      snap = reg.snapshot()
+      assert snap["xla.compiles.train.loop"]["value"] == 1
+      assert snap["xla.compiles.train.step"]["value"] == 1
+      # the loop advertises its burst size for the straggler detector
+      assert snap["train.unroll"]["value"] == 4
+      assert snap["train.steps"]["value"] == 15
+    finally:
+      metrics.deactivate()
+
+
+class TestCheckpointCadenceAtSlabBoundaries:
+  """``save_interval_steps`` must not silently stretch when steps arrive
+  K at a time: the save fires at the FIRST slab boundary at/past each
+  interval crossing (orbax's modulo rule would save every lcm(K, N))."""
+
+  @pytest.fixture()
+  def mgr_of(self, tmp_path):
+    mgrs = []
+
+    def make(interval):
+      from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
+      m = CheckpointManager(str(tmp_path), save_interval_steps=interval)
+      mgrs.append(m)
+      return m
+
+    yield make
+    for m in mgrs:
+      m.wait()
+
+  def test_unroll_8_interval_5_saves_every_slab(self, mgr_of):
+    mgr = mgr_of(5)
+    state = {"w": np.ones((2,), "float32")}
+    saved = [s for s in range(8, 41, 8) if mgr.save(s, state)]
+    # every slab boundary crosses a 5-interval: all save (the modulo
+    # rule would have saved only at 40)
+    assert saved == [8, 16, 24, 32, 40]
+
+  def test_unroll_2_interval_5_crossings_only(self, mgr_of):
+    mgr = mgr_of(5)
+    state = {"w": np.ones((2,), "float32")}
+    saved = [s for s in range(2, 21, 2) if mgr.save(s, state)]
+    # first save, then the first boundary at/past 5, 10, 15, 20
+    assert saved == [2, 6, 10, 16, 20]
+
+  def test_dense_per_step_cadence_unchanged(self, mgr_of):
+    mgr = mgr_of(5)
+    state = {"w": np.ones((2,), "float32")}
+    saved = [s for s in range(1, 16) if mgr.save(s, state)]
+    assert saved == [1, 5, 10, 15]
+
+  def test_non_advancing_step_never_saves(self, mgr_of):
+    mgr = mgr_of(5)
+    state = {"w": np.ones((2,), "float32")}
+    assert mgr.save(8, state)
+    assert not mgr.save(8, state)
+    assert not mgr.save(7, state)
+    # force bypasses the interval, not the monotonicity of orbax steps
+    assert mgr.save(9, state, force=True)
+
+  def test_preemption_forces_mid_interval_save(self, mgr_of, monkeypatch):
+    """Taking the interval decision away from orbax must NOT lose its
+    save-on-preemption behavior: a signalled preemption saves even at a
+    mid-interval step."""
+    mgr = mgr_of(100)
+    state = {"w": np.ones((2,), "float32")}
+    assert mgr.save(8, state)                     # first save
+    assert not mgr.save(12, state)                # mid-interval: skipped
+    monkeypatch.setattr(mgr._mgr, "reached_preemption", lambda step: True)
+    assert mgr.save(16, state)                    # preempted: saved

@@ -36,6 +36,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 #: default refresh cadence (seconds); also the rate-delta base
 DEFAULT_INTERVAL = 2.0
 
+#: how many polls the step/token rate window retains: fused train loops
+#: (TOS_TRAIN_UNROLL) land steps K at a time, so a single-poll delta
+#: flaps between 0 and 2K/dt when the slab cadence beats against the
+#: poll cadence — rating over the retained window reads steadily
+RATE_WINDOW_POLLS = 8
+
 _ANSI_CLEAR = "\x1b[H\x1b[2J"
 
 
@@ -73,12 +79,25 @@ def _rate(cur, prev, name, dt):
   return max(0.0, (b - a) / dt)
 
 
+def _series_rate(hist, idx):
+  """Rate over the oldest→newest retained samples carrying this metric
+  (``hist`` rows are ``(t, steps, tokens)``; ``idx`` picks the column).
+  Window-based so K-at-a-time step bursts don't flap the display."""
+  pts = [(t, row[idx]) for t, *row in hist if row[idx] is not None]
+  if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+    return None
+  return max(0.0, (pts[-1][1] - pts[0][1]) / (pts[-1][0] - pts[0][0]))
+
+
 def build_snapshot(reply, prev=None, dt=0.0):
   """Digest one HEALTH reply (+ the previous poll) into the render/JSON
   model: per-executor rows with rates where two samples exist."""
   liveness = reply.get("data") or {}
   obs = reply.get("obs") or {}
   alerts = reply.get("alerts")
+  now = time.time()
+  prev_series = (prev or {}).get("series") or {}
+  series = {}
   rows = {}
   for eid in sorted(set(liveness) | set(obs), key=lambda x: int(x)):
     live = liveness.get(eid) or {}
@@ -92,6 +111,11 @@ def build_snapshot(reply, prev=None, dt=0.0):
       if r is not None:
         # seconds-per-second inside the stage = fraction of wall time
         stage_rates[s] = r
+    # step/token rates come from the retained multi-poll window, not the
+    # last pair: fused loops deliver steps in K-bursts (TOS_TRAIN_UNROLL)
+    hist = list(prev_series.get(eid, []))
+    hist.append((now, m.get("train.steps"), m.get("serve.tokens")))
+    series[eid] = hist[-RATE_WINDOW_POLLS:]
     rows[eid] = {
         "state": live.get("state"),
         "beat_age": live.get("age"),
@@ -100,8 +124,8 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "pid": ex.get("pid"),
         "ships": ex.get("ships"),
         "metrics": m,
-        "step_rate": _rate({"metrics": m}, pobs, "train.steps", dt),
-        "token_rate": _rate({"metrics": m}, pobs, "serve.tokens", dt),
+        "step_rate": _series_rate(series[eid], 0),
+        "token_rate": _series_rate(series[eid], 1),
         "feed_stage_frac": stage_rates,
         "occupancy": m.get("serve.occupancy"),
         "queue_depth": m.get("serve.queue_depth"),
@@ -112,7 +136,7 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "clock_rtt_ms": m.get("clock.rtt_ms"),
         "alerts": m.get("obs.alerts"),
     }
-  return {"t": time.time(), "executors": rows, "alerts": alerts,
+  return {"t": now, "executors": rows, "alerts": alerts, "series": series,
           "has_obs": bool(obs), "has_alert_ring": alerts is not None}
 
 

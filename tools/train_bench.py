@@ -1,0 +1,210 @@
+"""Per-step vs fused train loop: what does dispatch amortization buy?
+
+The per-step training path (`parallel.sharding.make_train_step`) pays
+one host dispatch, one host→device batch transfer and one metrics sync
+per optimizer step; at dispatch-dominated step times that overhead is
+the step time (the serving bench proved the same effect on the decode
+side — fusing a horizon bought 1.78x). This bench runs the SAME batches
+through both paths of `parallel.sharding.make_train_loop`:
+
+- ``per-step``: one ``loop(state, batch)`` dispatch per optimizer step,
+  loss harvested per step — the status-quo loop every example runs
+  (StepTimer semantics: block on the loss inside the step region);
+- ``fused``: ``unroll`` batches stacked into one ``data.readers.Slab``,
+  one jitted ``lax.scan`` dispatch per slab, the ``[unroll]`` loss
+  vector harvested once per slab.
+
+Both paths pay their host→device transfer per dispatch (one device_put
+per batch vs one per slab) — the three per-step costs the fusion
+amortizes. Data is pre-staged host-side so the feed plane stays out of
+the measurement (feed overhead is `feed_bench`'s job); batches are
+DISTINCT so the loss trajectory moves, and the bench asserts the fused
+trajectory is BIT-IDENTICAL to the per-step one on every rep — the
+fusion contract, re-verified on each run.
+
+Methodology (feed_bench/serve_bench house rules): PAIRED reps — each
+rep times per-step then fused back to back so this box's CPU throttling
+hits both sides of a ratio equally; the headline is the MEDIAN rep's
+speedup; core pinning keeps XLA on one core. Prints ONE JSON line;
+``--json-out`` additionally writes it to a file and appends a
+``train_bench`` series line to ``bench_artifacts/history.jsonl``.
+
+Usage:  python tools/train_bench.py [--steps 320] [--batch 16]
+                                    [--unroll 8] [--reps 3] [--smoke]
+                                    [--json-out PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from statistics import median as _median
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu.obs import metrics as obs_metrics  # noqa: E402
+from tools.feed_bench import _pin_to_core  # noqa: E402 - one pin impl
+
+
+def _build(hidden: int, batch: int, unroll: int, steps: int, seed: int = 0):
+  """The dispatch-dominated harness: a small MLP train step + pre-staged
+  host batches (distinct per step, shared by both paths)."""
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from flax import linen as nn
+  from flax.training import train_state
+  from tensorflowonspark_tpu.data.readers import Slab
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+  class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+      x = nn.Dense(hidden)(x)
+      x = nn.relu(x)
+      return nn.Dense(10)(x)
+
+  model = MLP()
+  params0 = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 784)))["params"]
+
+  def fresh_state():
+    # the fused path donates its state: every run needs its own copies
+    params = jax.tree.map(jnp.array, params0)
+    return train_state.TrainState.create(apply_fn=model.apply,
+                                         params=params, tx=optax.sgd(0.01))
+
+  def loss_fn(p, b):
+    logits = model.apply({"params": p}, b["x"])
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, b["y"]).mean()
+
+  rng = np.random.RandomState(seed)
+  batches = [{"x": rng.rand(batch, 784).astype("float32"),
+              "y": rng.randint(0, 10, batch).astype("int32")}
+             for _ in range(steps)]
+  slabs = [Slab({k: np.stack([batches[i + j][k] for j in range(unroll)])
+                 for k in ("x", "y")})
+           for i in range(0, steps, unroll)]
+  # one device regardless of XLA_FLAGS device-count overrides: the bench
+  # measures dispatch amortization, not cross-device collectives
+  mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=-1),
+                             devices=jax.devices()[:1])
+  return fresh_state, loss_fn, mesh, batches, slabs
+
+
+def _run_path(loop, fresh_state, items, per: int):
+  """Time one path; returns (steps/sec, loss trajectory as a list).
+
+  Every dispatch pays its own host→device transfer (device_put of the
+  host batch/slab) and its own loss harvest (block_until_ready) — the
+  per-step status quo semantics on both sides, so the ratio isolates
+  what fusing K dispatches into one buys.
+  """
+  import numpy as np
+  import jax
+  state = fresh_state()
+  # warmup: compile outside the timed window
+  state, losses = loop(state, jax.device_put(items[0]))
+  jax.block_until_ready(losses)
+  state = fresh_state()
+  traj = []
+  n = 0
+  t0 = time.perf_counter()
+  for item in items:
+    state, losses = loop(state, jax.device_put(item))
+    traj.append(np.asarray(losses))
+    n += per
+  dt = time.perf_counter() - t0
+  return n / dt, [float(v) for arr in traj for v in arr.reshape(-1)]
+
+
+def run_pair(hidden, batch, unroll, steps):
+  """One paired rep: per-step then fused over the SAME batches."""
+  from tensorflowonspark_tpu.parallel import sharding as SH
+
+  fresh_state, loss_fn, mesh, batches, slabs = _build(hidden, batch,
+                                                      unroll, steps)
+  loop1 = SH.make_train_loop(loss_fn, mesh, unroll=1, donate_state=True)
+  loopk = SH.make_train_loop(loss_fn, mesh, unroll=unroll,
+                             donate_state=True)
+  rate1, traj1 = _run_path(loop1, fresh_state, batches, 1)
+  ratek, trajk = _run_path(loopk, fresh_state, slabs, unroll)
+  return rate1, ratek, traj1 == trajk
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--steps", type=int, default=320,
+                  help="optimizer steps per timed run (multiple of unroll)")
+  ap.add_argument("--batch", type=int, default=16)
+  ap.add_argument("--hidden", type=int, default=128)
+  ap.add_argument("--unroll", type=int, default=8,
+                  help="fused steps per dispatch (the K under test)")
+  ap.add_argument("--reps", type=int, default=3,
+                  help="paired repetitions (median rep reported)")
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny run (CPU CI / plumbing check)")
+  ap.add_argument("--json-out", default=None,
+                  help="additionally write the JSON result to this path")
+  args = ap.parse_args()
+  if args.smoke or os.environ.get("TOS_BENCH_SMOKE"):
+    args.steps, args.batch, args.hidden, args.reps = 32, 16, 64, 1
+  if args.steps % args.unroll:
+    args.steps += args.unroll - args.steps % args.unroll
+  _pin_to_core(0)   # before jax's first use so XLA threads inherit it
+  if obs_metrics.enabled():
+    # price the device tier exactly like an obs-enabled cluster process
+    from tensorflowonspark_tpu.obs import device as obs_device
+    obs_device.install_compile_listener()
+
+  per_step, fused, speedups = [], [], []
+  parity = True
+  for _ in range(max(1, args.reps)):
+    r1, rk, bit_identical = run_pair(args.hidden, args.batch, args.unroll,
+                                     args.steps)
+    per_step.append(r1)
+    fused.append(rk)
+    speedups.append(rk / r1)
+    parity = parity and bit_identical
+
+  result = {
+      "metric": "train_fused_speedup",
+      "speedup_median": round(_median(speedups), 3),
+      "speedup_reps": [round(s, 3) for s in speedups],
+      "per_step_steps_per_sec": round(_median(per_step), 2),
+      "fused_steps_per_sec": round(_median(fused), 2),
+      "losses_bit_identical": parity,
+      "unroll": args.unroll,
+      "batch": args.batch,
+      "hidden": args.hidden,
+      "steps": args.steps,
+      "reps": args.reps,
+      "obs": int(obs_metrics.enabled()),
+      "note": "speedup = fused/per-step steps/s per PAIRED rep, median "
+              "rep reported; both paths pay per-dispatch device_put + "
+              "loss harvest; losses_bit_identical re-verifies the fusion "
+              "contract (same batches => same trajectory, bitwise) on "
+              "every rep.",
+  }
+  line = json.dumps(result)
+  print(line)
+  if not parity:
+    sys.stderr.write("FUSED TRAJECTORY DIVERGED FROM PER-STEP\n")
+    return 1
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    bench_history.append_record(
+        "train_bench", result["fused_steps_per_sec"],
+        "u%d-b%d-h%d-s%d" % (args.unroll, args.batch, args.hidden,
+                             args.steps),
+        extra={"speedup": result["speedup_median"],
+               "obs": result["obs"]})
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
