@@ -5,8 +5,8 @@
 PY ?= python
 
 .PHONY: test lint analyze check native bench serve-bench train-bench \
-	train-bench-smoke dryrun mosaic-gate validate clean chaos obs-smoke \
-	obs-top-smoke bench-check
+	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
+	serve-bench-chaos obs-smoke obs-top-smoke bench-check
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -72,6 +72,20 @@ test: analyze
 # are tier-1, not slow)
 chaos:
 	$(PY) -m pytest tests/ -q -m chaos
+
+# serving-plane fault injection only (TOS_CHAOS_SERVE): crash-replay
+# bit-parity, stream dedup, poison isolation, stall-driven deadlines —
+# docs/ROBUSTNESS.md; also tier-1 (not slow)
+chaos-serve:
+	$(PY) -m pytest tests/test_serving.py -q -m chaos
+
+# degraded goodput + recovery latency under injected serving faults,
+# paired against a clean pass (parity re-verified); writes the artifact
+# + a serve_bench_chaos history line
+serve-bench-chaos:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --chaos \
+	  --json-out bench_artifacts/serve_bench_chaos.json
 
 native:
 	$(MAKE) -C native

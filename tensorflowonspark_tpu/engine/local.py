@@ -304,7 +304,9 @@ class LocalEngine(Engine):
       pass   # fence: a monitor-thread respawn in flight completes first
     for tq in self._task_qs:
       try:
-        tq.put(_STOP)
+        # bounded: a wedged/full task queue must not hang driver shutdown
+        # — the executor process is terminated below regardless
+        tq.put(_STOP, timeout=5)
       except Exception:  # noqa: BLE001
         pass
     for p in self._procs:

@@ -1202,6 +1202,11 @@ _SERVING_MESH_CACHE = {}
 # thread + device arrays and must never ride a pickled bundle)
 _SERVING_ENGINE_CACHE = {}
 
+# how long a cached-engine rebuild waits for the old engine to finish its
+# accepted requests before stopping it (ServingEngine.drain — a param
+# swap must shed zero accepted work; docs/ROBUSTNESS.md)
+_SERVING_ENGINE_DRAIN_TIMEOUT = 60.0
+
 
 def _prompt_rows(prompts):
   """Normalize a predict-fn prompt column to (rows, ragged?).
@@ -1298,9 +1303,20 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
     if cached is not None and cached[0] is params and cached[1].alive:
       return cached[1]
     if cached is not None:
-      cached[1].stop()
+      # rolling rebuild: drain finishes every request the old engine
+      # already accepted (bounded), THEN stops it — in-flight work from
+      # concurrent transform partitions is never shed. A dead engine
+      # drains instantly (its loop cannot make progress).
+      cached[1].drain(timeout=_self._SERVING_ENGINE_DRAIN_TIMEOUT)
+    # admission bounds OFF for this internal path: the transform feed is
+    # already bounded (yield_batch caps rows per predict call) and has
+    # no retry story — the client-facing TOS_SERVE_MAX_QUEUE* defaults
+    # would turn a big ragged partition into a hard failure that the
+    # pre-robustness engine served fine. Direct ServingEngine users
+    # keep the bounds.
     eng = ServingEngine(params, cfg, num_slots=num_slots, eos_id=eos_id,
                         pad_id=pad_id, max_new_tokens=num_steps,
+                        max_queue=0, max_queued_tokens=0,
                         mesh=_mesh()).start()
     _self._SERVING_ENGINE_CACHE[key] = (params, eng)
     return eng

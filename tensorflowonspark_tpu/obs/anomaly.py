@@ -23,6 +23,11 @@ catalogue every pass:
 ``serving_saturated`` request queue depth at/over ``TOS_OBS_QUEUE_SAT`` with
                     slot occupancy ~1: the engine is goodput-bound, admit
                     fewer or add slots
+``serve_crash_loop`` ``serve.engine_restarts`` advanced by
+                    ``TOS_OBS_CRASH_LOOP`` or more inside the window: the
+                    serving engine is crash-replaying repeatedly — a poison
+                    request slipped past detection, or the device/runtime
+                    is genuinely failing (docs/ROBUSTNESS.md)
 ``mem_slope``       ``device.bytes_in_use`` grew monotonically by more than
                     ``TOS_OBS_MEM_SLOPE_PCT`` percent across the window (a
                     leak-shaped creep toward OOM)
@@ -75,6 +80,9 @@ ENV_OBS_COMPILE_WARMUP = "TOS_OBS_COMPILE_WARMUP"
 ENV_OBS_FEED_STALL_FRAC = "TOS_OBS_FEED_STALL_FRAC"
 #: serving saturation: queue depth at/over this with occupancy ~1 (TOS008)
 ENV_OBS_QUEUE_SAT = "TOS_OBS_QUEUE_SAT"
+#: serve crash loop FIRES AT/ABOVE this many engine restarts per window
+#: (TOS008)
+ENV_OBS_CRASH_LOOP = "TOS_OBS_CRASH_LOOP"
 #: memory slope: percent in-use growth across the window that fires (TOS008)
 ENV_OBS_MEM_SLOPE_PCT = "TOS_OBS_MEM_SLOPE_PCT"
 #: per-(kind, executor) refire suppression in seconds (TOS008)
@@ -87,6 +95,7 @@ _DEFAULT_RECOMPILE_LIMIT = 3
 _DEFAULT_COMPILE_WARMUP = 120.0
 _DEFAULT_FEED_STALL_FRAC = 0.6
 _DEFAULT_QUEUE_SAT = 8
+_DEFAULT_CRASH_LOOP = 2
 _DEFAULT_MEM_SLOPE_PCT = 10.0
 _DEFAULT_COOLDOWN = 30.0
 
@@ -101,7 +110,9 @@ MIN_MEM_SAMPLES = 3
 #: the cumulative/gauge metric names one detector pass reads per executor
 _SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
             "feed.decode_s", "feed.assemble_s", "xla.compiles",
-            "serve.queue_depth", "serve.occupancy", "device.bytes_in_use")
+            "serve.queue_depth", "serve.occupancy",
+            "serve.engine_restarts", "serve.replays",
+            "device.bytes_in_use")
 
 
 def detect_enabled() -> bool:
@@ -162,6 +173,8 @@ class AnomalyDetector(object):
     self.feed_stall_frac = _env_float(ENV_OBS_FEED_STALL_FRAC,
                                       _DEFAULT_FEED_STALL_FRAC)
     self.queue_sat = _env_float(ENV_OBS_QUEUE_SAT, _DEFAULT_QUEUE_SAT)
+    self.crash_loop_limit = _env_float(ENV_OBS_CRASH_LOOP,
+                                       _DEFAULT_CRASH_LOOP)
     self.mem_slope_pct = _env_float(ENV_OBS_MEM_SLOPE_PCT,
                                     _DEFAULT_MEM_SLOPE_PCT)
     self.cooldown = _env_float(ENV_OBS_ALERT_COOLDOWN, _DEFAULT_COOLDOWN)
@@ -249,6 +262,7 @@ class AnomalyDetector(object):
         new.extend(self._check_feed_stall(eid, dq, span, now))
         new.extend(self._check_recompiles(eid, dq, span, now))
         new.extend(self._check_serving(eid, dq, span, now))
+        new.extend(self._check_serve_crash_loop(eid, dq, span, now))
         new.extend(self._check_mem_slope(eid, dq, span, now))
     except Exception:  # noqa: BLE001 - the detector must outlive any
       # single evaluation bug; failures are counted and visible
@@ -345,6 +359,20 @@ class AnomalyDetector(object):
         {"queue_depth": depth, "occupancy": occ},
         "executor %d serving at occupancy %.2f with %d queued request(s) "
         "— goodput-bound; add slots or shed load" % (eid, occ, int(depth)))
+
+  def _check_serve_crash_loop(self, eid, dq, span, now) -> List[dict]:
+    d = self._delta(dq, "serve.engine_restarts")
+    if d is None or d < self.crash_loop_limit:
+      return []
+    replays = self._delta(dq, "serve.replays") or 0.0
+    return self._fire(
+        "serve_crash_loop", eid, span, now,
+        {"restarts": d, "replays": replays,
+         "total_restarts": dq[-1][1].get("serve.engine_restarts", 0.0)},
+        "executor %d serving engine restarted %d time(s) in the last "
+        "%.0fs (%d request replays) — crash-looping: a poison request "
+        "slipped past detection, or the device/runtime is failing"
+        % (eid, int(d), span, int(replays)))
 
   def _check_mem_slope(self, eid, dq, span, now) -> List[dict]:
     series = [(t, v["device.bytes_in_use"]) for t, v in dq

@@ -351,6 +351,8 @@ class ObsSink(object):
       "feed.assemble_s",
       "serve.tokens", "serve.completed", "serve.occupancy",
       "serve.queue_depth", "serve.slots_active",
+      "serve.rejected", "serve.expired", "serve.cancelled",
+      "serve.replays", "serve.engine_restarts",
       "xla.compiles",
       "device.bytes_in_use", "device.peak_bytes", "device.bytes_limit",
       "clock.offset_ms", "clock.rtt_ms", "clock.samples",

@@ -3,21 +3,29 @@
 Public surface:
 
 * :class:`~tensorflowonspark_tpu.serving.engine.ServingEngine` — the
-  runtime: submit/poll/stream/generate over a persistent slot slab.
+  runtime: submit/poll/stream/generate over a persistent slot slab,
+  plus the self-healing surface — admission control
+  (:class:`ServingOverloaded`), per-request deadlines
+  (:class:`DeadlineExceeded`) and ``cancel(rid)``
+  (:class:`RequestCancelled`), crash-replay recovery with poison
+  detection (:class:`PoisonedRequest`), and graceful ``drain(timeout)``.
 * :class:`~tensorflowonspark_tpu.serving.slots.SlotDecoder` /
   :func:`~tensorflowonspark_tpu.serving.slots.chunk_plan` — the jitted
   device ops and the bucketed-prefill policy.
 * :class:`~tensorflowonspark_tpu.serving.scheduler.Request` /
   :class:`~tensorflowonspark_tpu.serving.scheduler.RequestQueue` — the
-  host-side bookkeeping.
+  host-side bookkeeping (bounded, closable admission queue).
 
 See docs/PERFORMANCE.md §Serving for the static-vs-continuous batching
-story and ``tools/serve_bench.py --compare`` for the measurement.
+story, docs/ROBUSTNESS.md for the failure model and chaos knobs, and
+``tools/serve_bench.py --compare`` / ``--chaos`` for the measurements.
 """
 
 from tensorflowonspark_tpu.serving.engine import (            # noqa: F401
-    ENV_SERVE_POLL, ENV_SERVE_SLOTS, ServingEngine)
+    ENV_SERVE_MAX_QUEUE, ENV_SERVE_MAX_QUEUED_TOKENS, ENV_SERVE_POLL,
+    ENV_SERVE_SLOTS, ENV_SERVE_TTL, ServingEngine)
 from tensorflowonspark_tpu.serving.scheduler import (         # noqa: F401
-    ENV_SERVE_BUCKETS, Request, RequestQueue)
+    ENV_SERVE_BUCKETS, DeadlineExceeded, PoisonedRequest, Request,
+    RequestCancelled, RequestQueue, ServingOverloaded)
 from tensorflowonspark_tpu.serving.slots import (             # noqa: F401
     DEFAULT_BUCKETS, SlotDecoder, chunk_plan)

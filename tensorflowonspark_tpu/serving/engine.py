@@ -18,17 +18,42 @@ are independent in every einsum, per-slot cursors mask each lane to its
 own length, and prefill chunking changes which einsum computes a value
 but not the value (pinned by tests/test_serving.py).
 
+The engine is SELF-HEALING (docs/ROBUSTNESS.md):
+
+* admission control — the queue is bounded by request count AND
+  queued-token mass; ``submit`` raises a structured
+  :class:`~tensorflowonspark_tpu.serving.scheduler.ServingOverloaded`
+  with a retry-after hint derived from the live tokens/s rate instead
+  of growing without bound;
+* deadlines & cancellation — a per-request ``deadline``/``ttl`` is
+  checked at admission (an expired queued request fails with
+  ``DeadlineExceeded`` without ever taking a slot) and at every horizon
+  boundary; ``cancel(rid)`` frees an in-flight slot exactly like EOS;
+* crash-replay recovery — an exception in the loop thread no longer
+  kills the engine: the slab is rebuilt and every in-flight request is
+  transparently replayed from its prompt (greedy ⇒ bit-identical;
+  stream consumers see no duplicates because the already-emitted prefix
+  is suppressed), with capped consecutive restarts + backoff and poison
+  detection (a request blamed across N consecutive crashes is failed,
+  not replayed);
+* graceful drain — ``drain(timeout)`` stops admission, finishes every
+  accepted request, then stops, so rolling restarts shed zero work.
+
 Usage::
 
     eng = ServingEngine(params, cfg, num_slots=8, eos_id=2).start()
-    rid = eng.submit(prompt_ids, max_new_tokens=128)
+    rid = eng.submit(prompt_ids, max_new_tokens=128, ttl=30.0)
     tokens = eng.result(rid, timeout=60)        # prompt + generated
     # or: for tok in eng.stream(rid): ...
-    eng.stop()
+    eng.drain(timeout=30)                       # or eng.stop()
 
 All waits are timeout-bounded (TOS001) and the loop thread is a daemon
 (TOS007). Config knobs ride registered ``TOS_*`` env vars (TOS008):
-``TOS_SERVE_SLOTS``, ``TOS_SERVE_BUCKETS``, ``TOS_SERVE_POLL``.
+``TOS_SERVE_SLOTS``, ``TOS_SERVE_BUCKETS``, ``TOS_SERVE_POLL``,
+``TOS_SERVE_HORIZON``, ``TOS_SERVE_MAX_QUEUE``,
+``TOS_SERVE_MAX_QUEUED_TOKENS``, ``TOS_SERVE_TTL``,
+``TOS_SERVE_MAX_RESTARTS``, ``TOS_SERVE_RESTART_BACKOFF``,
+``TOS_SERVE_POISON_CRASHES``.
 """
 
 import contextlib
@@ -58,10 +83,45 @@ ENV_SERVE_POLL = "TOS_SERVE_POLL"
 #: of at most horizon-1 frozen slot-steps per finished request and
 #: admission every horizon tokens (see SlotDecoder.step_many)
 ENV_SERVE_HORIZON = "TOS_SERVE_HORIZON"
+#: admission bound on queued request count (0 disables)
+ENV_SERVE_MAX_QUEUE = "TOS_SERVE_MAX_QUEUE"
+#: admission bound on queued token mass: sum of prompt+budget over the
+#: backlog (0 disables; an oversized request still admits when the
+#: queue is empty)
+ENV_SERVE_MAX_QUEUED_TOKENS = "TOS_SERVE_MAX_QUEUED_TOKENS"
+#: default per-request TTL in seconds applied when submit passes neither
+#: ``deadline`` nor ``ttl`` (0 = no default deadline)
+ENV_SERVE_TTL = "TOS_SERVE_TTL"
+#: consecutive loop crashes (no successful decode between) tolerated
+#: before the engine dies terminally
+ENV_SERVE_MAX_RESTARTS = "TOS_SERVE_MAX_RESTARTS"
+#: base restart backoff in seconds (doubles per consecutive crash,
+#: capped at 2s; interruptible by stop())
+ENV_SERVE_RESTART_BACKOFF = "TOS_SERVE_RESTART_BACKOFF"
+#: a request blamed for this many consecutive crashes is failed
+#: (PoisonedRequest), not replayed — the crash-loop breaker
+ENV_SERVE_POISON_CRASHES = "TOS_SERVE_POISON_CRASHES"
 
 _DEFAULT_SLOTS = 4
 _DEFAULT_POLL = 0.05
 _DEFAULT_HORIZON = 4
+_DEFAULT_MAX_QUEUE = 1024
+_DEFAULT_MAX_QUEUED_TOKENS = 1 << 20
+_DEFAULT_MAX_RESTARTS = 5
+_DEFAULT_RESTART_BACKOFF = 0.05
+_DEFAULT_POISON_CRASHES = 2
+#: restart backoff never exceeds this many seconds
+_BACKOFF_CAP = 2.0
+#: restart_log keeps this many most-recent recovery records
+_RESTART_LOG_CAP = 64
+
+
+def _env_int(name: str, default: int) -> int:
+  return int(os.environ.get(name, str(default)))
+
+
+def _env_float(name: str, default: float) -> float:
+  return float(os.environ.get(name, str(default)))
 
 
 class ServingEngine(object):
@@ -71,15 +131,20 @@ class ServingEngine(object):
                eos_id: Optional[int] = None, pad_id: int = 0,
                max_new_tokens: int = 64, buckets=None, mesh=None,
                poll_interval: Optional[float] = None,
-               horizon: Optional[int] = None):
+               horizon: Optional[int] = None,
+               max_queue: Optional[int] = None,
+               max_queued_tokens: Optional[int] = None,
+               default_ttl: Optional[float] = None,
+               max_restarts: Optional[int] = None,
+               restart_backoff: Optional[float] = None,
+               poison_crashes: Optional[int] = None):
     if eos_id is not None and int(eos_id) == int(pad_id):
       raise ValueError("eos_id and pad_id must differ (both %d)"
                        % int(pad_id))
     if num_slots is None:
-      num_slots = int(os.environ.get(ENV_SERVE_SLOTS, str(_DEFAULT_SLOTS)))
+      num_slots = _env_int(ENV_SERVE_SLOTS, _DEFAULT_SLOTS)
     if horizon is None:
-      horizon = int(os.environ.get(ENV_SERVE_HORIZON,
-                                   str(_DEFAULT_HORIZON)))
+      horizon = _env_int(ENV_SERVE_HORIZON, _DEFAULT_HORIZON)
     if horizon < 1:
       raise ValueError("horizon must be >= 1, got %d" % horizon)
     self.params = params
@@ -91,12 +156,33 @@ class ServingEngine(object):
     # explicit argument beats the env knob (the num_slots/horizon rule)
     self.buckets = tuple(buckets) if buckets is not None \
         else sched.buckets_from_env(slots_lib.DEFAULT_BUCKETS)
+    self.max_queue = int(max_queue if max_queue is not None
+                         else _env_int(ENV_SERVE_MAX_QUEUE,
+                                       _DEFAULT_MAX_QUEUE))
+    self.max_queued_tokens = int(
+        max_queued_tokens if max_queued_tokens is not None
+        else _env_int(ENV_SERVE_MAX_QUEUED_TOKENS,
+                      _DEFAULT_MAX_QUEUED_TOKENS))
+    ttl = default_ttl if default_ttl is not None \
+        else _env_float(ENV_SERVE_TTL, 0.0)
+    self.default_ttl = float(ttl) if ttl and ttl > 0 else None
+    self.max_restarts = int(max_restarts if max_restarts is not None
+                            else _env_int(ENV_SERVE_MAX_RESTARTS,
+                                          _DEFAULT_MAX_RESTARTS))
+    self.restart_backoff = float(
+        restart_backoff if restart_backoff is not None
+        else _env_float(ENV_SERVE_RESTART_BACKOFF,
+                        _DEFAULT_RESTART_BACKOFF))
+    self.poison_crashes = max(1, int(
+        poison_crashes if poison_crashes is not None
+        else _env_int(ENV_SERVE_POISON_CRASHES, _DEFAULT_POISON_CRASHES)))
     self.decoder = slots_lib.SlotDecoder(cfg, num_slots, pad_id=pad_id,
                                          eos_id=self.eos_id, mesh=mesh)
     self._poll = float(poll_interval if poll_interval is not None
                        else os.environ.get(ENV_SERVE_POLL, _DEFAULT_POLL))
     self._queue = sched.RequestQueue()
     self._lock = threading.Lock()
+    self._stats_lock = threading.Lock()
     self._requests = {}                    # rid -> Request (in flight or done)
     self._slots: List[Optional[sched.Request]] = [None] * num_slots
     self._slabs = None                     # built lazily on start()
@@ -104,8 +190,19 @@ class ServingEngine(object):
     self._stop_evt = threading.Event()
     self._thread: Optional[threading.Thread] = None
     self._loop_error: Optional[BaseException] = None
+    self._draining = False
+    self._admitting: Optional[sched.Request] = None
+    self._crash_streak = 0
+    self._tok_rate = 0.0                   # EMA tokens/s over decode passes
+    #: bounded record of crash recoveries: {t, duration_s, replayed,
+    #: poisoned, streak, error} — serve_bench --chaos reads recovery
+    #: latency off this
+    self.restart_log: List[dict] = []
     self.stats = {"steps": 0, "live_slot_steps": 0, "emitted_tokens": 0,
-                  "prefills": 0, "completed": 0}
+                  "prefills": 0, "completed": 0, "rejected": 0,
+                  "expired": 0, "cancelled": 0, "replays": 0,
+                  "engine_restarts": 0, "poisoned": 0,
+                  "replay_mismatches": 0}
     # obs seam (docs/OBSERVABILITY.md): cached handles; disabled = one
     # None check per decode dispatch
     self._rec = obs_spans.active()
@@ -115,11 +212,28 @@ class ServingEngine(object):
         "completed": reg.counter("serve.completed"),
         "prefills": reg.counter("serve.prefills"),
         "steps": reg.counter("serve.steps"),
+        "rejected": reg.counter("serve.rejected"),
+        "expired": reg.counter("serve.expired"),
+        "cancelled": reg.counter("serve.cancelled"),
+        "replays": reg.counter("serve.replays"),
+        "engine_restarts": reg.counter("serve.engine_restarts"),
+        "poisoned": reg.counter("serve.poisoned"),
         "occupancy": reg.gauge("serve.occupancy"),
         "queue_depth": reg.gauge("serve.queue_depth"),
         "slots_active": reg.gauge("serve.slots_active"),
         "decode_ms": reg.histogram("serve.decode_ms"),
     }
+
+  def _count(self, key: str, n: int = 1) -> None:
+    """Bump a stats key and its obs counter twin (when the plane is on).
+
+    Locked: rejected/expired/cancelled are bumped from client threads
+    (submit, cancel-on-a-dead-engine) AND the loop thread — a bare
+    ``+=`` interleaving would drop increments."""
+    with self._stats_lock:
+      self.stats[key] += n
+    if self._obs_m is not None and key in self._obs_m:
+      self._obs_m[key].inc(n)
 
   def stats_snapshot(self) -> obs_metrics.StatsSnapshot:
     """Subtraction baseline over the LIVE ``stats`` dict — the safe way
@@ -138,6 +252,9 @@ class ServingEngine(object):
       return self
     self._stop_evt.clear()
     self._loop_error = None
+    self._draining = False
+    self._crash_streak = 0
+    self._queue.reopen()
     if self._slabs is None:
       self._slabs = self.decoder.init_slabs()
     self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -146,7 +263,8 @@ class ServingEngine(object):
     return self
 
   def stop(self, timeout: float = 30.0) -> None:
-    """Stop the loop thread; queued-but-unstarted requests are failed."""
+    """Stop the loop thread; unfinished requests (queued AND in flight)
+    are failed. Idempotent, and safe before :meth:`start`."""
     self._stop_evt.set()
     t = self._thread
     if t is not None:
@@ -154,15 +272,58 @@ class ServingEngine(object):
       if t.is_alive():
         logger.warning("serving loop did not stop within %.1fs", timeout)
     err = RuntimeError("serving engine stopped")
-    for req in self._queue.drain():
+    # close-and-drain is atomic under the queue's own lock: a submit
+    # racing this stop either lands before (and is failed here) or
+    # fails fast on the closed queue — never orphaned (the old
+    # submit-vs-loop-death race, docs/ROBUSTNESS.md)
+    for req in self._queue.close(err):
       req.finish(err)
     with self._lock:
       live = [r for r in self._slots if r is not None]
       self._slots = [None] * self.num_slots
+      adm, self._admitting = self._admitting, None
+    if adm is not None:
+      live.append(adm)
     for req in live:
-      if not req.done.is_set():
-        req.finish(err)
+      req.finish(err)                      # finish() is idempotent
     self._slabs = None                     # next start() gets a fresh slab
+
+  def drain(self, timeout: float) -> bool:
+    """Graceful shutdown: stop admission, finish every accepted request
+    (queued and in flight), then stop. Returns True when all accepted
+    work completed inside ``timeout`` (requests left at the deadline are
+    failed by the final :meth:`stop`). Rolling restarts and the
+    cached-engine rebuild in ``make_serving_predict_fn`` use this so
+    zero accepted requests are shed. ``timeout`` is required — the
+    wait parks on in-flight progress, so the deadline must be the
+    caller's choice (TOS001, like ``wait_alert``)."""
+    deadline = time.monotonic() + max(0.0, float(timeout))
+    self._draining = True                  # submit() rejects from here on
+    while time.monotonic() < deadline:
+      if self._loop_error is not None:
+        break
+      t = self._thread
+      if t is None or not t.is_alive():
+        break
+      if self._idle():
+        break
+      time.sleep(min(0.05, self._poll))
+    completed = self._idle() and self._loop_error is None
+    self.stop(timeout=max(1.0, deadline - time.monotonic()))
+    return completed
+
+  def _idle(self) -> bool:
+    # order matters (drain's zero-shed contract): the queue is checked
+    # FIRST. A pop marks the request as mid-admission while the queue
+    # lock is held (pop_nowait's on_pop hook), so once we observe the
+    # queue empty, any popped request is already visible in
+    # _admitting or a slot — there is no in-neither window to misread
+    # as idle.
+    if len(self._queue) > 0:
+      return False
+    with self._lock:
+      return not (any(r is not None for r in self._slots)
+                  or self._admitting is not None)
 
   def __enter__(self):
     return self.start()
@@ -172,13 +333,30 @@ class ServingEngine(object):
 
   # -- client API -----------------------------------------------------------
 
-  def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
-    """Queue one prompt; returns the request id."""
+  def submit(self, prompt, max_new_tokens: Optional[int] = None,
+             deadline: Optional[float] = None,
+             ttl: Optional[float] = None) -> int:
+    """Queue one prompt; returns the request id.
+
+    ``deadline`` is an absolute ``time.monotonic()`` bound; ``ttl`` is
+    seconds from now (pass one or the other). An admitted request whose
+    deadline passes fails with ``DeadlineExceeded`` — while queued,
+    without ever taking a slot; in flight, at the next horizon boundary.
+    Raises ``ServingOverloaded`` (structured: queue depth, queued token
+    mass, retry-after hint) instead of queueing without bound.
+    """
     budget = int(max_new_tokens if max_new_tokens is not None
                  else self.default_max_new_tokens)
     if budget < 1:
       raise ValueError("max_new_tokens must be >= 1, got %d" % budget)
-    req = sched.Request(prompt, budget)
+    now = time.monotonic()
+    if deadline is not None and ttl is not None:
+      raise ValueError("pass deadline OR ttl, not both")
+    if ttl is None and deadline is None and self.default_ttl is not None:
+      ttl = self.default_ttl
+    if ttl is not None:
+      deadline = now + float(ttl)
+    req = sched.Request(prompt, budget, deadline=deadline)
     if len(req.prompt) < 1:
       # reject here, not in the loop thread: a chunk_plan(0) crash there
       # would take every other in-flight request down with it
@@ -187,12 +365,67 @@ class ServingEngine(object):
       raise ValueError(
           "prompt of %d tokens + budget %d exceeds the max_seq_len=%d "
           "slot cache" % (len(req.prompt), budget, self.cfg.max_seq_len))
+    if req.expired(now):
+      self._count("expired")
+      raise sched.DeadlineExceeded(
+          "request dead on arrival: its deadline already passed at "
+          "submit")
+    if self._draining:
+      self._count("rejected")   # drain-time turn-aways must be visible
+      raise sched.ServingOverloaded(
+          "serving engine is draining — admission is closed",
+          queue_depth=len(self._queue),
+          queued_tokens=self._queue.token_mass, draining=True)
     if self._loop_error is not None:
       raise RuntimeError("serving loop died") from self._loop_error
     with self._lock:
       self._requests[req.rid] = req
-    self._queue.push(req)
+    try:
+      self._queue.push_bounded(req, self.max_queue, self.max_queued_tokens)
+    except sched.ServingOverloaded as e:
+      with self._lock:
+        self._requests.pop(req.rid, None)
+      self._count("rejected")
+      e.retry_after = self._retry_after(e.queued_tokens)
+      raise
+    except sched.QueueClosed:
+      # the loop died (or the engine stopped) between our liveness check
+      # and the push — the close happened under the queue's lock, so we
+      # fail HERE instead of orphaning the request until its timeout
+      with self._lock:
+        self._requests.pop(req.rid, None)
+      if self._loop_error is not None:
+        raise RuntimeError("serving loop died") from self._loop_error
+      raise RuntimeError("serving engine stopped")
     return req.rid
+
+  def _retry_after(self, queued_tokens: int) -> float:
+    """Backpressure hint: how long until the live decode rate clears the
+    current backlog (bounded; a cold engine answers one poll tick)."""
+    rate = self._tok_rate
+    if rate <= 0:
+      return round(max(self._poll, 0.1), 3)
+    return round(min(60.0, max(self._poll, queued_tokens / rate)), 3)
+
+  def cancel(self, rid: int, timeout: float) -> bool:
+    """Cancel a request: queued → failed without taking a slot; in
+    flight → its slot frees at the next horizon boundary, exactly like
+    EOS. Blocks (bounded) until the request actually finished; returns
+    True when it did. Already-finished requests return True unchanged.
+    ``timeout`` is required — the wait parks on the slot release, so
+    the deadline must be the caller's choice (TOS001).
+    """
+    req = self._req(rid)
+    if req.done.is_set():
+      return True
+    req.cancelled.set()
+    t = self._thread
+    if t is None or not t.is_alive():
+      # no loop to reap it: fail queued entries synchronously so the
+      # caller is not parked on a dead engine
+      self._reap_queue(time.monotonic())
+    req.done.wait(timeout=timeout)
+    return req.done.is_set()
 
   def _req(self, rid: int) -> sched.Request:
     with self._lock:
@@ -216,23 +449,63 @@ class ServingEngine(object):
     return self._result_of(req, pop=True)
 
   def result(self, rid: int, timeout: float = 600.0) -> np.ndarray:
-    """Block (bounded) for one request's output."""
+    """Block (bounded) for one request's output. Fails FAST — with the
+    loop's root cause — when the engine is dead or was never started,
+    instead of sitting out the full timeout."""
     req = self._req(rid)
-    if not req.done.wait(timeout=timeout):
-      raise TimeoutError("request %d not finished within %.1fs"
-                         % (rid, timeout))
+    self._wait_done(req, timeout, "request %d" % rid)
     return self._result_of(req, pop=True)
+
+  def _wait_done(self, req: sched.Request, timeout: float,
+                 what: str) -> None:
+    deadline = time.monotonic() + timeout
+    chunk = max(0.05, self._poll)
+    while True:
+      remaining = deadline - time.monotonic()
+      if req.done.wait(timeout=max(0.0, min(chunk, remaining))):
+        return
+      self._raise_if_dead(req, what)
+      if deadline - time.monotonic() <= 0:
+        raise TimeoutError("%s not finished within %.1fs"
+                           % (what, timeout))
+
+  def _raise_if_dead(self, req: Optional[sched.Request],
+                     what: str) -> None:
+    """Fail-fast check for waiters: a dead (or never-started) engine
+    cannot finish anything — raise the root cause now, not at the
+    caller's timeout."""
+    if req is not None and req.done.is_set():
+      return
+    if self._loop_error is not None:
+      raise RuntimeError("serving loop died; %s cannot finish"
+                         % what) from self._loop_error
+    t = self._thread
+    if t is None:
+      raise RuntimeError(
+          "serving engine was never started — call start() before "
+          "waiting on %s" % what)
+    if not t.is_alive():
+      raise RuntimeError("serving engine is stopped; %s cannot finish"
+                         % what)
 
   def _result_of(self, req: sched.Request, pop: bool) -> np.ndarray:
     if pop:
       with self._lock:
         self._requests.pop(req.rid, None)
-    if req.error is not None:
-      raise RuntimeError("request %d failed" % req.rid) from req.error
+    err = req.error
+    if isinstance(err, (sched.DeadlineExceeded, sched.RequestCancelled,
+                        sched.PoisonedRequest)):
+      raise err                  # structured verdicts surface as-is
+    if err is not None:
+      raise RuntimeError("request %d failed" % req.rid) from err
     return req.output()
 
   def stream(self, rid: int, timeout: float = 600.0):
-    """Yield generated tokens as they are produced (EOS inclusive)."""
+    """Yield generated tokens as they are produced (EOS inclusive).
+
+    Crash replays are invisible here: the engine suppresses the
+    already-emitted prefix, so a consumer sees each position exactly
+    once. Fails fast on a dead/never-started engine."""
     req = self._req(rid)
     deadline = time.monotonic() + timeout
     emitted = 0
@@ -243,6 +516,7 @@ class ServingEngine(object):
       try:
         tok = req.stream_q.get(timeout=min(remaining, self._poll * 10))
       except std_queue.Empty:
+        self._raise_if_dead(req, "request %d" % rid)
         continue
       if tok is None:
         break
@@ -250,15 +524,31 @@ class ServingEngine(object):
       yield tok
     with self._lock:
       self._requests.pop(rid, None)
-    if req.error is not None:
+    err = req.error
+    if isinstance(err, (sched.DeadlineExceeded, sched.RequestCancelled,
+                        sched.PoisonedRequest)):
+      raise err
+    if err is not None:
       raise RuntimeError("request %d failed after %d token(s)"
-                         % (rid, emitted)) from req.error
+                         % (rid, emitted)) from err
 
   def generate(self, prompts: Sequence,
                max_new_tokens: Optional[int] = None,
                timeout: float = 600.0) -> List[np.ndarray]:
-    """Submit a batch of prompts and wait for all outputs (in order)."""
-    rids = [self.submit(p, max_new_tokens=max_new_tokens) for p in prompts]
+    """Submit a batch of prompts and wait for all outputs (in order).
+
+    If a mid-list submit is rejected (overload/validation), the
+    already-submitted prefix is cancelled before re-raising — no
+    orphaned work keeps burning slots for a caller that went away."""
+    rids = []
+    try:
+      for p in prompts:
+        rids.append(self.submit(p, max_new_tokens=max_new_tokens))
+    except BaseException:
+      for rid in rids:
+        with contextlib.suppress(Exception):
+          self.cancel(rid, timeout=1.0)
+      raise
     deadline = time.monotonic() + timeout
     outs = []
     for rid in rids:
@@ -268,9 +558,15 @@ class ServingEngine(object):
 
   @property
   def alive(self) -> bool:
-    """False once the loop thread has died on an error — callers holding
-    a cached engine must rebuild instead of reusing a dead one."""
-    return self._loop_error is None
+    """False once the engine is terminally dead (loop exhausted its
+    restart budget) or stopped — callers holding a cached engine must
+    rebuild instead of reusing it. A transient crash mid-replay keeps
+    ``alive`` True: the engine is healing, not dead. True before
+    ``start()`` (a constructed engine is startable)."""
+    if self._loop_error is not None:
+      return False
+    t = self._thread
+    return t is None or t.is_alive()
 
   @property
   def occupancy(self) -> float:
@@ -283,34 +579,194 @@ class ServingEngine(object):
   # -- engine loop ----------------------------------------------------------
 
   def _loop(self) -> None:
-    try:
-      while not self._stop_evt.is_set():
+    while not self._stop_evt.is_set():
+      try:
+        if self._slabs is None:            # rebuilt after a crash
+          self._slabs = self.decoder.init_slabs()
+        self._reap()
         self._admit()
         if not any(r is not None for r in self._slots):
           # idle: bounded block until work arrives (TOS001)
           self._queue.wait_nonempty(timeout=self._poll)
           continue
         self._decode_once()
-    except BaseException as e:  # noqa: BLE001 - forwarded to every waiter
-      self._loop_error = e
-      logger.exception("serving loop died")
-      for req in self._queue.drain():
-        req.finish(e)
+        self._crash_streak = 0             # a full decode pass = healthy
+      except BaseException as e:  # noqa: BLE001 - crash-replay recovery;
+        # terminal failures are forwarded to every waiter by _die
+        if not self._recover(e):
+          return
+
+  # -- crash-replay recovery -------------------------------------------------
+
+  def _recover(self, error: BaseException) -> bool:
+    """Heal from a loop crash: rebuild device state and transparently
+    replay every in-flight request from its prompt (greedy ⇒ the
+    regenerated stream is bit-identical; the already-emitted prefix is
+    suppressed). Returns False when the engine must die instead
+    (stopping, or the consecutive-restart budget is spent)."""
+    if self._stop_evt.is_set():
+      return False                         # stop() owns cleanup from here
+    t_crash = time.monotonic()
+    self._crash_streak += 1
+    streak = self._crash_streak
+    if streak > self.max_restarts:
+      logger.exception("serving loop died terminally (%d consecutive "
+                       "crashes > max_restarts=%d)",
+                       streak, self.max_restarts)
+      self._die(error)
+      return False
+    logger.warning("serving loop crashed (consecutive crash %d/%d), "
+                   "recovering: %r", streak, self.max_restarts, error)
+    self._count("engine_restarts")
+    # collect the victims: in-flight slots in slot order, then the
+    # request that was mid-admission (the _admit prefill path) — it is
+    # in neither the queue nor a slot and must not be lost
+    with self._lock:
+      victims = [r for r in self._slots if r is not None]
+      self._slots = [None] * self.num_slots
+      adm, self._admitting = self._admitting, None
+    if adm is not None:
+      victims.append(adm)
+    self._last[:] = self.pad_id
+    self._slabs = None                     # fresh slab next iteration
+    # blame: a crash during admission implicates exactly the request
+    # being prefilled; a crash mid-decode cannot be attributed and
+    # implicates every in-flight lane
+    for req in victims:
+      if adm is None or req is adm:
+        req.crash_count += 1
+    now = time.monotonic()
+    replay: List[sched.Request] = []
+    poisoned = 0
+    for req in victims:
+      if req.done.is_set():
+        continue
+      if req.cancelled.is_set():
+        self._count("cancelled")
+        req.finish(sched.RequestCancelled(
+            "request %d cancelled" % req.rid))
+        continue
+      if req.expired(now):
+        self._count("expired")
+        req.finish(sched.DeadlineExceeded(
+            "request %d deadline passed during crash recovery" % req.rid))
+        continue
+      if req.crash_count >= self.poison_crashes:
+        poisoned += 1
+        self._count("poisoned")
+        err = sched.PoisonedRequest(
+            "request %d was in flight across %d consecutive engine "
+            "crashes — failed, not replayed" % (req.rid, req.crash_count))
+        err.__cause__ = error
+        req.finish(err)
+        continue
+      replay.append(req)
+    try:
+      # ahead of the backlog, original order preserved: appendleft in
+      # reverse puts victims back in the order they were running
+      for req in reversed(replay):
+        req.begin_replay()
+        self._queue.push_front(req)
+    except sched.QueueClosed:
+      err = RuntimeError("serving engine stopped")
+      for req in replay:
+        req.finish(err)
+      return False
+    if replay:
+      self._count("replays", len(replay))
+    if poisoned:
+      # removing the suspected cause IS progress: don't let a healed
+      # poison sequence burn the restart budget of a real crash loop
+      self._crash_streak = 0
+    backoff = min(_BACKOFF_CAP,
+                  self.restart_backoff * (2 ** (streak - 1)))
+    if backoff > 0:
+      self._stop_evt.wait(backoff)         # interruptible by stop()
+    rec = {"t": t_crash, "duration_s": time.monotonic() - t_crash,
+           "replayed": len(replay), "poisoned": poisoned,
+           "streak": streak, "error": repr(error)[:200]}
+    self.restart_log.append(rec)
+    del self.restart_log[:-_RESTART_LOG_CAP]
+    if self._rec is not None:
+      self._rec.event("serve.restart", replayed=len(replay),
+                      poisoned=poisoned, streak=streak)
+    return True
+
+  def _die(self, error: BaseException) -> None:
+    """Terminal loop death: mark the root cause, then fail every waiter
+    — queued, in flight, and mid-admission — so nobody burns a timeout.
+    The queue close is atomic with its drain (scheduler.RequestQueue),
+    so a racing submit can never orphan a request behind it."""
+    self._loop_error = error
+    for req in self._queue.close(error):
+      req.finish(error)
+    with self._lock:
+      live = [r for r in self._slots if r is not None]
+      self._slots = [None] * self.num_slots
+      adm, self._admitting = self._admitting, None
+    if adm is not None:
+      live.append(adm)
+    for req in live:
+      req.finish(error)
+
+  # -- reaping (deadlines & cancellation) ------------------------------------
+
+  def _reap(self) -> None:
+    """Fail expired/cancelled requests: queued ones without ever taking
+    a slot, in-flight ones by freeing their slot at this horizon
+    boundary — exactly the bookkeeping an EOS exit does."""
+    now = time.monotonic()
+    self._reap_queue(now)
+    for slot in range(self.num_slots):
+      req = self._slots[slot]
+      if req is None:
+        continue
+      if not (req.cancelled.is_set() or req.expired(now)):
+        continue
+      self._fail_reaped(req, now)
       with self._lock:
-        live = [r for r in self._slots if r is not None]
-        self._slots = [None] * self.num_slots
-      for req in live:
-        req.finish(e)
+        self._slots[slot] = None
+      self._last[slot] = self.pad_id
+
+  def _reap_queue(self, now: float) -> None:
+    for req in self._queue.reap(
+        lambda r: r.cancelled.is_set() or r.expired(now)):
+      self._fail_reaped(req, now)
+
+  def _fail_reaped(self, req: sched.Request, now: float) -> None:
+    if req.cancelled.is_set():
+      self._count("cancelled")
+      req.finish(sched.RequestCancelled(
+          "request %d cancelled" % req.rid))
+    else:
+      self._count("expired")
+      req.finish(sched.DeadlineExceeded(
+          "request %d missed its deadline by %.3fs"
+          % (req.rid, now - (req.deadline or now))))
+
+  # -- admission -------------------------------------------------------------
 
   def _admit(self) -> None:
     """Prefill queued requests into free slots (EOS-freed or virgin)."""
     for slot in range(self.num_slots):
       if self._slots[slot] is not None:
         continue
-      req = self._queue.pop_nowait()
-      if req is None:
-        return
-      req.started_at = time.monotonic()
+      req = None
+      while req is None:
+        # on_pop marks the request mid-admission ATOMICALLY with the
+        # pop (under the queue lock): crash-safe for _recover, and
+        # drain's idle check can never observe the in-neither gap
+        req = self._queue.pop_nowait(on_pop=self._mark_admitting)
+        if req is None:
+          return
+        now = time.monotonic()
+        if req.cancelled.is_set() or req.expired(now):
+          # the admission-time deadline check: fail WITHOUT a slot
+          self._fail_reaped(req, now)
+          self._admitting = None
+          req = None
+      if req.started_at is None:
+        req.started_at = time.monotonic()
       cm = self._rec.span("serve.prefill", rid=req.rid,
                           prompt_len=len(req.prompt), slot=slot) \
           if self._rec is not None else contextlib.nullcontext()
@@ -320,20 +776,26 @@ class ServingEngine(object):
       self.stats["prefills"] += 1
       if self._obs_m is not None:
         self._obs_m["prefills"].inc()
-      req.emit(first)
+      if not req.emit(first):
+        self.stats["replay_mismatches"] += 1
       self.stats["emitted_tokens"] += 1
       if self._finished(req, first):
         self._complete(req)
+        self._admitting = None
         continue                 # slot stays free for the next request
       self._slabs = self.decoder.insert(self._slabs, row_cache, slot)
       with self._lock:
         self._slots[slot] = req
+      self._admitting = None
       self._last[slot] = first
+
+  def _mark_admitting(self, req: sched.Request) -> None:
+    self._admitting = req
 
   def _finished(self, req: sched.Request, token: int) -> bool:
     if self.eos_id is not None and int(token) == self.eos_id:
       return True
-    return len(req.tokens) >= req.max_new_tokens
+    return req.generated >= req.max_new_tokens
 
   def _complete(self, req: sched.Request) -> None:
     self.stats["completed"] += 1
@@ -349,12 +811,11 @@ class ServingEngine(object):
     num_slots]`` token matrix, so the two views cannot diverge. A lane
     that stops mid-horizon idles (frozen) for the remaining scan steps —
     the bounded price of amortizing dispatch over the horizon."""
-    obs_on = self._rec is not None or self._obs_m is not None
-    t0 = time.monotonic() if obs_on else 0.0
+    t0 = time.monotonic()
     tokens_before = self.stats["emitted_tokens"]
     active = np.asarray([r is not None for r in self._slots], bool)
     remaining = np.asarray(
-        [0 if r is None else r.max_new_tokens - len(r.tokens)
+        [0 if r is None else r.max_new_tokens - r.generated
          for r in self._slots], np.int32)
     self._slabs, toks, _, _ = self.decoder.step_many(
         self.params, self._slabs, self._last, active, remaining,
@@ -367,7 +828,8 @@ class ServingEngine(object):
         continue
       for j in range(self.horizon):
         tok = int(toks[j, slot])
-        req.emit(tok)
+        if not req.emit(tok):
+          self.stats["replay_mismatches"] += 1
         self.stats["emitted_tokens"] += 1
         self.stats["live_slot_steps"] += 1
         if self._finished(req, tok):
@@ -378,8 +840,14 @@ class ServingEngine(object):
           break
       else:
         self._last[slot] = int(toks[self.horizon - 1, slot])
-    if obs_on:
-      dt = time.monotonic() - t0
+    dt = time.monotonic() - t0
+    emitted = self.stats["emitted_tokens"] - tokens_before
+    if dt > 0 and emitted:
+      # live tokens/s EMA — the denominator of the retry-after hint
+      rate = emitted / dt
+      self._tok_rate = rate if self._tok_rate <= 0 \
+          else 0.5 * self._tok_rate + 0.5 * rate
+    if self._rec is not None or self._obs_m is not None:
       live = sum(1 for r in self._slots if r is not None)
       if self._rec is not None:
         self._rec.record_span("serve.decode", t0, dt,
@@ -388,7 +856,7 @@ class ServingEngine(object):
       m = self._obs_m
       if m is not None:
         m["steps"].inc(self.horizon)
-        m["tokens"].inc(self.stats["emitted_tokens"] - tokens_before)
+        m["tokens"].inc(emitted)
         m["decode_ms"].observe(dt * 1e3)
         m["occupancy"].set(self.occupancy)
         m["queue_depth"].set(len(self._queue))

@@ -6,6 +6,18 @@ Pure bookkeeping — no device work happens here. The
 :class:`RequestQueue` whenever a slot frees and prefills them in
 (``serving.slots``); callers hold the request handle and wait on its
 event / stream queue. Every blocking wait is timeout-bounded (TOS001).
+
+The robustness vocabulary also lives here (docs/ROBUSTNESS.md):
+
+* :class:`ServingOverloaded` — structured admission rejection (queue
+  depth / queued-token mass over the bound, or the engine is draining),
+  carrying a ``retry_after`` hint derived from the live decode rate;
+* :class:`DeadlineExceeded` — a request's TTL ran out (at submit, while
+  queued, or mid-flight at a horizon boundary);
+* :class:`RequestCancelled` — the client called ``cancel(rid)``;
+* :class:`PoisonedRequest` — the request was in flight across
+  ``poison_crashes`` consecutive engine crashes and is failed instead of
+  replayed (no crash loops on one bad request).
 """
 
 import collections
@@ -25,21 +37,38 @@ ENV_SERVE_BUCKETS = "TOS_SERVE_BUCKETS"
 _request_ids = itertools.count(1)
 
 
-def buckets_from_env(default):
-  """The prefill bucket set: ``TOS_SERVE_BUCKETS`` (comma ints) or
-  ``default``."""
-  raw = os.environ.get(ENV_SERVE_BUCKETS, "").strip()
-  if not raw:
-    return tuple(default)
-  try:
-    sizes = tuple(int(p) for p in raw.split(",") if p.strip())
-  except ValueError:
-    raise ValueError("%s must be a comma list of ints, got %r"
-                     % (ENV_SERVE_BUCKETS, raw))
-  if not sizes or min(sizes) < 1:
-    raise ValueError("%s must name positive chunk sizes, got %r"
-                     % (ENV_SERVE_BUCKETS, raw))
-  return sizes
+class ServingOverloaded(RuntimeError):
+  """Admission rejected: the queue bound would be exceeded (or the
+  engine is draining). ``retry_after`` (seconds, may be None) is derived
+  from the engine's live tokens/s rate over the queued token mass —
+  the client-visible backpressure signal."""
+
+  def __init__(self, message: str, queue_depth: int = 0,
+               queued_tokens: int = 0, retry_after=None,
+               draining: bool = False):
+    super().__init__(message)
+    self.queue_depth = int(queue_depth)
+    self.queued_tokens = int(queued_tokens)
+    self.retry_after = retry_after
+    self.draining = bool(draining)
+
+
+class DeadlineExceeded(TimeoutError):
+  """The request's deadline/TTL expired before it finished."""
+
+
+class RequestCancelled(RuntimeError):
+  """The client cancelled the request (``ServingEngine.cancel``)."""
+
+
+class PoisonedRequest(RuntimeError):
+  """Failed instead of replayed: the request was in flight across N
+  consecutive engine crashes (the crash-loop breaker)."""
+
+
+class QueueClosed(RuntimeError):
+  """Internal: push on a closed queue (engine stopped or loop dead).
+  Carries the closing cause so submit can fail fast with the root."""
 
 
 class Request(object):
@@ -48,13 +77,18 @@ class Request(object):
   ``tokens`` accumulates generated ids (EOS inclusive, never pad);
   ``done`` fires when the request finishes or fails; ``stream_q``
   receives each token as it is emitted, then a ``None`` sentinel.
+  ``deadline`` is an absolute ``time.monotonic()`` bound (None = no
+  deadline); ``cancelled`` is the client-side cancellation flag the
+  engine loop reaps; ``crash_count`` counts engine crashes this request
+  was blamed for (poison detection, docs/ROBUSTNESS.md).
   """
 
   __slots__ = ("rid", "prompt", "max_new_tokens", "tokens", "done",
                "stream_q", "error", "submitted_at", "started_at",
-               "finished_at")
+               "finished_at", "deadline", "cancelled", "crash_count",
+               "_suppress")
 
-  def __init__(self, prompt, max_new_tokens: int):
+  def __init__(self, prompt, max_new_tokens: int, deadline=None):
     self.rid = next(_request_ids)
     self.prompt = np.asarray(prompt, np.int32).ravel()
     self.max_new_tokens = int(max_new_tokens)
@@ -65,12 +99,57 @@ class Request(object):
     self.submitted_at = time.monotonic()
     self.started_at: Optional[float] = None
     self.finished_at: Optional[float] = None
+    self.deadline = None if deadline is None else float(deadline)
+    self.cancelled = threading.Event()
+    self.crash_count = 0
+    # crash-replay suppression: how many upcoming emits regenerate
+    # already-delivered positions (greedy ⇒ bit-identical) and must not
+    # reach tokens/stream a second time
+    self._suppress = 0
 
-  def emit(self, token: int) -> None:
-    self.tokens.append(int(token))
-    self.stream_q.put_nowait(int(token))   # unbounded: never blocks
+  @property
+  def token_cost(self) -> int:
+    """Worst-case token mass this request puts on the engine (prompt to
+    prefill + budget to decode) — the unit of the queued-token bound."""
+    return len(self.prompt) + self.max_new_tokens
+
+  @property
+  def generated(self) -> int:
+    """Tokens generated in the CURRENT engine incarnation. Equal to
+    ``len(tokens)`` except mid-replay, where already-recorded tokens are
+    still being regenerated — budget math must use THIS, or a replayed
+    request would stop short of re-reaching its pre-crash position."""
+    return len(self.tokens) - self._suppress
+
+  def expired(self, now: Optional[float] = None) -> bool:
+    if self.deadline is None:
+      return False
+    return (time.monotonic() if now is None else now) >= self.deadline
+
+  def begin_replay(self) -> None:
+    """Arm suppression for a crash replay: the next ``len(tokens)``
+    emits re-derive positions the client already holds."""
+    self._suppress = len(self.tokens)
+
+  def emit(self, token: int) -> bool:
+    """Record one generated token. Returns replay parity: False when a
+    suppressed (replayed) emit disagrees with the recorded token — the
+    greedy bit-identity contract says that never happens; the engine
+    counts violations instead of trusting it blindly."""
+    token = int(token)
+    if self._suppress:
+      idx = len(self.tokens) - self._suppress
+      self._suppress -= 1
+      return self.tokens[idx] == token
+    self.tokens.append(token)
+    self.stream_q.put_nowait(token)        # unbounded: never blocks
+    return True
 
   def finish(self, error: Optional[BaseException] = None) -> None:
+    """Idempotent: a request failed by the crash path and again by
+    ``stop()`` keeps its FIRST verdict (and one stream sentinel)."""
+    if self.done.is_set():
+      return
     self.error = error
     self.finished_at = time.monotonic()
     self.stream_q.put_nowait(None)         # unbounded: never blocks
@@ -88,23 +167,115 @@ class Request(object):
         [self.prompt, np.asarray(self.tokens, np.int32)])
 
 
+def buckets_from_env(default):
+  """The prefill bucket set: ``TOS_SERVE_BUCKETS`` (comma ints) or
+  ``default``."""
+  raw = os.environ.get(ENV_SERVE_BUCKETS, "").strip()
+  if not raw:
+    return tuple(default)
+  try:
+    sizes = tuple(int(p) for p in raw.split(",") if p.strip())
+  except ValueError:
+    raise ValueError("%s must be a comma list of ints, got %r"
+                     % (ENV_SERVE_BUCKETS, raw))
+  if not sizes or min(sizes) < 1:
+    raise ValueError("%s must name positive chunk sizes, got %r"
+                     % (ENV_SERVE_BUCKETS, raw))
+  return sizes
+
+
 class RequestQueue(object):
-  """Thread-safe FIFO of pending requests with bounded waits."""
+  """Thread-safe FIFO of pending requests with bounded waits, bounded
+  admission, and a closed state.
+
+  * ``push_bounded`` enforces the request-count AND queued-token-mass
+    bounds (``ServingOverloaded``); an oversized request is still
+    admitted when the queue is empty — it CAN be served (slots don't
+    care), the bound is about backlog (the feedhub oversized-envelope
+    rule).
+  * ``close(error)`` atomically (under the one lock ``push`` uses)
+    marks the queue dead and returns the drained backlog — the fix for
+    the submit-vs-loop-death race: a push can land before or after the
+    close, never between the dying loop's drain and its error mark.
+  * ``push_front`` re-queues crash-replay requests ahead of the backlog
+    (they were already admitted; bounds don't re-apply).
+  """
 
   def __init__(self):
     self._items = collections.deque()
     self._cond = threading.Condition()
+    self._tokens = 0                       # queued token mass
+    self._closed: Optional[BaseException] = None
+
+  def _check_open_locked(self):
+    if self._closed is not None:
+      raise QueueClosed("request queue is closed") from self._closed
 
   def push(self, request: Request) -> None:
     with self._cond:
+      self._check_open_locked()
       self._items.append(request)
+      self._tokens += request.token_cost
       self._cond.notify_all()
 
-  def pop_nowait(self) -> Optional[Request]:
+  def push_front(self, request: Request) -> None:
+    """Replay re-queue: ahead of the backlog, exempt from bounds."""
+    with self._cond:
+      self._check_open_locked()
+      self._items.appendleft(request)
+      self._tokens += request.token_cost
+      self._cond.notify_all()
+
+  def push_bounded(self, request: Request, max_requests: int = 0,
+                   max_tokens: int = 0) -> None:
+    """Admit under the bounds (0 disables a bound) or raise
+    :class:`ServingOverloaded` / :class:`QueueClosed`."""
+    with self._cond:
+      self._check_open_locked()
+      depth, tokens = len(self._items), self._tokens
+      if max_requests and depth >= max_requests:
+        raise ServingOverloaded(
+            "serving queue full: %d queued request(s) at the "
+            "TOS_SERVE_MAX_QUEUE=%d bound" % (depth, max_requests),
+            queue_depth=depth, queued_tokens=tokens)
+      if max_tokens and self._items and \
+          tokens + request.token_cost > max_tokens:
+        raise ServingOverloaded(
+            "serving queue full: %d queued tokens + %d for this request "
+            "exceeds the TOS_SERVE_MAX_QUEUED_TOKENS=%d bound"
+            % (tokens, request.token_cost, max_tokens),
+            queue_depth=depth, queued_tokens=tokens)
+      self._items.append(request)
+      self._tokens += request.token_cost
+      self._cond.notify_all()
+
+  def pop_nowait(self, on_pop=None) -> Optional[Request]:
+    """Pop the head; ``on_pop(req)`` runs UNDER the queue lock — the
+    engine uses it to mark the request as mid-admission atomically with
+    the pop, so a drain checking queue-then-admitting can never observe
+    the gap between the two (the zero-shed contract)."""
     with self._cond:
       if self._items:
-        return self._items.popleft()
+        req = self._items.popleft()
+        self._tokens -= req.token_cost
+        if on_pop is not None:
+          on_pop(req)
+        return req
       return None
+
+  def reap(self, pred) -> List[Request]:
+    """Remove (and return) every queued request matching ``pred`` —
+    expired/cancelled requests fail without ever taking a slot."""
+    with self._cond:
+      kept, removed = collections.deque(), []
+      for req in self._items:
+        if pred(req):
+          removed.append(req)
+          self._tokens -= req.token_cost
+        else:
+          kept.append(req)
+      self._items = kept
+      return removed
 
   def wait_nonempty(self, timeout: float) -> bool:
     """Block (bounded) until at least one request is queued."""
@@ -114,11 +285,31 @@ class RequestQueue(object):
       self._cond.wait(timeout=timeout)
       return bool(self._items)
 
-  def drain(self) -> List[Request]:
+  def close(self, error: BaseException) -> List[Request]:
+    """Mark closed and return the drained backlog, atomically. A queue
+    closed with an earlier error stays closed with THAT error."""
     with self._cond:
+      if self._closed is None:
+        self._closed = error
       items = list(self._items)
       self._items.clear()
+      self._tokens = 0
+      self._cond.notify_all()
       return items
+
+  def reopen(self) -> None:
+    with self._cond:
+      self._closed = None
+
+  @property
+  def closed(self) -> bool:
+    with self._cond:
+      return self._closed is not None
+
+  @property
+  def token_mass(self) -> int:
+    with self._cond:
+      return self._tokens
 
   def __len__(self) -> int:
     with self._cond:

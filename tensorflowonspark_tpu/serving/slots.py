@@ -41,6 +41,7 @@ from jax.tree_util import tree_map_with_path
 
 from tensorflowonspark_tpu.models import transformer as tfm
 from tensorflowonspark_tpu.obs import device as obs_device
+from tensorflowonspark_tpu.utils import chaos
 
 #: prompt-chunk sizes for bucketed prefill, largest-first. The compiled
 #: prefill cache holds at most one entry per size, so arbitrary prompt
@@ -137,6 +138,11 @@ class SlotDecoder(object):
       raise ValueError(
           "prompt of %d tokens leaves no decode room in the "
           "max_seq_len=%d cache" % (plen, self.cfg.max_seq_len))
+    # deterministic fault site (TOS_CHAOS_SERVE, docs/ROBUSTNESS.md):
+    # raise-or-stall here stands in for a device failure during prefill.
+    # The index is the prompt length — the one identity a spec can pin
+    # before request ids exist (per-length specs make poison requests)
+    chaos.serve_fault("prefill", index=plen)
     if self._zero_row is None:
       # memoized: model.init is a full trace, far too slow to pay per
       # admitted request; jax arrays are immutable so one zero pytree
@@ -216,6 +222,9 @@ class SlotDecoder(object):
     """
     if horizon < 1:
       raise ValueError("horizon must be >= 1, got %d" % horizon)
+    # deterministic fault site (TOS_CHAOS_SERVE): one count per fused
+    # decode dispatch — "decode#N:raise" crashes the Nth horizon step
+    chaos.serve_fault("decode")
     fn = self._step_many_jits.get(horizon)
     if fn is None:
       def impl(params, slabs, tok, active, remaining, _h=horizon):

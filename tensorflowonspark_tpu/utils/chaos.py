@@ -34,6 +34,20 @@ Env vars (all optional; absent ⇒ every hook is a no-op):
 ``TOS_CHAOS_RV_DELAY`` = ``"VERB:seconds[:count]"`` (comma-separated)
     Client-side rendezvous fault: delay messages of the given verb by
     ``seconds`` before sending (first ``count`` messages; default: all).
+
+``TOS_CHAOS_SERVE`` = ``"point[@index][#nth]:raise"`` or
+    ``"point[@index][#nth]:stall:seconds"`` (comma-separated)
+    Serving-plane fault at a named :func:`serve_fault` point
+    (``serving.slots`` arms ``prefill`` and ``decode``): ``raise``
+    throws :class:`InjectedFault` into the engine loop the nth time the
+    point fires (exercising crash-replay recovery), ``stall`` sleeps
+    there (a hung device call; exercising deadlines). Without
+    ``@index`` the nth count is global across the point; with it, the
+    count is per caller-supplied index — the ``prefill`` point passes
+    the PROMPT LENGTH, the only stable pre-assignment identity a spec
+    can name, so ``"prefill@13#1:raise,prefill@13#2:raise"`` makes every
+    length-13 prompt a deterministic poison request while its neighbors
+    sail through (docs/ROBUSTNESS.md).
 """
 
 import logging
@@ -50,6 +64,12 @@ ENV_KILL = "TOS_CHAOS_KILL"
 ENV_STALL = "TOS_CHAOS_STALL"
 ENV_RV_DROP = "TOS_CHAOS_RV_DROP"
 ENV_RV_DELAY = "TOS_CHAOS_RV_DELAY"
+ENV_SERVE = "TOS_CHAOS_SERVE"
+
+
+class InjectedFault(RuntimeError):
+  """The exception a ``raise``-action serving fault throws — a stand-in
+  for any device/runtime error escaping the engine loop thread."""
 
 # per-process invocation counters, keyed by (point, index)
 _counts = {}
@@ -57,7 +77,7 @@ _stalled = set()
 _rv_counts = {}
 _lock = threading.Lock()
 
-_KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY)
+_KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY, ENV_SERVE)
 _ENV_PREFIX = "TOS_CHAOS_"
 #: cache of the last validated env signature (validation is consulted from
 #: hot paths like the rendezvous client's per-request chaos check)
@@ -122,6 +142,14 @@ def check_config() -> None:
     except ValueError:
       raise ValueError("%s: malformed delay spec %r (want "
                        "'VERB:seconds[:count]')" % (ENV_RV_DELAY, spec))
+  for spec in _split_specs(os.environ.get(ENV_SERVE)):
+    try:
+      _parse_serve_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed serve spec %r (want "
+                       "'point[@index][#nth]:raise' or "
+                       "'point[@index][#nth]:stall:seconds')"
+                       % (ENV_SERVE, spec))
   _validated = sig
 
 
@@ -193,6 +221,25 @@ def _parse_delay_spec(spec: str):
           int(parts[2]) if len(parts) == 3 else None)
 
 
+def _parse_serve_spec(spec: str):
+  """``"point[@index][#nth]:raise"`` / ``"...:stall:seconds"`` →
+  ((name, index, nth), action, seconds_or_None)."""
+  parts = spec.split(":")
+  if len(parts) < 2 or not parts[0]:
+    raise ValueError(spec)
+  target = _parse_point_spec(parts[0])
+  action = parts[1]
+  if action == "raise":
+    if len(parts) != 2:
+      raise ValueError(spec)
+    return target, action, None
+  if action == "stall":
+    if len(parts) != 3:
+      raise ValueError(spec)
+    return target, action, float(parts[2])
+  raise ValueError(spec)
+
+
 def _sentinel_path(name: str, index) -> str:
   safe = re.sub(r"[^A-Za-z0-9_.-]", "_", "%s_%s" % (name, index))
   return os.path.join(os.getcwd(), ".tos_chaos_fired_%s" % safe)
@@ -254,6 +301,52 @@ def stall_point(name: str, index: Optional[int] = None) -> float:
     time.sleep(duration)
     return duration
   return 0.0
+
+
+def serve_fault(name: str, index: Optional[int] = None) -> None:
+  """Deterministic serving-plane fault site (``serving.slots`` arms
+  ``prefill``/``decode``): raise :class:`InjectedFault` or stall when a
+  ``TOS_CHAOS_SERVE`` spec matches this invocation.
+
+  Two invocation counters run per point: a GLOBAL one (specs without
+  ``@index``: "the nth time this point fires at all") and a per-index
+  one (specs with ``@index``: "the nth time it fires for THIS index").
+  The ``prefill`` point passes the prompt length as its index — the only
+  stable identity a spec can name before request ids are assigned — so a
+  per-index spec turns one crafted prompt into a deterministic poison
+  request (docs/ROBUSTNESS.md).
+  """
+  _first_consult()
+  spec_env = os.environ.get(ENV_SERVE)
+  if not spec_env:
+    return
+  check_config()
+  point = "serve." + name
+  with _lock:
+    gcount = _counts[(point, None)] = _counts.get((point, None), 0) + 1
+    icount = gcount
+    if index is not None:
+      icount = _counts[(point, index)] = \
+          _counts.get((point, index), 0) + 1
+  for spec in _split_specs(spec_env):
+    (sname, sindex, nth), action, secs = _parse_serve_spec(spec)
+    if sname != name:
+      continue
+    if sindex is None:
+      if gcount != nth:
+        continue
+    elif sindex != index or icount != nth:
+      continue
+    if action == "stall":
+      logger.warning("chaos: stalling %.2fs at serving point %r index %r "
+                     "(occurrence %d)", secs, name, index, nth)
+      time.sleep(secs)
+      continue
+    logger.warning("chaos: raising at serving point %r index %r "
+                   "(occurrence %d)", name, index, nth)
+    raise InjectedFault(
+        "chaos: injected fault at serving point %r (occurrence %d)"
+        % (name, nth))
 
 
 def message_fault(verb) -> Tuple[bool, float]:

@@ -79,6 +79,34 @@ def test_tos001_ignores_driver_only_code():
   assert not [f for f in result["findings"] if f.rule == "TOS001"]
 
 
+TOS001_SERVE_BAD = '''
+def make_task_fn(eng):
+  def _task(it):
+    eng.cancel()
+    eng.drain()
+  return _task
+'''
+
+TOS001_SERVE_GOOD = '''
+def make_task_fn(eng, rec):
+  def _task(it):
+    eng.cancel(timeout=5.0)
+    eng.drain(timeout=30.0)
+    rec.drain(512)          # nonblocking drain(max_items) idiom: exempt
+  return _task
+'''
+
+
+def test_tos001_flags_unbounded_serving_waits():
+  """The serving engine's bounded waits (cancel parks on slot release,
+  drain on in-flight work) need explicit deadlines like wait/join."""
+  result = analyze_snippet(TOS001_SERVE_BAD)
+  tos1 = [f for f in result["findings"] if f.rule == "TOS001"]
+  assert {f.detail for f in tos1} == {"serve.cancel", "serve.drain"}
+  assert not [f for f in analyze_snippet(TOS001_SERVE_GOOD)["findings"]
+              if f.rule == "TOS001"]
+
+
 def test_tos001_subprocess_without_timeout():
   src = '''
 import subprocess
@@ -489,6 +517,9 @@ class TestChaosConfigValidation:
     monkeypatch.setenv(chaos.ENV_STALL, "feeder@1:3")
     monkeypatch.setenv(chaos.ENV_RV_DROP, "BEAT:3")
     monkeypatch.setenv(chaos.ENV_RV_DELAY, "BEAT:0.5:2,REG:1.5")
+    monkeypatch.setenv(chaos.ENV_SERVE,
+                       "decode#3:raise,prefill@13#2:raise,"
+                       "decode#1:stall:0.5")
     chaos.reset()
     assert chaos.enabled()
     chaos.check_config()   # must not raise
@@ -508,6 +539,12 @@ class TestChaosConfigValidation:
       (chaos.ENV_RV_DROP, "BEAT:many"),        # non-int count
       (chaos.ENV_RV_DELAY, "BEAT"),            # missing seconds
       (chaos.ENV_RV_DELAY, "BEAT:1:2:3"),      # too many fields
+      (chaos.ENV_SERVE, "decode#1"),           # missing action
+      (chaos.ENV_SERVE, "decode#1:explode"),   # unknown action
+      (chaos.ENV_SERVE, "decode#1:stall"),     # stall without seconds
+      (chaos.ENV_SERVE, "decode#1:stall:x"),   # non-float seconds
+      (chaos.ENV_SERVE, "decode#1:raise:2"),   # raise takes no operand
+      (chaos.ENV_SERVE, "prefill@x#1:raise"),  # non-int index
   ])
   def test_malformed_specs_rejected(self, monkeypatch, env, value):
     monkeypatch.setenv(env, value)

@@ -266,6 +266,41 @@ class TestServingSaturationDetector:
     assert det.poll(now=10.0) == []
 
 
+class TestServeCrashLoopDetector:
+  def test_fires_on_restart_burst(self):
+    """TOS_OBS_CRASH_LOOP (default 2) engine restarts inside one window
+    = a crash loop: one self-heal is routine, repeated ones mean a
+    poison request slipped detection or the device is failing."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__engine_restarts=1, serve__replays=3)
+    det.poll(now=0.0)
+    sink.set(0, serve__engine_restarts=3, serve__replays=9)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["serve_crash_loop"]
+    assert alerts[0]["evidence"]["restarts"] == 2
+    assert alerts[0]["evidence"]["replays"] == 6
+    assert alerts[0]["evidence"]["total_restarts"] == 3
+
+  def test_single_recovery_stays_quiet(self):
+    """ONE crash-replay inside a window is the self-healing design
+    working — just below the threshold, no alert."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__engine_restarts=0)
+    det.poll(now=0.0)
+    sink.set(0, serve__engine_restarts=1)
+    assert det.poll(now=10.0) == []
+
+  def test_no_serving_executor_is_exempt(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, train__steps=0)
+    det.poll(now=0.0)
+    sink.set(0, train__steps=50)
+    assert det.poll(now=10.0) == []
+
+
 class TestMemorySlopeDetector:
   def test_fires_on_monotonic_creep(self):
     sink = FakeSink(eids=(0,))

@@ -50,11 +50,44 @@ def _kill_victim(spec, cwd):
 class TestChaosPrimitives:
   def test_disarmed_points_are_noops(self, monkeypatch):
     for var in (chaos.ENV_KILL, chaos.ENV_STALL, chaos.ENV_RV_DROP,
-                chaos.ENV_RV_DELAY):
+                chaos.ENV_RV_DELAY, chaos.ENV_SERVE):
       monkeypatch.delenv(var, raising=False)
     chaos.kill_point("anything", index=3)      # must not kill us
     assert chaos.stall_point("anything") == 0.0
     assert chaos.message_fault("BEAT") == (False, 0.0)
+    chaos.serve_fault("decode")                # must not raise
+
+  def test_serve_fault_raises_on_nth_global_occurrence(self, monkeypatch):
+    monkeypatch.setenv(chaos.ENV_SERVE, "decode#3:raise")
+    chaos.serve_fault("decode")
+    chaos.serve_fault("decode")
+    with pytest.raises(chaos.InjectedFault, match="decode"):
+      chaos.serve_fault("decode")
+    chaos.serve_fault("decode")                # 4th: budget spent
+    chaos.serve_fault("prefill", index=8)      # other point untouched
+
+  def test_serve_fault_per_index_count(self, monkeypatch):
+    """@index specs count per caller index: the poison-request selector
+    (prefill passes the prompt length) fires only for ITS length, and
+    every time a spec names that occurrence."""
+    monkeypatch.setenv(chaos.ENV_SERVE,
+                       "prefill@13#1:raise,prefill@13#2:raise")
+    chaos.serve_fault("prefill", index=5)      # other length: sails
+    with pytest.raises(chaos.InjectedFault):
+      chaos.serve_fault("prefill", index=13)   # 1st occurrence of @13
+    chaos.serve_fault("prefill", index=5)
+    with pytest.raises(chaos.InjectedFault):
+      chaos.serve_fault("prefill", index=13)   # 2nd occurrence of @13
+    chaos.serve_fault("prefill", index=13)     # 3rd: budget spent
+
+  def test_serve_fault_stall_sleeps_then_proceeds(self, monkeypatch):
+    monkeypatch.setenv(chaos.ENV_SERVE, "decode#2:stall:0.2")
+    t0 = time.monotonic()
+    chaos.serve_fault("decode")                # 1st: no stall
+    assert time.monotonic() - t0 < 0.1
+    t0 = time.monotonic()
+    chaos.serve_fault("decode")                # 2nd: stalls, returns
+    assert time.monotonic() - t0 >= 0.2
 
   def test_kill_point_sigkills_on_nth_invocation(self, monkeypatch, tmp_path):
     """A kill spec 'p@idx#n' SIGKILLs the calling process on invocation n

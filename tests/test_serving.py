@@ -7,6 +7,8 @@ single-request ``greedy_generate_kv`` decode. Everything else (slot
 accounting, queue semantics, knobs) is bookkeeping around that.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -15,8 +17,10 @@ import jax.numpy as jnp
 
 from tensorflowonspark_tpu.models import transformer as tfm
 from tensorflowonspark_tpu.serving import (
-    DEFAULT_BUCKETS, Request, RequestQueue, ServingEngine, SlotDecoder,
-    chunk_plan)
+    DEFAULT_BUCKETS, DeadlineExceeded, PoisonedRequest, Request,
+    RequestCancelled, RequestQueue, ServingEngine, ServingOverloaded,
+    SlotDecoder, chunk_plan)
+from tensorflowonspark_tpu.utils import chaos
 
 EOS = 7
 PAD = 0
@@ -73,10 +77,83 @@ class TestRequestQueue:
     q.push(a)
     q.push(b)
     assert len(q) == 2
+    assert q.token_mass == a.token_cost + b.token_cost
     assert q.wait_nonempty(timeout=0.05) is True
     assert q.pop_nowait() is a
-    assert q.drain() == [b]
-    assert len(q) == 0
+    assert q.close(RuntimeError("bye")) == [b]
+    assert len(q) == 0 and q.token_mass == 0
+
+  def test_bounds_and_oversized_when_empty(self):
+    q = RequestQueue()
+    big = Request([1] * 10, 100)            # token_cost 110
+    q.push_bounded(big, max_requests=2, max_tokens=50)  # empty: admitted
+    with pytest.raises(ServingOverloaded) as ei:
+      q.push_bounded(Request([1], 4), max_requests=2, max_tokens=50)
+    assert ei.value.queue_depth == 1
+    assert ei.value.queued_tokens == big.token_cost
+    q.pop_nowait()
+    q.push_bounded(Request([1], 4), max_requests=1, max_tokens=0)
+    with pytest.raises(ServingOverloaded, match="TOS_SERVE_MAX_QUEUE"):
+      q.push_bounded(Request([2], 4), max_requests=1, max_tokens=0)
+
+  def test_closed_queue_refuses_push_atomically(self):
+    """The submit-vs-loop-death race fix: close-and-drain happens under
+    the same lock push uses, so a racing push lands before the drain or
+    fails — never between (an orphan nobody would ever finish)."""
+    from tensorflowonspark_tpu.serving.scheduler import QueueClosed
+    q = RequestQueue()
+    root = RuntimeError("loop died")
+    assert q.close(root) == []
+    with pytest.raises(QueueClosed) as ei:
+      q.push(Request([1], 4))
+    assert ei.value.__cause__ is root
+    with pytest.raises(QueueClosed):
+      q.push_bounded(Request([1], 4))
+    # a second close keeps the FIRST verdict
+    q.close(RuntimeError("later"))
+    with pytest.raises(QueueClosed) as ei:
+      q.push_front(Request([1], 4))
+    assert ei.value.__cause__ is root
+    q.reopen()
+    q.push(Request([1], 4))
+    assert len(q) == 1
+
+  def test_reap_removes_matching_and_keeps_order(self):
+    q = RequestQueue()
+    reqs = [Request([i], 4) for i in range(1, 5)]
+    for r in reqs:
+      q.push(r)
+    removed = q.reap(lambda r: r.rid in (reqs[1].rid, reqs[3].rid))
+    assert removed == [reqs[1], reqs[3]]
+    assert q.pop_nowait() is reqs[0]
+    assert q.pop_nowait() is reqs[2]
+    assert q.token_mass == 0
+
+  def test_replay_suppression_dedups_and_checks_parity(self):
+    r = Request([9, 9], 8)
+    for t in (3, 4, 5):
+      r.emit(t)
+    r.begin_replay()
+    assert r.generated == 0                 # budget math restarts
+    assert r.emit(3) and r.emit(4)
+    assert r.generated == 2
+    assert r.emit(6) is False               # divergence is reported
+    assert r.emit(7)                        # suppression exhausted: live
+    assert r.tokens == [3, 4, 5, 7]
+    # the stream saw each position once: 3,4,5 pre-crash, then 7
+    seen = []
+    while not r.stream_q.empty():
+      seen.append(r.stream_q.get_nowait())
+    assert seen == [3, 4, 5, 7]
+
+  def test_finish_is_idempotent(self):
+    r = Request([1], 2)
+    first = RuntimeError("first verdict")
+    r.finish(first)
+    r.finish(RuntimeError("second"))
+    assert r.error is first
+    assert r.stream_q.get_nowait() is None
+    assert r.stream_q.empty()               # exactly one sentinel
 
 
 class TestSlotDecoder:
@@ -258,6 +335,351 @@ class TestServingEngine:
         == tuple(DEFAULT_BUCKETS)
 
 
+class TestAdmissionControl:
+  def test_queue_bound_rejects_with_structured_error(self, tiny_state):
+    """At TOS_SERVE_MAX_QUEUE the engine REJECTS — structured, with a
+    retry-after hint — it never queues unboundedly and never hangs."""
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, max_queue=2,
+                        max_queued_tokens=0)      # not started: queue holds
+    eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+    eng.submit(np.asarray([3, 4], np.int32), max_new_tokens=4)
+    with pytest.raises(ServingOverloaded) as ei:
+      eng.submit(np.asarray([5, 6], np.int32), max_new_tokens=4)
+    assert ei.value.queue_depth == 2
+    assert ei.value.queued_tokens == 12           # 2 × (2 prompt + 4 budget)
+    assert ei.value.retry_after is not None and ei.value.retry_after > 0
+    assert not ei.value.draining
+    assert eng.stats["rejected"] == 1
+    eng.stop()
+
+  def test_token_mass_bound_and_oversized_admission(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, max_queue=0,
+                        max_queued_tokens=20)
+    # oversized vs the bound but the queue is empty: admitted (it CAN be
+    # served — the bound is about backlog, the feedhub rule)
+    eng.submit(np.asarray([1] * 10, np.int32), max_new_tokens=30)
+    with pytest.raises(ServingOverloaded,
+                       match="TOS_SERVE_MAX_QUEUED_TOKENS"):
+      eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+    eng.stop()
+
+  def test_env_knobs_register_and_apply(self, tiny_state, monkeypatch):
+    cfg, state = tiny_state
+    monkeypatch.setenv("TOS_SERVE_MAX_QUEUE", "3")
+    monkeypatch.setenv("TOS_SERVE_MAX_QUEUED_TOKENS", "999")
+    monkeypatch.setenv("TOS_SERVE_MAX_RESTARTS", "7")
+    monkeypatch.setenv("TOS_SERVE_POISON_CRASHES", "4")
+    monkeypatch.setenv("TOS_SERVE_TTL", "2.5")
+    eng = ServingEngine(state.params, cfg)
+    assert eng.max_queue == 3
+    assert eng.max_queued_tokens == 999
+    assert eng.max_restarts == 7
+    assert eng.poison_crashes == 4
+    assert eng.default_ttl == 2.5
+    # explicit arguments beat the env knobs (the num_slots rule)
+    eng2 = ServingEngine(state.params, cfg, max_queue=9,
+                         poison_crashes=1, default_ttl=0)
+    assert eng2.max_queue == 9 and eng2.poison_crashes == 1
+    assert eng2.default_ttl is None
+
+
+class TestDeadlinesAndCancel:
+  def test_dead_on_arrival_rejected_at_submit(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1)
+    with pytest.raises(DeadlineExceeded):
+      eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4,
+                 deadline=time.monotonic() - 0.01)
+    with pytest.raises(ValueError, match="deadline OR ttl"):
+      eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4,
+                 deadline=time.monotonic() + 5, ttl=5)
+    assert eng.stats["expired"] == 1
+    eng.stop()
+
+  def test_queued_expiry_never_takes_a_slot(self, tiny_state):
+    """A request whose TTL runs out while queued fails with
+    DeadlineExceeded at admission — zero prefills spent on it."""
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS)
+    rid = eng.submit(np.asarray([1, 2, 3], np.int32), max_new_tokens=4,
+                     ttl=0.05)
+    time.sleep(0.15)                        # expires while engine is down
+    eng.start()
+    with pytest.raises(DeadlineExceeded):
+      eng.result(rid, timeout=30)
+    assert eng.stats["expired"] == 1
+    assert eng.stats["prefills"] == 0
+    eng.stop()
+
+  def test_cancel_queued_request(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1)   # not started
+    rid = eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+    assert eng.cancel(rid, timeout=5.0) is True
+    with pytest.raises(RequestCancelled):
+      eng.result(rid, timeout=5)
+    assert eng.stats["cancelled"] == 1
+    assert eng.stats["prefills"] == 0
+    eng.stop()
+
+  def test_cancel_inflight_frees_slot_like_eos(self, tiny_state):
+    """cancel(rid) on an in-flight request frees its slot at the next
+    horizon boundary: the 1-slot engine must go on to serve the next
+    request bit-identically."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(11)
+    a = rng.randint(1, 64, (6,)).astype(np.int32)
+    b = rng.randint(1, 64, (4,)).astype(np.int32)
+    with ServingEngine(state.params, cfg, num_slots=1, eos_id=None,
+                       horizon=2, poll_interval=0.01) as eng:
+      # no eos: A runs its full (large) budget unless cancelled
+      rid_a = eng.submit(a, max_new_tokens=40)
+      deadline = time.monotonic() + 30
+      while eng.stats["prefills"] < 1:      # wait until A is in flight
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+      rid_b = eng.submit(b, max_new_tokens=5)
+      assert eng.cancel(rid_a, timeout=30) is True
+      with pytest.raises(RequestCancelled):
+        eng.result(rid_a, timeout=5)
+      out_b = eng.result(rid_b, timeout=60)
+      assert eng.stats["cancelled"] == 1
+    ref_b = np.asarray(tfm.greedy_generate_kv(
+        state.params, cfg, jnp.asarray(b)[None], 5, eos_id=None,
+        pad_id=PAD))[0]
+    np.testing.assert_array_equal(out_b, ref_b)
+
+  def test_cancel_finished_request_is_noop_true(self, tiny_state):
+    cfg, state = tiny_state
+    with ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS) as eng:
+      rid = eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=3)
+      req = eng.request(rid)
+      req.done.wait(timeout=60)
+      assert eng.cancel(rid, timeout=1.0) is True
+      assert eng.result(rid, timeout=5) is not None
+
+
+class TestDrain:
+  def test_drain_finishes_accepted_work_then_stops(self, tiny_state):
+    cfg, state = tiny_state
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 64, (4,)).astype(np.int32)
+               for _ in range(5)]
+    eng = ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS).start()
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    assert eng.drain(timeout=120) is True
+    # admission is closed, structurally (a rolling restart sheds no
+    # accepted work but accepts no new work)
+    with pytest.raises(ServingOverloaded) as ei:
+      eng.submit(prompts[0], max_new_tokens=6)
+    assert ei.value.draining
+    # every accepted request's result is still retrievable after drain
+    for p, rid in zip(prompts, rids):
+      out = eng.result(rid, timeout=5)
+      np.testing.assert_array_equal(out,
+                                    _reference(state.params, cfg, p, 6))
+    assert not eng.alive                    # stopped: cached callers rebuild
+
+  def test_drain_then_restart_serves_again(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS)
+    eng.start()
+    assert eng.drain(timeout=60) is True    # nothing in flight: instant
+    eng.start()                             # the rolling-restart pattern
+    p = np.asarray([4, 5, 6], np.int32)
+    out = eng.result(eng.submit(p, max_new_tokens=4), timeout=60)
+    np.testing.assert_array_equal(out,
+                                  _reference(state.params, cfg, p, 4))
+    eng.stop()
+
+
+class TestFailFast:
+  def test_result_on_never_started_engine_fails_fast(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1)
+    rid = eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=2)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="never started"):
+      eng.result(rid, timeout=600)          # must NOT burn 600s
+    assert time.monotonic() - t0 < 5.0
+    eng.stop()
+
+  def test_stream_on_never_started_engine_fails_fast(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1)
+    rid = eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=2)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="never started"):
+      list(eng.stream(rid, timeout=600))
+    assert time.monotonic() - t0 < 5.0
+    eng.stop()
+
+  def test_submit_after_stop_fails_fast(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1)
+    eng.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+      eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=2)
+
+  def test_stop_is_idempotent_and_safe_before_start(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS)
+    eng.stop()                              # never started: no-op, safe
+    eng.stop()                              # idempotent
+    eng.start()                             # still startable after stop
+    p = np.asarray([7, 8], np.int32)
+    out = eng.result(eng.submit(p, max_new_tokens=3), timeout=60)
+    np.testing.assert_array_equal(out,
+                                  _reference(state.params, cfg, p, 3))
+    eng.stop()
+    eng.stop()
+
+
+@pytest.mark.chaos
+class TestServingChaos:
+  """TOS_CHAOS_SERVE-driven recovery proofs (make chaos-serve): the
+  self-healing contract is exercised under injected faults, not assumed.
+  Chaos counters are per-process — every test resets them."""
+
+  @pytest.fixture(autouse=True)
+  def _fresh_chaos(self, monkeypatch):
+    chaos.reset()
+    yield
+    monkeypatch.delenv(chaos.ENV_SERVE, raising=False)
+    chaos.reset()
+
+  def test_decode_crash_replays_bit_identical(self, tiny_state,
+                                              monkeypatch):
+    """THE acceptance pin: a decode-dispatch crash mid-run is healed by
+    replaying every in-flight request from its prompt — outputs stay
+    bit-identical to uninjured single-request decodes, the engine stays
+    alive, and the restart/replay counters fire."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(1, 64, (int(p),)).astype(np.int32)
+               for p in (4, 7, 5, 9, 6, 8)]
+    monkeypatch.setenv(chaos.ENV_SERVE, "decode#2:raise")
+    with ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS,
+                       poison_crashes=3, restart_backoff=0.01) as eng:
+      outs = eng.generate(prompts, max_new_tokens=8, timeout=120)
+      stats = dict(eng.stats)
+      assert eng.alive
+      log = list(eng.restart_log)
+    assert stats["engine_restarts"] == 1
+    assert stats["replays"] >= 1
+    assert stats["replay_mismatches"] == 0
+    assert stats["poisoned"] == 0
+    assert len(log) == 1 and log[0]["duration_s"] >= 0.01
+    for p, out in zip(prompts, outs):
+      np.testing.assert_array_equal(
+          out, _reference(state.params, cfg, p, 8))
+
+  def test_stream_is_deduplicated_across_crash(self, tiny_state,
+                                               monkeypatch):
+    """A stream() consumer must see every position exactly once even
+    when the crash forces the engine to regenerate the prefix."""
+    cfg, state = tiny_state
+    p = np.asarray([3, 9, 4, 1], np.int32)
+    monkeypatch.setenv(chaos.ENV_SERVE, "decode#2:raise")
+    with ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS,
+                       horizon=1, poison_crashes=3,
+                       restart_backoff=0.01) as eng:
+      rid = eng.submit(p, max_new_tokens=10)
+      toks = list(eng.stream(rid, timeout=120))
+      assert eng.stats["engine_restarts"] == 1
+      assert eng.stats["replays"] == 1
+    ref = _reference(state.params, cfg, p, 10)
+    np.testing.assert_array_equal(np.asarray(toks, np.int32),
+                                  ref[len(p):])
+
+  def test_prefill_poison_request_isolated(self, tiny_state, monkeypatch):
+    """A request that deterministically crashes its own prefill (the
+    per-prompt-length chaos index) is failed as PoisonedRequest after
+    poison_crashes consecutive crashes — while its neighbors replay and
+    complete bit-identically. No crash loop, engine stays alive."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(31)
+    good_a = rng.randint(1, 64, (5,)).astype(np.int32)
+    poison = rng.randint(1, 64, (13,)).astype(np.int32)   # unique length
+    good_b = rng.randint(1, 64, (8,)).astype(np.int32)
+    monkeypatch.setenv(chaos.ENV_SERVE,
+                       "prefill@13#1:raise,prefill@13#2:raise")
+    with ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS,
+                       poison_crashes=2, restart_backoff=0.01) as eng:
+      rid_a = eng.submit(good_a, max_new_tokens=6)
+      rid_p = eng.submit(poison, max_new_tokens=6)
+      rid_b = eng.submit(good_b, max_new_tokens=6)
+      out_a = eng.result(rid_a, timeout=120)
+      out_b = eng.result(rid_b, timeout=120)
+      with pytest.raises(PoisonedRequest,
+                         match="consecutive engine crashes"):
+        eng.result(rid_p, timeout=120)
+      assert eng.alive                      # healed, not dead
+      assert eng.stats["engine_restarts"] == 2
+      assert eng.stats["poisoned"] == 1
+      # the poison verdict chains the actual crash cause
+      assert eng.stats["replay_mismatches"] == 0
+    np.testing.assert_array_equal(
+        out_a, _reference(state.params, cfg, good_a, 6))
+    np.testing.assert_array_equal(
+        out_b, _reference(state.params, cfg, good_b, 6))
+
+  def test_stall_blows_deadline_and_frees_slot(self, tiny_state,
+                                               monkeypatch):
+    """A stall fault (hung-device stand-in) makes an in-flight request
+    miss its deadline: it is reaped at the horizon boundary — freeing
+    the slot exactly like EOS — and a later request completes."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(41)
+    victim = rng.randint(1, 64, (6,)).astype(np.int32)
+    healthy = rng.randint(1, 64, (4,)).astype(np.int32)
+    with ServingEngine(state.params, cfg, num_slots=1, eos_id=None,
+                       horizon=2, poll_interval=0.01) as eng:
+      # warm every jit (prefill buckets for both lengths + the fused
+      # step) so the timed phase measures the stall, not compilation
+      eng.generate([victim, healthy], max_new_tokens=2, timeout=120)
+      monkeypatch.setenv(chaos.ENV_SERVE, "decode#1:stall:0.5")
+      chaos.reset()
+      rid_v = eng.submit(victim, max_new_tokens=40, ttl=0.2)
+      with pytest.raises(DeadlineExceeded):
+        eng.result(rid_v, timeout=60)
+      monkeypatch.delenv(chaos.ENV_SERVE)
+      chaos.reset()
+      rid_h = eng.submit(healthy, max_new_tokens=4)
+      out_h = eng.result(rid_h, timeout=60)
+      assert eng.stats["expired"] == 1
+    ref_h = np.asarray(tfm.greedy_generate_kv(
+        state.params, cfg, jnp.asarray(healthy)[None], 4, eos_id=None,
+        pad_id=PAD))[0]
+    np.testing.assert_array_equal(out_h, ref_h)
+
+  def test_terminal_death_fails_everyone_fast(self, tiny_state,
+                                              monkeypatch):
+    """max_restarts=0: the first crash is terminal. Every waiter gets
+    the root cause promptly, and submit fails fast instead of orphaning
+    a request behind the dying loop's drain (the PR race fix)."""
+    cfg, state = tiny_state
+    p = np.asarray([2, 3, 4], np.int32)
+    monkeypatch.setenv(chaos.ENV_SERVE, "decode#1:raise")
+    eng = ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS,
+                        max_restarts=0).start()
+    try:
+      rid = eng.submit(p, max_new_tokens=8)
+      t0 = time.monotonic()
+      with pytest.raises(RuntimeError, match="request %d failed" % rid):
+        eng.result(rid, timeout=600)
+      assert time.monotonic() - t0 < 30.0   # not the 600s timeout
+      assert not eng.alive
+      # submit now fails immediately with the loop's root cause
+      with pytest.raises(RuntimeError, match="serving loop died") as ei:
+        eng.submit(p, max_new_tokens=2)
+      assert isinstance(ei.value.__cause__, chaos.InjectedFault)
+    finally:
+      eng.stop()
+
+
 class TestServingPredictFn:
   def test_ragged_batch_routes_through_engine(self, tiny_state):
     """TFModel.transform's ragged-column fallback: variable-length
@@ -301,6 +723,27 @@ class TestServingPredictFn:
     ref = np.asarray(tfm.greedy_generate_kv(
         state.params, cfg, jnp.asarray(batch), 4, eos_id=EOS, pad_id=PAD))
     np.testing.assert_array_equal(out, ref)
+
+  def test_ragged_path_ignores_client_admission_bounds(self, tiny_state,
+                                                       monkeypatch):
+    """The transform path's internal engine must NOT inherit the
+    client-facing admission bounds: a ragged partition larger than
+    TOS_SERVE_MAX_QUEUE served fine before the robustness PR and must
+    keep serving — bounds are for direct ServingEngine users."""
+    cfg, state = tiny_state
+    monkeypatch.setenv("TOS_SERVE_MAX_QUEUE", "2")
+    monkeypatch.setenv("TOS_SERVE_MAX_QUEUED_TOKENS", "8")
+    fn = tfm.make_serving_predict_fn(cfg, 3, eos_id=EOS, pad_id=PAD,
+                                     num_slots=1)
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(1, 64, (n,)).astype(np.int32)
+               for n in (3, 5, 4, 6, 3, 5)]       # 6 rows >> bound of 2
+    col = np.empty(len(prompts), object)
+    col[:] = prompts
+    out = fn(state.params, {"x": col})["tokens"]
+    for i, p in enumerate(prompts):
+      ref = _reference(state.params, cfg, p, 3)
+      np.testing.assert_array_equal(out[i, :len(ref)], ref)
 
   def test_ragged_sampling_rejected(self, tiny_state):
     cfg, state = tiny_state

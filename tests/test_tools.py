@@ -109,6 +109,39 @@ class TestServeBenchCompareSmoke:
     assert result["static"]["fixed_steps"] in result["workload"]["budgets"]
 
 
+class TestServeBenchChaosSmoke:
+  def test_chaos_smoke_recovers_with_bit_parity(self):
+    """`serve_bench --chaos --smoke` injects a REAL deterministic decode
+    crash (TOS_CHAOS_SERVE) into the engine mid-workload and measures
+    the recovery: tier-1 re-proves on every CI run that crash-replay
+    reproduces bit-identical outputs, that the restart actually fired,
+    and that recovery latency is measured and bounded."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "serve_bench.py"),
+         "--chaos", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "serving_chaos_goodput"
+    assert result["parity_ok"] is True
+    assert result["chaos"]["restarts"] >= 1
+    assert result["chaos"]["replays"] >= 1
+    assert result["chaos"]["poisoned"] == 0
+    assert result["chaos"]["replay_mismatches"] == 0
+    assert result["clean"]["tok_s"] > 0 and result["chaos"]["tok_s"] > 0
+    assert 0 < result["goodput_ratio"] <= 1.5
+    rec = result["recovery_latency_s"]
+    assert rec["events"] >= 1 and rec["median"] is not None
+
+
 class TestObsReportSmoke:
   def test_smoke_merges_aligned_trace_from_cluster_run(self, tmp_path):
     """`obs_report --smoke` drives a REAL 2-process LocalEngine

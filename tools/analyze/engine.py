@@ -95,6 +95,10 @@ EXTRA_ROOT_PATTERNS = [
     # registry/tracer seams in user main fns) — analyze all of it as
     # executor-reachable
     "*.obs.*",
+    # the continuous-batching serving runtime runs inside executors too
+    # (make_serving_predict_fn's cached engine under TFModel.transform):
+    # its loop thread + every client wait get the full TOS discipline
+    "*.serving.*",
 ]
 
 

@@ -135,6 +135,23 @@ def check_tos001(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
                     ".wait() without timeout= blocks forever if the event "
                     "is never set / the process never exits")
       continue
+    if name in ("cancel", "drain") and not node.args \
+        and "timeout" not in kws:
+      # serving.ServingEngine's bounded waits: cancel parks until the
+      # slot is actually released, drain until accepted work finishes —
+      # ServingEngine REQUIRES the timeout (wait_alert house style), and
+      # this keeps future call sites on other engines honest. Zero-arg
+      # only, like wait/join: positional-arg calls are the nonblocking
+      # drain(max_items)/cancel(rid, t) idioms. Known residual: a
+      # zero-arg nonblocking .cancel() (threading.Timer) in
+      # executor-reachable code would need an inline suppression.
+      yield Finding("TOS001", fn.path, node.lineno, fn.qualname,
+                    "serve.%s" % name,
+                    ".%s() without timeout= parks on engine progress "
+                    "(slot release / in-flight completion) — the "
+                    "deadline must be the caller's choice; pass an "
+                    "explicit timeout=" % name)
+      continue
     if name in ("recv", "recvfrom") and recv is not None \
         and not _sock_created_locally(fn, recv):
       # sockets created in this function are TOS002's job; recv on a

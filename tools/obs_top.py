@@ -129,6 +129,12 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "feed_stage_frac": stage_rates,
         "occupancy": m.get("serve.occupancy"),
         "queue_depth": m.get("serve.queue_depth"),
+        # serving robustness counters (docs/ROBUSTNESS.md): restarts =
+        # crash-replay recoveries, replays = requests replayed through
+        # them, rejected = admission-control rejections
+        "engine_restarts": m.get("serve.engine_restarts"),
+        "replays": m.get("serve.replays"),
+        "rejected": m.get("serve.rejected"),
         "mem_in_use": m.get("device.bytes_in_use"),
         "mem_peak": m.get("device.peak_bytes"),
         "compiles": m.get("xla.compiles"),
@@ -160,6 +166,14 @@ def render(snap, clear=True):
       feed = "  feed[" + " ".join(
           "%s %.0f%%" % (k.replace("_s", ""), 100 * v)
           for k, v in stages.items()) + "]"
+    srv = [(lbl, row.get(key)) for lbl, key in
+           (("restarts", "engine_restarts"), ("replays", "replays"),
+            ("rej", "rejected")) if row.get(key)]
+    if srv:
+      # self-healing activity is an operator signal: surface it the
+      # moment any recovery/rejection counter moves
+      feed += "  serve[" + " ".join("%s %d" % (lbl, v)
+                                    for lbl, v in srv) + "]"
     lines.append(
         "%-4s %-9s %8s %8s %6s %6s %9s %8s %7s %7s%s" % (
             eid, row["state"] or "?",

@@ -19,8 +19,18 @@ refilled immediately. Reports aggregate tokens/sec (useful tokens only
 verifies every engine output is BIT-IDENTICAL to the single-request
 decode of the same prompt. `--smoke` shrinks the shapes for CI.
 
+`--chaos` measures the engine's SELF-HEALING cost (docs/ROBUSTNESS.md):
+the same workload runs paired — one clean pass, one with deterministic
+`TOS_CHAOS_SERVE` faults injected into the decode dispatch — through
+ONE engine, and the report carries degraded goodput (chaos vs clean
+tokens/s), recovery latency (crash → in-flight work replay-requeued,
+off `ServingEngine.restart_log`), and the replay/restart counters. The
+acceptance bar rides along: every recovered output must stay
+BIT-IDENTICAL to its single-request decode (greedy replay parity).
+
 Usage: python tools/serve_bench.py [--batch 8] [--prompt 128] [--steps 128]
        python tools/serve_bench.py --compare [--smoke] [--json-out f.json]
+       python tools/serve_bench.py --chaos [--smoke] [--json-out f.json]
 """
 
 import argparse
@@ -279,6 +289,155 @@ def measure_compare(params, cfg, workload, slots, eos_id, useful, horizon,
   return median
 
 
+# --- chaos mode: goodput + recovery latency under injected faults -----------
+
+#: deterministic fault schedules for --chaos (TOS_CHAOS_SERVE grammar,
+#: utils/chaos.py): decode#N counts fused decode dispatches, so the
+#: crashes land mid-run with requests in flight on every seed
+_CHAOS_FULL_SPEC = "decode#6:raise,decode#18:raise"
+_CHAOS_SMOKE_SPEC = "decode#3:raise"
+
+
+def run_chaos_pass(eng, workload):
+  """One engine pass that tolerates per-request failures; returns
+  (wall_s, outputs_or_None, stats delta, failed count)."""
+  snap = eng.stats_snapshot()
+  t0 = time.perf_counter()
+  rids = [eng.submit(p, max_new_tokens=b) for p, b in workload]
+  outs, failed = [], 0
+  for rid in rids:
+    try:
+      outs.append(eng.result(rid, timeout=600))
+    except Exception as e:  # noqa: BLE001 - a poisoned/failed request is
+      # a reportable outcome here, not a bench crash
+      sys.stderr.write("chaos pass request failed: %r\n" % (e,))
+      outs.append(None)
+      failed += 1
+  return time.perf_counter() - t0, outs, snap.delta(), failed
+
+
+def measure_chaos(params, cfg, workload, slots, eos_id, useful, horizon,
+                  reps, spec):
+  """Paired clean/chaos reps through ONE engine (same jit caches both
+  legs); the chaos env is armed only around the chaos leg and the chaos
+  invocation counters reset per rep so the same faults re-fire."""
+  import numpy as np
+  from tensorflowonspark_tpu.serving import ServingEngine
+  from tensorflowonspark_tpu.utils import chaos
+
+  # poison_crashes above the injected crash count: the schedule injects
+  # infrastructure faults, not poison requests — nobody should be failed
+  eng = ServingEngine(params, cfg, num_slots=slots, eos_id=eos_id,
+                      pad_id=0, horizon=horizon,
+                      poison_crashes=spec.count("raise") + 1).start()
+  rows = []
+  try:
+    run_chaos_pass(eng, workload)          # warm every shape, no faults
+    for _ in range(reps):
+      c_wall, _, c_delta, c_failed = run_chaos_pass(eng, workload)
+      restarts_before = len(eng.restart_log)
+      os.environ[chaos.ENV_SERVE] = spec
+      chaos.reset()                        # per-rep deterministic counts
+      try:
+        x_wall, outs, x_delta, x_failed = run_chaos_pass(eng, workload)
+      finally:
+        del os.environ[chaos.ENV_SERVE]
+        chaos.reset()
+      recoveries = eng.restart_log[restarts_before:]
+      mismatches = sum(
+          1 for (prompt, _), out, ref in zip(workload, outs, useful)
+          if out is not None and
+          not np.array_equal(out, np.concatenate([prompt, ref])))
+      total_useful = float(sum(len(s) for s in useful))
+      rows.append({
+          "clean": {"tok_s": round(total_useful / c_wall, 2),
+                    "wall_s": round(c_wall, 3), "failed": c_failed},
+          "chaos": {"tok_s": round(total_useful / x_wall, 2),
+                    "wall_s": round(x_wall, 3),
+                    "restarts": int(x_delta.get("engine_restarts", 0)),
+                    "replays": int(x_delta.get("replays", 0)),
+                    "poisoned": int(x_delta.get("poisoned", 0)),
+                    "replay_mismatches":
+                        int(x_delta.get("replay_mismatches", 0)),
+                    "failed": x_failed,
+                    "parity_mismatches": mismatches},
+          "recovery_s": [round(r["duration_s"], 4) for r in recoveries],
+          "goodput_ratio": round(c_wall / x_wall, 3),
+      })
+  finally:
+    eng.stop()
+  rows.sort(key=lambda r: r["goodput_ratio"])
+  return rows[len(rows) // 2], rows
+
+
+def run_chaos(args):
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+
+  shape = _COMPARE_SMOKE if args.smoke else _COMPARE_FULL
+  if args.requests:
+    shape = dict(shape, requests=args.requests)
+  if args.slots:
+    shape = dict(shape, slots=args.slots)
+  spec = args.chaos_spec or (_CHAOS_SMOKE_SPEC if args.smoke
+                             else _CHAOS_FULL_SPEC)
+  cfg = tfm.TransformerConfig(
+      vocab_size=shape["vocab"], num_layers=shape["layers"],
+      num_heads=shape["heads"], d_model=shape["d_model"],
+      d_ff=shape["d_ff"], max_seq_len=shape["max_seq"], remat=False,
+      dtype=jnp.float32)   # f32: the bit-parity check must be exact
+  state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+  eos_id = 2
+  workload = make_workload(shape, args.seed)
+  useful = _reference_streams(state.params, cfg, workload, eos_id)
+  reps = args.reps if args.reps else (1 if args.smoke else 3)
+  median, rows = measure_chaos(state.params, cfg, workload,
+                               shape["slots"], eos_id, useful,
+                               shape["horizon"], reps, spec)
+  rec = sorted(s for r in rows for s in r["recovery_s"])
+  result = {
+      "metric": "serving_chaos_goodput",
+      "mode": "smoke" if args.smoke else "full",
+      "seed": args.seed, "reps": reps, "chaos_spec": spec,
+      "workload": {"requests": shape["requests"], "slots": shape["slots"],
+                   "useful_tokens": int(sum(len(s) for s in useful))},
+      "clean": median["clean"],
+      "chaos": median["chaos"],
+      "goodput_ratio": median["goodput_ratio"],
+      "per_rep_goodput_ratios": [r["goodput_ratio"] for r in rows],
+      "recovery_latency_s": {
+          "median": rec[len(rec) // 2] if rec else None,
+          "max": rec[-1] if rec else None,
+          "events": len(rec)},
+      "parity_ok": all(r["chaos"]["parity_mismatches"] == 0 and
+                       r["chaos"]["replay_mismatches"] == 0 and
+                       r["chaos"]["failed"] == 0 for r in rows),
+      "note": "paired clean vs TOS_CHAOS_SERVE-injected passes through "
+              "one engine; goodput_ratio = chaos/clean useful tokens/s "
+              "(1.0 = free recovery); recovery latency = crash detect "
+              "to in-flight replay requeued, incl. backoff "
+              "(ServingEngine.restart_log); parity_ok requires every "
+              "recovered output bit-identical to its single-request "
+              "decode and zero replay mismatches/failures",
+  }
+  line = json.dumps(result)
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    bench_history.append_record(
+        "serve_bench_chaos", result["chaos"]["tok_s"],
+        "%s-r%d-s%d-h%d-seed%d" % (result["mode"], shape["requests"],
+                                   shape["slots"], shape["horizon"],
+                                   args.seed),
+        extra={"goodput_ratio": result["goodput_ratio"],
+               "restarts": result["chaos"]["restarts"]})
+  print(line)
+  ok = result["parity_ok"] and result["chaos"]["restarts"] >= 1
+  return 0 if ok else 3
+
+
 def run_compare(args):
   import jax
   import jax.numpy as jnp
@@ -368,8 +527,15 @@ def main():
   ap.add_argument("--compare", action="store_true",
                   help="continuous (serving.ServingEngine) vs static "
                        "batching on a seeded mixed-length workload")
+  ap.add_argument("--chaos", action="store_true",
+                  help="paired clean vs fault-injected engine passes: "
+                       "degraded goodput + recovery latency under "
+                       "TOS_CHAOS_SERVE (parity re-verified)")
+  ap.add_argument("--chaos-spec", default=None,
+                  help="--chaos: override the injected TOS_CHAOS_SERVE "
+                       "fault schedule")
   ap.add_argument("--smoke", action="store_true",
-                  help="tiny --compare shapes for CI")
+                  help="tiny --compare/--chaos shapes for CI")
   ap.add_argument("--requests", type=int, default=0,
                   help="--compare workload size override")
   ap.add_argument("--slots", type=int, default=0,
@@ -383,12 +549,14 @@ def main():
   args = ap.parse_args()
   if args.compare:
     sys.exit(run_compare(args))
+  if args.chaos:
+    sys.exit(run_chaos(args))
   if args.smoke:
     # the per-config modes take their MODEL shape from bench.py, which
     # is fixed at import by TOS_BENCH_SMOKE — a flag can't shrink it
     # retroactively, so refuse a misleading half-smoke
-    sys.exit("--smoke shrinks --compare; for the per-config decode "
-             "modes set TOS_BENCH_SMOKE=1 instead")
+    sys.exit("--smoke shrinks --compare/--chaos; for the per-config "
+             "decode modes set TOS_BENCH_SMOKE=1 instead")
   if os.environ.get("TOS_BENCH_SMOKE"):
     args.batch, args.prompt, args.steps = 2, 16, 16
   wanted = (set(c.strip() for c in args.configs.split(",") if c.strip())
