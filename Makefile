@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test lint analyze check native bench serve-bench train-bench \
 	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
-	serve-bench-chaos obs-smoke obs-top-smoke bench-check
+	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
+	bench-check
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -98,6 +99,15 @@ bench:
 serve-bench:
 	$(PY) tools/serve_bench.py --compare \
 	  --json-out bench_artifacts/serve_bench_continuous.json
+
+# the decode-speed stack on a shared-system-prompt workload: paged KV at
+# equal HBM (more slots), +prefix cache, +self-speculative decode —
+# per-stage bit-parity gates; writes the committed artifact + a
+# serve_bench_prefix history line
+serve-bench-prefix:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --prefix-workload \
+	  --json-out bench_artifacts/serve_bench_prefix.json
 
 # AOT-compile every Pallas kernel + the full fused train step against a
 # deviceless v5e topology (real Mosaic lowering via local libtpu; no chip

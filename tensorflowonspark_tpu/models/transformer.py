@@ -106,6 +106,19 @@ class TransformerConfig:
   # no dequantized cache-sized copy exists in the program — asserted on
   # compiled TPU HLO (tests/test_mosaic_gate.py).
   kv_cache_dtype: str = "model"
+  # Paged KV decode cache (the serving plane's HBM-capacity lever,
+  # serving/slots.py): kv_page_size > 0 replaces each layer's contiguous
+  # [batch, max_seq_len, ...] decode cache with a shared page POOL
+  # ([kv_num_pages, kv_page_size, kv_heads, head_dim]) plus a per-slot
+  # page table ([batch, kv_pages_per_slot] int32) and a VECTOR cursor.
+  # A slot then holds only the pages its token mass needs, so slot count
+  # scales with actual tokens instead of num_slots × max_seq_len worst
+  # case. Page 0 is the TRASH page: never allocated, the sink for
+  # frozen-lane writes and unused table entries. Training/prefill paths
+  # are untouched (paging applies to decode=True with vector cursors).
+  kv_page_size: int = 0
+  kv_num_pages: int = 0
+  kv_pages_per_slot: int = 0
   # "gather": table lookup with the embed dim explicitly replicated first,
   # so SPMD slices the gather result instead of involuntarily rematerializing
   # the [B, S, D] activation (the round-2 dryrun warning); "one_hot": contract
@@ -146,6 +159,21 @@ class TransformerConfig:
     if self.kv_cache_dtype not in ("model", "int8"):
       raise ValueError("kv_cache_dtype must be 'model' or 'int8', got %r"
                        % (self.kv_cache_dtype,))
+    if self.kv_page_size < 0 or self.kv_num_pages < 0 \
+        or self.kv_pages_per_slot < 0:
+      raise ValueError("kv_page_size/kv_num_pages/kv_pages_per_slot must "
+                       "be >= 0")
+    if self.kv_page_size > 0:
+      if self.kv_num_pages < 2:
+        raise ValueError(
+            "paged KV needs kv_num_pages >= 2 (page 0 is the reserved "
+            "trash page), got %d" % (self.kv_num_pages,))
+      if self.kv_pages_per_slot < 1:
+        raise ValueError("paged KV needs kv_pages_per_slot >= 1, got %d"
+                         % (self.kv_pages_per_slot,))
+      if self.kv_cache_dtype == "int8":
+        raise ValueError("paged KV does not compose with the int8 cache "
+                         "yet — use kv_cache_dtype='model'")
 
   @property
   def head_dim(self) -> int:
@@ -396,6 +424,8 @@ class Attention(nn.Module):
     that are at different positions in their sequences.
     """
     cfg = self.cfg
+    if cfg.kv_page_size > 0:
+      return self._decode_attend_paged(q, k, v)
     b, seg, h, d = q.shape
     hk = cfg.kv_heads
     quant = cfg.kv_cache_dtype == "int8"
@@ -426,13 +456,27 @@ class Attention(nn.Module):
     def _cache_write(buf, val, trail):
       """Write ``val`` at the cursor: one dynamic_update_slice for the
       shared scalar cursor, a vmapped per-row update (one scatter) for
-      per-slot cursors. ``trail``: trailing dims after the seq axis."""
+      per-slot cursors. ``trail``: trailing dims after the seq axis.
+
+      Multi-token per-row writes go through an explicit OOB-dropping
+      scatter instead: a speculative verify window may transiently
+      overshoot ``max_seq_len`` on a lane whose remaining budget is
+      smaller than the draft depth, and dynamic_update_slice would
+      CLAMP the start — silently overwriting live attended KV below the
+      cursor (breaking bit-parity) instead of dropping the overflow
+      (which is never attended: accepted tokens stay within budget)."""
       if not vec:
         return jax.lax.dynamic_update_slice(
             buf, val, (0, idx) + (0,) * trail)
-      return jax.vmap(
-          lambda row, v, i: jax.lax.dynamic_update_slice(
-              row, v, (i,) + (0,) * trail))(buf, val, idx)
+      if seg == 1:
+        # single-token decode can never overshoot (cursor < max_seq_len
+        # by the submit-time budget check): keep the cheap update-slice
+        return jax.vmap(
+            lambda row, v, i: jax.lax.dynamic_update_slice(
+                row, v, (i,) + (0,) * trail))(buf, val, idx)
+      rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, seg)).reshape(-1)
+      pos = positions.reshape(-1)          # OOB entries drop, not clamp
+      return buf.at[rows, pos].set(val.reshape((b * seg,) + val.shape[2:]))
     # tensor-parallel serving: keep the cache sharded on its (grouped)
     # heads dim so each chip holds 1/t of the KV bytes and attends its own
     # head slice — without the constraint GSPMD may gather the cache.
@@ -563,6 +607,93 @@ class Attention(nn.Module):
     else:
       out = _dense_attend(None)
     return self._out_proj(out)
+
+  def _decode_attend_paged(self, q, k, v):
+    """Incremental attention against a PAGED KV cache (serving slabs).
+
+    Per layer the cache is a page POOL — ``pages_k``/``pages_v``
+    ``[kv_num_pages, kv_page_size, kv_heads, head_dim]`` — addressed
+    through a per-slot ``page_table [batch, kv_pages_per_slot] int32``
+    and the VECTOR cursor ``index [batch]``: slot ``b``'s token at
+    position ``p`` lives in page ``page_table[b, p // page_size]`` at
+    offset ``p % page_size``. Writes are one scatter over the flattened
+    (page, offset) indices; reads gather each slot's page list back into
+    a ``[batch, pages_per_slot·page_size, ...]`` view and run the same
+    masked dense attention as the vector-cursor contiguous branch.
+
+    Page 0 is the TRASH page: unused table entries point at it, so a
+    frozen lane (cursor 0, table all-zero) scatters its garbage there
+    and positions past ``pages_per_slot`` pages clip onto it — nothing
+    a live slot attends is ever touched, because the mask admits only
+    ``k_pos <= q_pos`` and every position a live slot can reach lies in
+    its own (or its shared read-only prefix) pages.
+    """
+    cfg = self.cfg
+    b, seg, h, d = q.shape
+    hk = cfg.kv_heads
+    ps, pp = cfg.kv_page_size, cfg.kv_pages_per_slot
+    span = pp * ps                       # a slot's maximum visible tokens
+    pages_k = self.variable(
+        "cache", "pages_k", jnp.zeros, (cfg.kv_num_pages, ps, hk, d),
+        cfg.dtype)
+    pages_v = self.variable(
+        "cache", "pages_v", jnp.zeros, (cfg.kv_num_pages, ps, hk, d),
+        cfg.dtype)
+    table = self.variable("cache", "page_table", jnp.zeros, (b, pp),
+                          jnp.int32)
+    # paged decode is slot-shaped by construction: the cursor is born a
+    # vector (the contiguous branch's scalar/vector duality doesn't apply)
+    cursor = self.variable("cache", "index", jnp.zeros, (b,), jnp.int32)
+    idx = cursor.value
+
+    positions = idx[:, None] + jnp.arange(seg)[None, :]        # [b, seg]
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+
+    # write: token position -> (page, offset) through the table. A
+    # position inside the span but past the slot's allocation resolves
+    # through an unused table entry to the trash page; a position PAST
+    # the span (a speculative verify window overshooting a full slot)
+    # is forced to trash explicitly — the clip would otherwise alias it
+    # into the slot's LAST page over live attended tokens
+    page_slot = jnp.clip(positions // ps, 0, pp - 1)           # [b, seg]
+    page_ids = jnp.take_along_axis(table.value, page_slot, axis=1)
+    page_ids = jnp.where(positions < pp * ps, page_ids, 0)
+    offs = positions % ps
+    flat_pages = page_ids.reshape(-1)
+    flat_offs = offs.reshape(-1)
+    # tensor-parallel serving: keep the page pools sharded on the
+    # (grouped) heads dim — the same constraint (and rationale) as the
+    # contiguous branch: without it GSPMD may gather the pool, the
+    # largest HBM object in serving, every step
+    pool_spec = (None, None, _heads_logical(hk, self.mesh), "kv")
+    pages_k.value = _constrain(
+        pages_k.value.at[flat_pages, flat_offs].set(
+            k.astype(cfg.dtype).reshape(b * seg, hk, d)),
+        pool_spec, self.mesh)
+    pages_v.value = _constrain(
+        pages_v.value.at[flat_pages, flat_offs].set(
+            v.astype(cfg.dtype).reshape(b * seg, hk, d)),
+        pool_spec, self.mesh)
+    cursor.value = idx + seg
+
+    # read: gather each slot's pages into its contiguous token view
+    kf = pages_k.value[table.value].reshape(b, span, hk, d) \
+        .astype(jnp.float32)
+    vf = pages_v.value[table.value].reshape(b, span, hk, d) \
+        .astype(jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+    qg = q.reshape(b, seg, hk, h // hk, d).astype(jnp.float32)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf) * scale
+    q_pos = idx[:, None, None] + jnp.arange(seg)[None, :, None]
+    k_pos = jnp.arange(span)[None, None, :]
+    keep = k_pos <= q_pos                                  # [b, seg, span]
+    if cfg.attention_window:
+      keep = jnp.logical_and(keep, k_pos > q_pos - cfg.attention_window)
+    scores = jnp.where(keep[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return self._out_proj(o.reshape(b, seg, h, d).astype(q.dtype))
 
 
 class _UpKernel(nn.Module):
@@ -829,8 +960,19 @@ class Transformer(nn.Module):
 
   @nn.compact
   def __call__(self, tokens, decode: bool = False,
-               return_hidden: bool = False):
+               return_hidden: bool = False,
+               exit_layer: Optional[int] = None):
+    """``exit_layer`` (static) runs only the first N blocks before the
+    final norm + tied projection — the SHALLOW-EXIT draft of
+    self-speculative decoding (serving/slots.py): the draft is a prefix
+    of the target's own layers, so it shares params and KV slabs and
+    needs no second model. Untouched layers' cache entries pass through
+    an ``apply`` unchanged (flax keeps unvisited collection entries), so
+    a shallow decode step advances only the visited layers' cursors."""
     cfg = self.cfg
+    if exit_layer is not None and not 1 <= exit_layer <= cfg.num_layers:
+      raise ValueError("exit_layer must be in [1, num_layers=%d], got %r"
+                       % (cfg.num_layers, exit_layer))
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
     emb = TiedEmbed(cfg, self.mesh, name="embed")
     x = emb(tokens)
@@ -840,7 +982,7 @@ class Transformer(nn.Module):
     block = Block
     if cfg.remat and not decode:
       block = _remat_block(cfg)
-    for i in range(cfg.num_layers):
+    for i in range(cfg.num_layers if exit_layer is None else exit_layer):
       use_moe = (cfg.moe_experts > 0
                  and i % cfg.moe_every == cfg.moe_every - 1)
       layer = block(cfg, self.mesh, use_moe, name="layer_%d" % i)
@@ -915,7 +1057,11 @@ def _select_token(logits, rng, temperature: float, top_k: int):
   return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
 
-@functools.lru_cache(maxsize=8)
+# 32 entries, not 8: serving traffic (and the parity suites) legitimately
+# touch dozens of (batch, prompt_len, num_steps) shapes — an 8-entry
+# cache thrashes and recompiles shapes it just evicted. Entries hold
+# compiled executables (code, not params), so the residency cost is MBs
+@functools.lru_cache(maxsize=32)
 def _kv_generate_fn(cfg: TransformerConfig, batch: int, plen: int,
                     num_steps: int, temperature: float, top_k: int,
                     mesh=None, eos_id=None, pad_id: int = 0):

@@ -28,6 +28,12 @@ catalogue every pass:
                     serving engine is crash-replaying repeatedly — a poison
                     request slipped past detection, or the device/runtime
                     is genuinely failing (docs/ROBUSTNESS.md)
+``kv_pages_exhausted`` ``serve.kv_pages_free`` pinned at 0 across the whole
+                    window while the request queue is non-empty: the paged
+                    KV pool is the admission bottleneck — raise
+                    ``TOS_SERVE_NUM_PAGES``, shrink
+                    ``TOS_SERVE_PREFIX_PAGES``, or shed load
+                    (docs/PERFORMANCE.md §paged KV)
 ``mem_slope``       ``device.bytes_in_use`` grew monotonically by more than
                     ``TOS_OBS_MEM_SLOPE_PCT`` percent across the window (a
                     leak-shaped creep toward OOM)
@@ -112,6 +118,7 @@ _SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
             "feed.decode_s", "feed.assemble_s", "xla.compiles",
             "serve.queue_depth", "serve.occupancy",
             "serve.engine_restarts", "serve.replays",
+            "serve.kv_pages_free", "serve.kv_pages_in_use",
             "device.bytes_in_use")
 
 
@@ -263,6 +270,7 @@ class AnomalyDetector(object):
         new.extend(self._check_recompiles(eid, dq, span, now))
         new.extend(self._check_serving(eid, dq, span, now))
         new.extend(self._check_serve_crash_loop(eid, dq, span, now))
+        new.extend(self._check_kv_pages(eid, dq, span, now))
         new.extend(self._check_mem_slope(eid, dq, span, now))
     except Exception:  # noqa: BLE001 - the detector must outlive any
       # single evaluation bug; failures are counted and visible
@@ -373,6 +381,31 @@ class AnomalyDetector(object):
         "%.0fs (%d request replays) — crash-looping: a poison request "
         "slipped past detection, or the device/runtime is failing"
         % (eid, int(d), span, int(replays)))
+
+  def _check_kv_pages(self, eid, dq, span, now) -> List[dict]:
+    """Paged-KV pool exhaustion: free pages PINNED at zero for the whole
+    window (a transient dip to 0 between completions is normal — any
+    sample above 0 clears the verdict) while requests are queued waiting
+    for pages. The fix is capacity-shaped, not load-shaped, so this is
+    its own kind rather than a ``serving_saturated`` variant."""
+    frees = [v["serve.kv_pages_free"] for _, v in dq
+             if "serve.kv_pages_free" in v]
+    if len(frees) < 2:
+      return []   # paging off, or not enough window to call it pinned
+    if max(frees) > 0:
+      return []
+    depth = dq[-1][1].get("serve.queue_depth")
+    if depth is None or depth <= 0:
+      return []   # nothing waiting: a full pool at zero queue is just full
+    in_use = dq[-1][1].get("serve.kv_pages_in_use", 0.0)
+    return self._fire(
+        "kv_pages_exhausted", eid, span, now,
+        {"queue_depth": depth, "pages_in_use": in_use,
+         "samples_at_zero": len(frees)},
+        "executor %d KV page pool pinned at 0 free pages for %.0fs with "
+        "%d queued request(s) — paging is the admission bottleneck: "
+        "raise TOS_SERVE_NUM_PAGES, shrink TOS_SERVE_PREFIX_PAGES, or "
+        "shed load" % (eid, span, int(depth)))
 
   def _check_mem_slope(self, eid, dq, span, now) -> List[dict]:
     series = [(t, v["device.bytes_in_use"]) for t, v in dq

@@ -15,6 +15,16 @@ Public surface:
 * :class:`~tensorflowonspark_tpu.serving.scheduler.Request` /
   :class:`~tensorflowonspark_tpu.serving.scheduler.RequestQueue` — the
   host-side bookkeeping (bounded, closable admission queue).
+* :class:`~tensorflowonspark_tpu.serving.scheduler.PagePool` /
+  :class:`~tensorflowonspark_tpu.serving.scheduler.PrefixCache` — the
+  paged-KV host state: the ref-counted page allocator and the
+  shared-prefix radix trie (page-granular, LRU-evicted).
+
+Decode-speed stack (docs/PERFORMANCE.md §"Paged KV, prefix cache &
+speculative decode"): ``TOS_SERVE_PAGE_SIZE`` pages the KV slab,
+``TOS_SERVE_PREFIX_PAGES`` turns on prefix sharing over it, and
+``TOS_SERVE_SPEC_DEPTH`` enables self-speculative decoding — each stage
+independently gated on ``serve_bench`` bit-parity.
 
 See docs/PERFORMANCE.md §Serving for the static-vs-continuous batching
 story, docs/ROBUSTNESS.md for the failure model and chaos knobs, and
@@ -22,10 +32,13 @@ story, docs/ROBUSTNESS.md for the failure model and chaos knobs, and
 """
 
 from tensorflowonspark_tpu.serving.engine import (            # noqa: F401
-    ENV_SERVE_MAX_QUEUE, ENV_SERVE_MAX_QUEUED_TOKENS, ENV_SERVE_POLL,
-    ENV_SERVE_SLOTS, ENV_SERVE_TTL, ServingEngine)
+    ENV_SERVE_MAX_QUEUE, ENV_SERVE_MAX_QUEUED_TOKENS, ENV_SERVE_NUM_PAGES,
+    ENV_SERVE_PAGE_SIZE, ENV_SERVE_POLL, ENV_SERVE_PREFIX_PAGES,
+    ENV_SERVE_SLOTS, ENV_SERVE_SPEC_DEPTH, ENV_SERVE_SPEC_LAYERS,
+    ENV_SERVE_TTL, ServingEngine)
 from tensorflowonspark_tpu.serving.scheduler import (         # noqa: F401
-    ENV_SERVE_BUCKETS, DeadlineExceeded, PoisonedRequest, Request,
-    RequestCancelled, RequestQueue, ServingOverloaded)
+    ENV_SERVE_BUCKETS, DeadlineExceeded, PagePool, PoisonedRequest,
+    PrefixCache, Request, RequestCancelled, RequestQueue,
+    ServingOverloaded)
 from tensorflowonspark_tpu.serving.slots import (             # noqa: F401
     DEFAULT_BUCKETS, SlotDecoder, chunk_plan)

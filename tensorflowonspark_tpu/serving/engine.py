@@ -47,13 +47,38 @@ Usage::
     # or: for tok in eng.stream(rid): ...
     eng.drain(timeout=30)                       # or eng.stop()
 
+The engine is FAST (this PR's decode-speed stack, each stage gated on
+``serve_bench`` parity and composable with the self-healing surface):
+
+* paged KV slab — ``page_size > 0`` swaps the per-slot ``max_seq_len``
+  HBM reservation for a page pool + per-slot page tables
+  (``serving.slots``): a request holds only the pages its
+  prompt+budget token mass needs, so ``num_slots`` can exceed what
+  contiguous reservation would fit; a request that cannot get pages
+  waits in the queue (completions free pages) instead of failing;
+* shared-prefix cache — ``prefix_pages > 0`` (requires paging) keeps a
+  driver-side radix trie over prompt prefixes at page granularity
+  (``serving.scheduler.PrefixCache``): requests sharing a prefix
+  prefill it ONCE and fork read-only page references (the divergence
+  page stays private — copy-on-write at page granularity), turning the
+  system-prompt-heavy workload's O(requests × prefix) prefill into
+  O(1) per distinct prefix; eviction is ref-counted LRU;
+* self-speculative decode — ``spec_depth > 0`` drafts with a
+  ``spec_layers``-deep shallow-exit prefix of the SAME model and
+  verifies with one full-model step per round (``SlotDecoder
+  .step_spec``): greedy verification keeps exactly the tokens
+  ``greedy_generate_kv`` would emit, so bit-parity (and crash replay,
+  which leans on it) survives the speedup.
+
 All waits are timeout-bounded (TOS001) and the loop thread is a daemon
 (TOS007). Config knobs ride registered ``TOS_*`` env vars (TOS008):
 ``TOS_SERVE_SLOTS``, ``TOS_SERVE_BUCKETS``, ``TOS_SERVE_POLL``,
 ``TOS_SERVE_HORIZON``, ``TOS_SERVE_MAX_QUEUE``,
 ``TOS_SERVE_MAX_QUEUED_TOKENS``, ``TOS_SERVE_TTL``,
 ``TOS_SERVE_MAX_RESTARTS``, ``TOS_SERVE_RESTART_BACKOFF``,
-``TOS_SERVE_POISON_CRASHES``.
+``TOS_SERVE_POISON_CRASHES``, ``TOS_SERVE_PAGE_SIZE``,
+``TOS_SERVE_NUM_PAGES``, ``TOS_SERVE_PREFIX_PAGES``,
+``TOS_SERVE_SPEC_DEPTH``, ``TOS_SERVE_SPEC_LAYERS``.
 """
 
 import contextlib
@@ -101,6 +126,21 @@ ENV_SERVE_RESTART_BACKOFF = "TOS_SERVE_RESTART_BACKOFF"
 #: a request blamed for this many consecutive crashes is failed
 #: (PoisonedRequest), not replayed — the crash-loop breaker
 ENV_SERVE_POISON_CRASHES = "TOS_SERVE_POISON_CRASHES"
+#: paged KV slab: tokens per page (0 = contiguous per-slot reservation)
+ENV_SERVE_PAGE_SIZE = "TOS_SERVE_PAGE_SIZE"
+#: paged KV slab: pool size in pages, incl. the reserved trash page 0
+#: (0 = auto: num_slots × ceil(max_seq_len/page_size) + 1, the
+#: contiguous worst case — set lower to spend less HBM than
+#: num_slots × max_seq_len)
+ENV_SERVE_NUM_PAGES = "TOS_SERVE_NUM_PAGES"
+#: shared-prefix cache budget in pages (0 = off; requires paging) —
+#: ref-counted LRU eviction keeps the cache at/under this
+ENV_SERVE_PREFIX_PAGES = "TOS_SERVE_PREFIX_PAGES"
+#: self-speculative decode: draft-window depth per round (0 = off)
+ENV_SERVE_SPEC_DEPTH = "TOS_SERVE_SPEC_DEPTH"
+#: self-speculative decode: shallow-exit draft depth in layers
+#: (0 = auto: num_layers // 2)
+ENV_SERVE_SPEC_LAYERS = "TOS_SERVE_SPEC_LAYERS"
 
 _DEFAULT_SLOTS = 4
 _DEFAULT_POLL = 0.05
@@ -137,7 +177,12 @@ class ServingEngine(object):
                default_ttl: Optional[float] = None,
                max_restarts: Optional[int] = None,
                restart_backoff: Optional[float] = None,
-               poison_crashes: Optional[int] = None):
+               poison_crashes: Optional[int] = None,
+               page_size: Optional[int] = None,
+               num_pages: Optional[int] = None,
+               prefix_pages: Optional[int] = None,
+               spec_depth: Optional[int] = None,
+               spec_layers: Optional[int] = None):
     if eos_id is not None and int(eos_id) == int(pad_id):
       raise ValueError("eos_id and pad_id must differ (both %d)"
                        % int(pad_id))
@@ -176,8 +221,28 @@ class ServingEngine(object):
     self.poison_crashes = max(1, int(
         poison_crashes if poison_crashes is not None
         else _env_int(ENV_SERVE_POISON_CRASHES, _DEFAULT_POISON_CRASHES)))
-    self.decoder = slots_lib.SlotDecoder(cfg, num_slots, pad_id=pad_id,
-                                         eos_id=self.eos_id, mesh=mesh)
+    # explicit arguments beat the env knobs (the num_slots rule)
+    self.page_size = int(page_size if page_size is not None
+                         else _env_int(ENV_SERVE_PAGE_SIZE, 0))
+    self.num_pages = int(num_pages if num_pages is not None
+                         else _env_int(ENV_SERVE_NUM_PAGES, 0))
+    self.prefix_pages = int(prefix_pages if prefix_pages is not None
+                            else _env_int(ENV_SERVE_PREFIX_PAGES, 0))
+    self.spec_depth = int(spec_depth if spec_depth is not None
+                          else _env_int(ENV_SERVE_SPEC_DEPTH, 0))
+    spec_layers = int(spec_layers if spec_layers is not None
+                      else _env_int(ENV_SERVE_SPEC_LAYERS, 0))
+    if self.prefix_pages > 0 and self.page_size <= 0:
+      raise ValueError(
+          "the shared-prefix cache shares POOL PAGES — "
+          "TOS_SERVE_PREFIX_PAGES > 0 requires TOS_SERVE_PAGE_SIZE > 0")
+    self.decoder = slots_lib.SlotDecoder(
+        cfg, num_slots, pad_id=pad_id, eos_id=self.eos_id, mesh=mesh,
+        page_size=self.page_size, num_pages=self.num_pages,
+        spec_depth=self.spec_depth, spec_layers=spec_layers)
+    # spec rounds per dispatch: each round emits 1..spec_depth tokens,
+    # so this keeps the best-case tokens-per-dispatch near the horizon
+    self._spec_rounds = max(1, -(-horizon // max(1, self.spec_depth)))
     self._poll = float(poll_interval if poll_interval is not None
                        else os.environ.get(ENV_SERVE_POLL, _DEFAULT_POLL))
     self._queue = sched.RequestQueue()
@@ -186,6 +251,11 @@ class ServingEngine(object):
     self._requests = {}                    # rid -> Request (in flight or done)
     self._slots: List[Optional[sched.Request]] = [None] * num_slots
     self._slabs = None                     # built lazily on start()
+    # paged-KV host state — (re)built with the slab (_ensure_slabs): the
+    # allocator/trie describe DEVICE pages, so a rebuilt slab resets them
+    self._pool: Optional[sched.PagePool] = None
+    self._prefix: Optional[sched.PrefixCache] = None
+    self._req_pages = {}                   # rid -> [page ids] (one ref each)
     self._last = np.full((num_slots,), self.pad_id, np.int32)
     self._stop_evt = threading.Event()
     self._thread: Optional[threading.Thread] = None
@@ -198,11 +268,17 @@ class ServingEngine(object):
     #: poisoned, streak, error} — serve_bench --chaos reads recovery
     #: latency off this
     self.restart_log: List[dict] = []
+    # counters ONLY (monotonic): StatsSnapshot.delta subtracts these, so
+    # a last-write gauge here would read as a bogus per-pass delta —
+    # gauges (kv_pages_in_use/free) live on the obs registry and the
+    # kv_pages_in_use/kv_pages_free properties instead
     self.stats = {"steps": 0, "live_slot_steps": 0, "emitted_tokens": 0,
                   "prefills": 0, "completed": 0, "rejected": 0,
                   "expired": 0, "cancelled": 0, "replays": 0,
                   "engine_restarts": 0, "poisoned": 0,
-                  "replay_mismatches": 0}
+                  "replay_mismatches": 0, "prefix_hits": 0,
+                  "prefix_evictions": 0, "spec_accepted": 0,
+                  "spec_rejected": 0}
     # obs seam (docs/OBSERVABILITY.md): cached handles; disabled = one
     # None check per decode dispatch
     self._rec = obs_spans.active()
@@ -218,9 +294,15 @@ class ServingEngine(object):
         "replays": reg.counter("serve.replays"),
         "engine_restarts": reg.counter("serve.engine_restarts"),
         "poisoned": reg.counter("serve.poisoned"),
+        "prefix_hits": reg.counter("serve.prefix_hits"),
+        "prefix_evictions": reg.counter("serve.prefix_evictions"),
+        "spec_accepted": reg.counter("serve.spec_accepted"),
+        "spec_rejected": reg.counter("serve.spec_rejected"),
         "occupancy": reg.gauge("serve.occupancy"),
         "queue_depth": reg.gauge("serve.queue_depth"),
         "slots_active": reg.gauge("serve.slots_active"),
+        "kv_pages_in_use": reg.gauge("serve.kv_pages_in_use"),
+        "kv_pages_free": reg.gauge("serve.kv_pages_free"),
         "decode_ms": reg.histogram("serve.decode_ms"),
     }
 
@@ -247,6 +329,32 @@ class ServingEngine(object):
   def num_slots(self) -> int:
     return self.decoder.num_slots
 
+  @property
+  def kv_pages_in_use(self) -> int:
+    """Allocated pool pages (0 when paging is off / engine not started)."""
+    pool = self._pool
+    return 0 if pool is None else pool.in_use
+
+  @property
+  def kv_pages_free(self) -> int:
+    pool = self._pool
+    return 0 if pool is None else pool.free_pages
+
+  def _ensure_slabs(self) -> None:
+    """(Re)build the device slab AND the host page state describing it —
+    a fresh slab means every old page id is meaningless, so the
+    allocator, prefix trie and per-request page lists reset with it
+    (crash recovery rebuilds everything; replayed requests re-allocate
+    at re-admission)."""
+    if self._slabs is not None:
+      return
+    self._slabs = self.decoder.init_slabs()
+    if self.decoder.paged:
+      self._pool = sched.PagePool(self.decoder.num_pages)
+      self._prefix = sched.PrefixCache(self.page_size, self.prefix_pages) \
+          if self.prefix_pages > 0 else None
+      self._req_pages = {}
+
   def start(self) -> "ServingEngine":
     if self._thread is not None and self._thread.is_alive():
       return self
@@ -255,8 +363,7 @@ class ServingEngine(object):
     self._draining = False
     self._crash_streak = 0
     self._queue.reopen()
-    if self._slabs is None:
-      self._slabs = self.decoder.init_slabs()
+    self._ensure_slabs()
     self._thread = threading.Thread(target=self._loop, daemon=True,
                                     name="tos-serving-engine")
     self._thread.start()
@@ -287,6 +394,10 @@ class ServingEngine(object):
     for req in live:
       req.finish(err)                      # finish() is idempotent
     self._slabs = None                     # next start() gets a fresh slab
+    # page ids described the dropped slab: the allocator/trie die with it
+    self._pool = None
+    self._prefix = None
+    self._req_pages = {}
 
   def drain(self, timeout: float) -> bool:
     """Graceful shutdown: stop admission, finish every accepted request
@@ -365,6 +476,17 @@ class ServingEngine(object):
       raise ValueError(
           "prompt of %d tokens + budget %d exceeds the max_seq_len=%d "
           "slot cache" % (len(req.prompt), budget, self.cfg.max_seq_len))
+    if self.decoder.paged:
+      needed = -(-(len(req.prompt) + budget) // self.page_size)
+      if needed > self.decoder.num_pages - 1:
+        # reject here, not in the loop: a request no amount of
+        # completions can ever page in would pin admission forever
+        raise ValueError(
+            "prompt of %d tokens + budget %d needs %d KV pages but the "
+            "pool holds %d allocatable (TOS_SERVE_NUM_PAGES=%d minus "
+            "the trash page)" % (len(req.prompt), budget, needed,
+                                 self.decoder.num_pages - 1,
+                                 self.decoder.num_pages))
     if req.expired(now):
       self._count("expired")
       raise sched.DeadlineExceeded(
@@ -581,8 +703,7 @@ class ServingEngine(object):
   def _loop(self) -> None:
     while not self._stop_evt.is_set():
       try:
-        if self._slabs is None:            # rebuilt after a crash
-          self._slabs = self.decoder.init_slabs()
+        self._ensure_slabs()               # rebuilt after a crash
         self._reap()
         self._admit()
         if not any(r is not None for r in self._slots):
@@ -629,6 +750,12 @@ class ServingEngine(object):
       victims.append(adm)
     self._last[:] = self.pad_id
     self._slabs = None                     # fresh slab next iteration
+    # the crash took the slab's pages with it: allocator, prefix trie
+    # and per-request page lists rebuild with the slab (_ensure_slabs);
+    # replayed requests re-allocate at re-admission
+    self._pool = None
+    self._prefix = None
+    self._req_pages = {}
     # blame: a crash during admission implicates exactly the request
     # being prefilled; a crash mid-decode cannot be attributed and
     # implicates every in-flight lane
@@ -717,6 +844,7 @@ class ServingEngine(object):
     boundary — exactly the bookkeeping an EOS exit does."""
     now = time.monotonic()
     self._reap_queue(now)
+    freed = []
     for slot in range(self.num_slots):
       req = self._slots[slot]
       if req is None:
@@ -727,6 +855,21 @@ class ServingEngine(object):
       with self._lock:
         self._slots[slot] = None
       self._last[slot] = self.pad_id
+      if self.decoder.paged:
+        self._release_pages(req.rid)
+        freed.append(slot)
+    self._reset_freed(freed)
+
+  def _reset_freed(self, freed: List[int]) -> None:
+    """Point freed slots' page tables at the trash page BEFORE the next
+    decode dispatch: a freed lane keeps computing (frozen), and its
+    stale table would otherwise scribble into pages the allocator may
+    already have handed to a new request."""
+    if not freed:
+      return
+    mask = np.zeros((self.num_slots,), bool)
+    mask[freed] = True
+    self._slabs = self.decoder.reset_slots(self._slabs, mask)
 
   def _reap_queue(self, now: float) -> None:
     for req in self._queue.reap(
@@ -745,6 +888,66 @@ class ServingEngine(object):
           % (req.rid, now - (req.deadline or now))))
 
   # -- admission -------------------------------------------------------------
+
+  def _alloc_pages(self, req: sched.Request):
+    """Page in one request: ``(all pages in token order, shared prefix
+    pages, shared token count)``, or None when the pool cannot host it
+    right now (the caller requeues; completions free pages).
+
+    Prefix-cache hits fork read-only references to the prefix's FULL
+    pages (pinned before any eviction can free them); the divergence
+    page and the tail/budget pages are fresh private allocations. A full
+    pool shrinks the prefix cache LRU-first before giving up.
+    """
+    plen = len(req.prompt)
+    shared_pages, shared_tokens = [], 0
+    if self._prefix is not None:
+      hit = self._prefix.lookup(req.prompt)
+      # always leave >= 1 tail token: the last prompt token must run
+      # through the model to yield g1, and the divergence page is never
+      # shared (the copy-on-write boundary)
+      usable = min(len(hit), (plen - 1) // self.page_size)
+      shared_pages = hit[:usable]
+      shared_tokens = usable * self.page_size
+      for p in shared_pages:         # pin BEFORE eviction can free them
+        self._pool.ref(p)
+    need = -(-(plen + req.max_new_tokens) // self.page_size) \
+        - len(shared_pages)
+    fresh = self._pool.alloc(need)
+    while fresh is None and self._prefix is not None \
+        and self._prefix.pages_held > 0:
+      # evict the whole deficit in one batched trie walk; STOP once a
+      # round frees nothing (every evicted page still ref'd by live
+      # readers) — grinding the trie to empty would destroy all prefix
+      # sharing without ever satisfying this allocation
+      if self._evict_prefix(need - self._pool.free_pages) == 0:
+        break
+      fresh = self._pool.alloc(need)
+    if fresh is None:
+      for p in shared_pages:
+        self._pool.unref(p)
+      return None
+    return shared_pages + fresh, shared_pages, shared_tokens
+
+  def _evict_prefix(self, n: int) -> int:
+    """Evict up to ``n`` LRU prefix pages; returns how many actually
+    came FREE (a page still ref'd by live readers leaves the cache but
+    stays allocated until its last ref drops)."""
+    freed = 0
+    for p in self._prefix.evict(max(1, n)):
+      self._count("prefix_evictions")
+      freed += bool(self._pool.unref(p))
+    return freed
+
+  def _release_pages(self, rid: int) -> None:
+    """Drop the request's page refs EXACTLY once (pop-then-unref: a
+    second call for the same rid is a no-op, so reap/complete/drain
+    paths cannot double-free; pages shared with the prefix cache or
+    other readers stay allocated until their last ref drops)."""
+    pages = self._req_pages.pop(rid, None)
+    if pages:
+      for p in pages:
+        self._pool.unref(p)
 
   def _admit(self) -> None:
     """Prefill queued requests into free slots (EOS-freed or virgin)."""
@@ -765,14 +968,36 @@ class ServingEngine(object):
           self._fail_reaped(req, now)
           self._admitting = None
           req = None
+      pages, shared_tokens, table = None, 0, None
+      if self.decoder.paged:
+        alloc = self._alloc_pages(req)
+        if alloc is None:
+          # pool exhausted: requeue AHEAD of the backlog (it was already
+          # admitted; bounds don't re-apply) and stop admitting — the
+          # next completion frees pages and admission resumes
+          self._queue.push_front(req)
+          self._admitting = None
+          return
+        pages, _, shared_tokens = alloc
+        table = pages + [0] * (self.decoder.pages_per_slot - len(pages))
       if req.started_at is None:
         req.started_at = time.monotonic()
       cm = self._rec.span("serve.prefill", rid=req.rid,
-                          prompt_len=len(req.prompt), slot=slot) \
+                          prompt_len=len(req.prompt), slot=slot,
+                          shared_tokens=shared_tokens) \
           if self._rec is not None else contextlib.nullcontext()
       with cm:
+        resume = None
+        if shared_tokens:
+          # prefix hit: rebuild the warm row cache from the shared pages
+          # and prefill only the tail — the O(prefix) work is skipped
+          self._count("prefix_hits")
+          row = self.decoder.gather_pages(self._slabs, table,
+                                          shared_tokens)
+          resume = (row, shared_tokens)
         row_cache, first = self.decoder.prefill(self.params, req.prompt,
-                                                self.buckets)
+                                                self.buckets,
+                                                resume=resume)
       self.stats["prefills"] += 1
       if self._obs_m is not None:
         self._obs_m["prefills"].inc()
@@ -781,9 +1006,27 @@ class ServingEngine(object):
       self.stats["emitted_tokens"] += 1
       if self._finished(req, first):
         self._complete(req)
+        if pages is not None:    # never inserted: nothing else holds them
+          for p in pages:
+            self._pool.unref(p)
         self._admitting = None
         continue                 # slot stays free for the next request
-      self._slabs = self.decoder.insert(self._slabs, row_cache, slot)
+      if self.decoder.paged:
+        self._slabs = self.decoder.insert_pages(self._slabs, row_cache,
+                                                slot, table,
+                                                start=shared_tokens)
+        if self._prefix is not None:
+          # the prompt's full pages become shareable: the cache takes
+          # its own ref on each newly cached page, outliving this
+          # request; then the LRU budget is enforced
+          for p in self._prefix.register(req.prompt, pages):
+            self._pool.ref(p)
+          over = self._prefix.over_budget
+          if over:
+            self._evict_prefix(over)
+        self._req_pages[req.rid] = pages
+      else:
+        self._slabs = self.decoder.insert(self._slabs, row_cache, slot)
       with self._lock:
         self._slots[slot] = req
       self._admitting = None
@@ -817,29 +1060,10 @@ class ServingEngine(object):
     remaining = np.asarray(
         [0 if r is None else r.max_new_tokens - r.generated
          for r in self._slots], np.int32)
-    self._slabs, toks, _, _ = self.decoder.step_many(
-        self.params, self._slabs, self._last, active, remaining,
-        self.horizon)
-    toks = np.asarray(toks)                       # [horizon, num_slots]
-    self.stats["steps"] += self.horizon
-    for slot in range(self.num_slots):
-      req = self._slots[slot]
-      if req is None:
-        continue
-      for j in range(self.horizon):
-        tok = int(toks[j, slot])
-        if not req.emit(tok):
-          self.stats["replay_mismatches"] += 1
-        self.stats["emitted_tokens"] += 1
-        self.stats["live_slot_steps"] += 1
-        if self._finished(req, tok):
-          self._complete(req)
-          with self._lock:
-            self._slots[slot] = None
-          self._last[slot] = self.pad_id
-          break
-      else:
-        self._last[slot] = int(toks[self.horizon - 1, slot])
+    if self.spec_depth > 0:
+      steps = self._decode_spec(active, remaining)
+    else:
+      steps = self._decode_plain(active, remaining)
     dt = time.monotonic() - t0
     emitted = self.stats["emitted_tokens"] - tokens_before
     if dt > 0 and emitted:
@@ -855,9 +1079,89 @@ class ServingEngine(object):
                               active=int(active.sum()))
       m = self._obs_m
       if m is not None:
-        m["steps"].inc(self.horizon)
+        m["steps"].inc(steps)
         m["tokens"].inc(emitted)
         m["decode_ms"].observe(dt * 1e3)
         m["occupancy"].set(self.occupancy)
         m["queue_depth"].set(len(self._queue))
         m["slots_active"].set(live)
+        if self._pool is not None:
+          m["kv_pages_in_use"].set(self._pool.in_use)
+          m["kv_pages_free"].set(self._pool.free_pages)
+
+  def _harvest(self, req, tok: int, slot: int, freed: List[int]) -> bool:
+    """Record one emitted token; on the request's stop, free its slot
+    (and pages) exactly like EOS. Returns True when the slot freed."""
+    if not req.emit(tok):
+      self.stats["replay_mismatches"] += 1
+    self.stats["emitted_tokens"] += 1
+    self.stats["live_slot_steps"] += 1
+    if not self._finished(req, tok):
+      return False
+    self._complete(req)
+    with self._lock:
+      self._slots[slot] = None
+    self._last[slot] = self.pad_id
+    if self.decoder.paged:
+      self._release_pages(req.rid)
+      freed.append(slot)
+    return True
+
+  def _decode_plain(self, active, remaining) -> int:
+    """The non-speculative fused horizon (SlotDecoder.step_many)."""
+    self._slabs, toks, _, _ = self.decoder.step_many(
+        self.params, self._slabs, self._last, active, remaining,
+        self.horizon)
+    toks = np.asarray(toks)                       # [horizon, num_slots]
+    self.stats["steps"] += self.horizon
+    freed: List[int] = []
+    for slot in range(self.num_slots):
+      req = self._slots[slot]
+      if req is None:
+        continue
+      for j in range(self.horizon):
+        if self._harvest(req, int(toks[j, slot]), slot, freed):
+          break
+      else:
+        self._last[slot] = int(toks[self.horizon - 1, slot])
+    self._reset_freed(freed)
+    return self.horizon
+
+  def _decode_spec(self, active, remaining) -> int:
+    """The self-speculative fused dispatch (SlotDecoder.step_spec).
+
+    ``counts[r, lane]`` bounds each lane's valid tokens per round (the
+    device's accept/EOS/budget verdict); the host still replays the
+    stop rule per token (the step_many contract), so the two views
+    cannot diverge. Accepted/rejected draft verdicts feed the
+    ``spec_accepted``/``spec_rejected`` counters.
+    """
+    k, rounds = self.spec_depth, self._spec_rounds
+    self._slabs, toks, counts, acc, rej, _, _ = self.decoder.step_spec(
+        self.params, self._slabs, self._last, active, remaining, rounds)
+    toks = np.asarray(toks)            # [rounds, spec_depth, num_slots]
+    counts = np.asarray(counts)        # [rounds, num_slots]
+    # a round's slot-step opportunity is its verify window (k wide) —
+    # occupancy then reads as useful-token fraction incl. rejections
+    self.stats["steps"] += rounds * k
+    self._count("spec_accepted", int(np.asarray(acc).sum()))
+    self._count("spec_rejected", int(np.asarray(rej).sum()))
+    freed: List[int] = []
+    for slot in range(self.num_slots):
+      req = self._slots[slot]
+      if req is None:
+        continue
+      done = False
+      last_tok = None
+      for r in range(rounds):
+        for j in range(int(counts[r, slot])):
+          last_tok = int(toks[r, j, slot])
+          if self._harvest(req, last_tok, slot, freed):
+            done = True
+            break
+        if done:
+          break
+      if not done and last_tok is not None:
+        self._last[slot] = last_tok
+    self._reset_freed(freed)
+    return rounds * k

@@ -1,10 +1,25 @@
 """Device side of continuous batching: slot slabs and their jitted ops.
 
-The slab is ONE persistent KV-cache pytree with a fixed slot capacity —
-per layer ``[num_slots, max_seq_len, kv_heads, head_dim]`` key/value
-buffers plus a VECTOR cursor ``index: [num_slots]`` (the per-slot-cursor
-branch of ``models.transformer.Attention._decode_attend``). Three jitted
-functions own it:
+The slab is ONE persistent KV-cache pytree with a fixed slot capacity.
+Two layouts exist:
+
+* CONTIGUOUS (default): per layer ``[num_slots, max_seq_len, kv_heads,
+  head_dim]`` key/value buffers plus a VECTOR cursor ``index:
+  [num_slots]`` (the per-slot-cursor branch of
+  ``models.transformer.Attention._decode_attend``). Every slot reserves
+  ``max_seq_len`` of HBM whether it needs it or not.
+* PAGED (``page_size > 0``): per layer a page POOL ``[num_pages,
+  page_size, kv_heads, head_dim]`` plus a per-slot ``page_table
+  [num_slots, pages_per_slot] int32`` and the vector cursor — a slot
+  holds only the pages its token mass needs, so slot count scales with
+  actual tokens instead of ``num_slots × max_seq_len`` worst case
+  (``_decode_attend_paged``). Page 0 is the reserved TRASH page; the
+  host-side allocator is ``serving.scheduler.PagePool``. Paging also
+  unlocks the shared-prefix cache (``serving.scheduler.PrefixCache``):
+  requests sharing a prompt prefix fork read-only references to the
+  prefix's full pages and prefill only their tail.
+
+Jitted functions owning the slab:
 
 * :meth:`SlotDecoder.prefill` — run one request's prompt through the
   model on a fresh single-row cache, in bucket-sized chunks so the jit
@@ -26,12 +41,25 @@ functions own it:
   ``horizon - 1`` frozen slot-steps per completion (the same
   done-mask mechanics as ``greedy_generate_kv(eos_id=...)``, so the
   emitted stream stays bit-identical).
+* :meth:`SlotDecoder.step_spec` — SELF-SPECULATIVE decode
+  (``spec_depth > 0``): each fused round drafts ``spec_depth`` tokens
+  with a shallow-exit prefix of the model's own layers
+  (``Transformer(..., exit_layer=spec_layers)`` — shared params, shared
+  slab), rolls the draft layers' cursors back, verifies the whole
+  window with ONE full-model multi-token step, and accepts the longest
+  per-lane prefix the target agrees with plus the target's own
+  correction token. Greedy verification accepts exactly the tokens
+  ``greedy_generate_kv`` would emit, so the bit-identical-decode
+  contract (crash replay, parity tests) survives the speedup; rejected
+  draft entries sit past the rewound per-lane cursor, masked and
+  overwritten (the ``_set_cache_cursor`` rollback trick, vectorized).
 
 Everything here is functional — the ``serving.engine.ServingEngine``
 thread owns the slab value and the host-side bookkeeping (which slots
-are live, per-request budgets/EOS).
+are live, per-request budgets/EOS, the page allocator / prefix trie).
 """
 
+import dataclasses
 from typing import Sequence, Tuple
 
 import jax
@@ -74,16 +102,44 @@ def _is_index(path) -> bool:
   return bool(path) and getattr(path[-1], "key", None) == "index"
 
 
+def _cursor_leaf(slabs):
+  """The slab's per-slot cursor vector (the first ``index`` leaf — every
+  layer carries the same value in steady state)."""
+  from jax.tree_util import tree_flatten_with_path
+  for path, leaf in tree_flatten_with_path(slabs)[0]:
+    if _is_index(path):
+      return leaf
+  raise ValueError("slab pytree has no 'index' leaf")
+
+
+def _with_cursor(slabs, vec):
+  """Every layer's cursor set to ``vec`` (vectorized rollback: rejected
+  speculative entries sit past the cursor, masked and overwritten — the
+  same free-rollback property as ``transformer._set_cache_cursor``)."""
+  return tree_map_with_path(
+      lambda p, leaf: vec.astype(leaf.dtype) if _is_index(p) else leaf,
+      slabs)
+
+
 class SlotDecoder(object):
   """Jitted slab operations for one (config, num_slots) serving shape.
 
   Greedy decode only: continuous batching's contract is that every
   request's tokens are bit-identical to its own single-request decode,
   which sampling's batch-shaped rng draw cannot promise.
+
+  ``page_size > 0`` switches the slab to the PAGED layout (``num_pages``
+  pool pages of ``page_size`` tokens each, ``pages_per_slot`` table
+  entries per slot — defaults cover the contiguous worst case so paging
+  alone never shrinks capacity; set ``num_pages`` lower to spend less
+  HBM than ``num_slots × max_seq_len``). ``spec_depth > 0`` enables
+  :meth:`step_spec` with a ``spec_layers``-deep shallow-exit draft.
   """
 
   def __init__(self, cfg, num_slots: int, pad_id: int = 0, eos_id=None,
-               mesh=None):
+               mesh=None, page_size: int = 0, num_pages: int = 0,
+               pages_per_slot: int = 0, spec_depth: int = 0,
+               spec_layers: int = 0):
     if num_slots < 1:
       raise ValueError("num_slots must be >= 1, got %d" % num_slots)
     self.cfg = cfg
@@ -91,20 +147,53 @@ class SlotDecoder(object):
     self.pad_id = int(pad_id)
     self.eos_id = None if eos_id is None else int(eos_id)
     self.mesh = mesh
+    self.page_size = int(page_size)
+    self.paged = self.page_size > 0
+    if self.paged:
+      pps = int(pages_per_slot) or -(-cfg.max_seq_len // self.page_size)
+      pool = int(num_pages) or num_slots * pps + 1
+      self.pages_per_slot = pps
+      self.num_pages = pool
+      # the slab model carries the paged cache layout in its config (the
+      # jit-cache key), while prefill keeps the contiguous row layout
+      self.slab_cfg = dataclasses.replace(
+          cfg, kv_page_size=self.page_size, kv_num_pages=pool,
+          kv_pages_per_slot=pps)
+    else:
+      self.pages_per_slot = 0
+      self.num_pages = 0
+      self.slab_cfg = cfg
+    self.spec_depth = int(spec_depth)
+    if self.spec_depth < 0:
+      raise ValueError("spec_depth must be >= 0, got %d" % self.spec_depth)
+    self.spec_layers = int(spec_layers) or max(1, cfg.num_layers // 2)
+    if self.spec_depth and not 1 <= self.spec_layers <= cfg.num_layers:
+      raise ValueError(
+          "spec_layers must be in [1, num_layers=%d], got %d"
+          % (cfg.num_layers, self.spec_layers))
     self.model = tfm.Transformer(cfg, mesh=mesh)
+    self.slab_model = tfm.Transformer(self.slab_cfg, mesh=mesh) \
+        if self.paged else self.model
     # jit caches retrace per chunk shape (bounded by the bucket set) /
     # once for insert+step (fixed slab shapes)
     self._prefill_fn = jax.jit(self._prefill_impl)
     self._insert_fn = jax.jit(self._insert_impl)
+    self._insert_pages_fn = jax.jit(self._insert_pages_impl)
+    self._gather_pages_fn = jax.jit(self._gather_pages_impl)
+    self._reset_slots_fn = jax.jit(self._reset_slots_impl)
     self._step_fn = jax.jit(self._step_impl)
     self._step_many_jits = {}    # horizon -> jitted fused-scan step
+    self._step_spec_jits = {}    # rounds -> jitted fused spec-round scan
     self._zero_row = None        # memoized fresh [1, ...] cache (immutable)
 
   # -- slab construction ----------------------------------------------------
 
   def init_slabs(self):
-    """A fresh all-zeros slab with VECTOR per-slot cursors."""
-    cache = tfm._zero_cache(self.model, self.num_slots)
+    """A fresh all-zeros slab with VECTOR per-slot cursors (paged slabs
+    are born vector-cursored with their page tables all-trash)."""
+    cache = tfm._zero_cache(self.slab_model, self.num_slots)
+    if self.paged:
+      return cache                 # index is already [num_slots]
 
     def widen(path, leaf):
       if _is_index(path):
@@ -125,13 +214,20 @@ class SlotDecoder(object):
     nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
     return mutated["cache"], nxt
 
-  def prefill(self, params, prompt, buckets: Sequence[int] = DEFAULT_BUCKETS
-              ) -> Tuple[object, int]:
+  def prefill(self, params, prompt, buckets: Sequence[int] = DEFAULT_BUCKETS,
+              resume=None) -> Tuple[object, int]:
     """Prefill one prompt into a fresh [1, ...] row cache.
 
     Returns ``(row_cache, first_token)``: the warm cache (cursor at
     ``len(prompt)``) and the first generated token g1. Chunks follow
     :func:`chunk_plan`, so only the LAST chunk's logits matter.
+
+    ``resume=(row_cache, start)`` skips the first ``start`` prompt
+    tokens: the given warm cache already holds their KV (the
+    shared-prefix path — ``gather_pages`` rebuilds such a cache from
+    cached pool pages), so only the tail rides the chunked prefill.
+    ``start`` must leave at least one tail token (the last prompt token
+    must run through the model to yield g1).
     """
     plen = len(prompt)
     if plen + 1 > self.cfg.max_seq_len:
@@ -143,15 +239,23 @@ class SlotDecoder(object):
     # The index is the prompt length — the one identity a spec can pin
     # before request ids exist (per-length specs make poison requests)
     chaos.serve_fault("prefill", index=plen)
-    if self._zero_row is None:
-      # memoized: model.init is a full trace, far too slow to pay per
-      # admitted request; jax arrays are immutable so one zero pytree
-      # serves every prefill
-      self._zero_row = tfm._zero_cache(self.model, 1)
-    cache = self._zero_row
+    if resume is not None:
+      cache, off = resume
+      off = int(off)
+      if not 0 <= off < plen:
+        raise ValueError(
+            "prefill resume offset %d must be in [0, prompt_len=%d)"
+            % (off, plen))
+    else:
+      if self._zero_row is None:
+        # memoized: model.init is a full trace, far too slow to pay per
+        # admitted request; jax arrays are immutable so one zero pytree
+        # serves every prefill
+        self._zero_row = tfm._zero_cache(self.model, 1)
+      cache, off = self._zero_row, 0
     prompt = jnp.asarray(prompt, jnp.int32).reshape(1, plen)
-    off, nxt = 0, None
-    for seg in chunk_plan(plen, buckets):
+    nxt = None
+    for seg in chunk_plan(plen - off, buckets):
       cache, nxt = self._prefill_fn(
           params, cache, lax.dynamic_slice(prompt, (0, off), (1, seg)))
       off += seg
@@ -176,10 +280,120 @@ class SlotDecoder(object):
     """Write a prefilled row cache into slab position ``slot``."""
     return self._insert_fn(slabs, row_cache, jnp.asarray(slot, jnp.int32))
 
+  # -- paged slab ops --------------------------------------------------------
+
+  def _each_attn(self, slabs, row):
+    """Yield matching (slab attn-cache dict, row attn-cache dict) pairs —
+    the paged slab and the contiguous row cache have different leaf sets,
+    so tree_map cannot pair them; this walks the shared dict spine."""
+    if isinstance(slabs, dict) and "pages_k" in slabs:
+      yield slabs, row
+      return
+    for key in slabs:
+      for pair in self._each_attn(slabs[key],
+                                  None if row is None else row[key]):
+        yield pair
+
+  def _map_attn(self, slabs, row, fn):
+    """Rebuild ``slabs`` with ``fn(slab_attn, row_attn)`` applied at every
+    attention-cache node (the dict holding ``pages_k``)."""
+    if isinstance(slabs, dict) and "pages_k" in slabs:
+      return fn(slabs, row)
+    return {k: self._map_attn(slabs[k],
+                              None if row is None else row[k], fn)
+            for k in slabs}
+
+  def _insert_pages_impl(self, slabs, row, slot, pages, start):
+    """Scatter a prefilled row cache into pool pages.
+
+    ``pages[i]`` receives prompt tokens ``[i·page_size, (i+1)·page_size)``;
+    positions below ``start`` (already resident in shared prefix pages)
+    and at/after the row's cursor are routed to the trash page. Sets the
+    slot's page-table row and cursor as part of the same dispatch.
+    """
+    obs_device.note_trace("serve.insert_pages")
+    ps, pp = self.page_size, self.pages_per_slot
+    max_len = self.cfg.max_seq_len
+    pos = jnp.arange(max_len)
+
+    def ins(att_s, att_r):
+      plen = att_r["index"].astype(jnp.int32)            # row cursor
+      valid = jnp.logical_and(pos >= start, pos < plen)
+      pg = jnp.where(valid, pages[jnp.clip(pos // ps, 0, pp - 1)], 0)
+      off = pos % ps
+      new = dict(att_s)
+      new["pages_k"] = att_s["pages_k"].at[pg, off].set(
+          att_r["cached_k"][0].astype(att_s["pages_k"].dtype))
+      new["pages_v"] = att_s["pages_v"].at[pg, off].set(
+          att_r["cached_v"][0].astype(att_s["pages_v"].dtype))
+      new["page_table"] = att_s["page_table"].at[slot].set(pages)
+      new["index"] = att_s["index"].at[slot].set(plen)
+      return new
+
+    return self._map_attn(slabs, row, ins)
+
+  def insert_pages(self, slabs, row_cache, slot: int, pages, start: int = 0):
+    """Paged insert: write ``row_cache`` into ``pages`` (a
+    ``pages_per_slot``-long int32 list, unused tail entries 0/trash) for
+    slab position ``slot``, skipping the first ``start`` tokens (they
+    live in shared read-only prefix pages the table also names)."""
+    return self._insert_pages_fn(slabs, row_cache,
+                                 jnp.asarray(slot, jnp.int32),
+                                 jnp.asarray(pages, jnp.int32),
+                                 jnp.asarray(start, jnp.int32))
+
+  def _gather_pages_impl(self, slabs, pages, n_tokens):
+    """Rebuild a contiguous [1, ...] row cache holding ``n_tokens``
+    prefix tokens gathered from pool ``pages`` — the warm cache a
+    shared-prefix tail prefill resumes from. Positions at/after
+    ``n_tokens`` are garbage but sit past the cursor (masked, then
+    overwritten by the tail prefill's writes before they are attended).
+    """
+    obs_device.note_trace("serve.gather_pages")
+    ps, pp = self.page_size, self.pages_per_slot
+    max_len = self.cfg.max_seq_len
+    take = min(pp * ps, max_len)
+
+    def build(att_s, _):
+      hk, d = att_s["pages_k"].shape[-2:]
+      row = {}
+      for name in ("cached_k", "cached_v"):
+        src = att_s["pages_" + name[-1]]
+        flat = src[pages].reshape(pp * ps, hk, d)
+        buf = jnp.zeros((max_len, hk, d), src.dtype)
+        row[name] = buf.at[:take].set(flat[:take])[None]
+      row["index"] = n_tokens.astype(jnp.int32)
+      return row
+
+    return self._map_attn(slabs, None, build)
+
+  def gather_pages(self, slabs, pages, n_tokens: int):
+    return self._gather_pages_fn(slabs, jnp.asarray(pages, jnp.int32),
+                                 jnp.asarray(n_tokens, jnp.int32))
+
+  def _reset_slots_impl(self, slabs, freed):
+    """Zero the page tables and cursors of freed slots: a freed slot's
+    lane keeps computing (frozen), and its stale table would otherwise
+    route garbage writes into pages the allocator has already handed to
+    a NEW request — the reset points them at the trash page instead."""
+    obs_device.note_trace("serve.reset_slots")
+
+    def rst(att_s, _):
+      new = dict(att_s)
+      new["page_table"] = jnp.where(freed[:, None], 0,
+                                    att_s["page_table"])
+      new["index"] = jnp.where(freed, 0, att_s["index"])
+      return new
+
+    return self._map_attn(slabs, None, rst)
+
+  def reset_slots(self, slabs, freed_mask):
+    return self._reset_slots_fn(slabs, jnp.asarray(freed_mask, jnp.bool_))
+
   # -- decode step ----------------------------------------------------------
 
   def _one_step(self, params, slabs, tok, active):
-    logits, mutated = self.model.apply(
+    logits, mutated = self.slab_model.apply(
         {"params": params, "cache": slabs}, tok[:, None], decode=True,
         mutable=["cache"])
     new_cache = mutated["cache"]
@@ -253,6 +467,117 @@ class SlotDecoder(object):
       # have genuinely different costs
       obs_device.capture_cost(
           "serve.step_many.h%d" % horizon, fn, params, slabs,
+          jnp.asarray(last_tokens, jnp.int32),
+          jnp.asarray(active, jnp.bool_),
+          jnp.asarray(remaining, jnp.int32))
+    return fn(params, slabs, jnp.asarray(last_tokens, jnp.int32),
+              jnp.asarray(active, jnp.bool_),
+              jnp.asarray(remaining, jnp.int32))
+
+  # -- self-speculative decode ----------------------------------------------
+
+  def step_spec(self, params, slabs, last_tokens, active, remaining,
+                rounds: int):
+    """``rounds`` fused SELF-SPECULATIVE rounds (requires
+    ``spec_depth > 0``). Each round per lane: draft ``spec_depth``
+    tokens with the ``spec_layers``-deep shallow exit, roll the draft
+    layers' cursors back, verify the window with ONE full-model
+    multi-token step, keep the longest target-agreeing prefix plus the
+    target's correction token, and advance that lane's cursor by
+    exactly the kept count — so every kept token is the target's own
+    greedy emission (bit-identical to ``greedy_generate_kv``) and a
+    round emits 1..spec_depth tokens per live lane.
+
+    Returns ``(new_slabs, tokens, counts, accepted, rejected, active,
+    remaining)``: ``tokens [rounds, spec_depth, num_slots]`` (a lane's
+    round is valid for its first ``counts[r, lane]`` positions, pad
+    after — counts are REQUIRED for harvest: rejection padding is
+    indistinguishable from an emitted pad token), ``accepted``/
+    ``rejected [rounds, num_slots]`` draft-token verdicts for the
+    telemetry counters. One compile per distinct ``rounds``.
+    """
+    if not self.spec_depth:
+      raise ValueError("step_spec requires spec_depth > 0")
+    if rounds < 1:
+      raise ValueError("rounds must be >= 1, got %d" % rounds)
+    # the same deterministic fault site as step_many: one count per
+    # fused decode dispatch, so TOS_CHAOS_SERVE schedules hit spec and
+    # non-spec engines alike
+    chaos.serve_fault("decode")
+    fn = self._step_spec_jits.get(rounds)
+    if fn is None:
+      k = self.spec_depth
+
+      def impl(params, slabs, tok, active, remaining, _r=rounds):
+        obs_device.note_trace("serve.step_spec")
+
+        def round_body(carry, _):
+          slabs, tok, active, remaining = carry
+          cur0 = _cursor_leaf(slabs).astype(jnp.int32)
+
+          def dstep(c, _):
+            cache, t = c
+            logits, mut = self.slab_model.apply(
+                {"params": params, "cache": cache}, t[:, None],
+                decode=True, mutable=["cache"],
+                exit_layer=self.spec_layers)
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            return (mut["cache"], nxt), nxt
+
+          (cache_d, _), P = lax.scan(dstep, (slabs, tok), None, length=k)
+          # rollback: only the shallow layers advanced; their draft
+          # writes sit past the restored cursor, masked and overwritten
+          cache_d = _with_cursor(cache_d, cur0)
+          Pt = P.T                                         # [S, k]
+          V = jnp.concatenate([tok[:, None], Pt[:, :k - 1]], axis=1)
+          logits, mut = self.slab_model.apply(
+              {"params": params, "cache": cache_d}, V, decode=True,
+              mutable=["cache"])
+          cache_v = mut["cache"]
+          T = jnp.argmax(logits, -1).astype(jnp.int32)     # [S, k]
+          ok = (Pt == T).astype(jnp.int32)
+          m = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)     # [S] in [0,k]
+          bonus = jnp.take_along_axis(
+              T, jnp.minimum(m, k - 1)[:, None], axis=1)[:, 0]
+          cols = jnp.arange(k)[None, :]
+          # kept stream: m agreed proposals, then (m < k) the target's
+          # correction — never more than k tokens, all target-greedy
+          emit = jnp.where(cols == m[:, None], bonus[:, None], Pt)
+          adv = jnp.where(m < k, m + 1, k)
+          limit = jnp.minimum(adv, remaining)
+          if self.eos_id is not None:
+            iseos = jnp.logical_and(emit == self.eos_id,
+                                    cols < limit[:, None])
+            has_eos = jnp.any(iseos, axis=1)
+            stop = jnp.where(has_eos, jnp.argmax(iseos, axis=1) + 1,
+                             limit)
+          else:
+            has_eos = jnp.zeros_like(active)
+            stop = limit
+          stop = jnp.where(active, stop, 0)
+          toks = jnp.where(cols < stop[:, None], emit,
+                           jnp.int32(self.pad_id))
+          new_rem = jnp.where(active, remaining - stop, remaining)
+          done = jnp.logical_or(new_rem <= 0, has_eos)
+          new_active = jnp.logical_and(active, jnp.logical_not(done))
+          newlast = jnp.take_along_axis(
+              emit, jnp.clip(stop - 1, 0, k - 1)[:, None], axis=1)[:, 0]
+          new_tok = jnp.where(new_active, newlast,
+                              jnp.int32(self.pad_id))
+          slabs2 = _with_cursor(cache_v, cur0 + stop)
+          accepted = jnp.minimum(stop, m)
+          rejected = jnp.where(active, k - m, 0)
+          return (slabs2, new_tok, new_active, new_rem), \
+              (toks.T, stop, accepted, rejected)
+
+        (slabs, tok, active, remaining), ys = lax.scan(
+            round_body, (slabs, tok, active, remaining), None, length=_r)
+        toks, counts, acc, rej = ys
+        return slabs, toks, counts, acc, rej, active, remaining
+
+      fn = self._step_spec_jits[rounds] = jax.jit(impl)
+      obs_device.capture_cost(
+          "serve.step_spec.r%d" % rounds, fn, params, slabs,
           jnp.asarray(last_tokens, jnp.int32),
           jnp.asarray(active, jnp.bool_),
           jnp.asarray(remaining, jnp.int32))

@@ -301,6 +301,56 @@ class TestServeCrashLoopDetector:
     assert det.poll(now=10.0) == []
 
 
+class TestKvPagesExhaustedDetector:
+  def test_fires_when_pinned_at_zero_with_queue(self):
+    """Free pages at 0 for EVERY sample in the window while requests
+    queue = the paged KV pool is the admission bottleneck."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__kv_pages_free=0, serve__kv_pages_in_use=36,
+             serve__queue_depth=5)
+    det.poll(now=0.0)
+    sink.set(0, serve__kv_pages_free=0, serve__kv_pages_in_use=36,
+             serve__queue_depth=7)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["kv_pages_exhausted"]
+    assert alerts[0]["evidence"]["queue_depth"] == 7
+    assert alerts[0]["evidence"]["pages_in_use"] == 36
+
+  def test_transient_zero_stays_quiet(self):
+    """Any sample above 0 inside the window clears the verdict: dipping
+    to 0 between completions is the pool doing its job, not exhaustion
+    — just below the pinned-all-window threshold."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__kv_pages_free=1, serve__kv_pages_in_use=35,
+             serve__queue_depth=5)
+    det.poll(now=0.0)
+    sink.set(0, serve__kv_pages_free=0, serve__kv_pages_in_use=36,
+             serve__queue_depth=7)
+    assert det.poll(now=10.0) == []
+
+  def test_empty_queue_stays_quiet(self):
+    """A full pool with nothing waiting is just a full pool — the alert
+    is about ADMISSION being blocked, not utilization."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__kv_pages_free=0, serve__kv_pages_in_use=36,
+             serve__queue_depth=0)
+    det.poll(now=0.0)
+    sink.set(0, serve__kv_pages_free=0, serve__kv_pages_in_use=36,
+             serve__queue_depth=0)
+    assert det.poll(now=10.0) == []
+
+  def test_unpaged_executor_is_exempt(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__queue_depth=9, serve__occupancy=0.2)
+    det.poll(now=0.0)
+    sink.set(0, serve__queue_depth=9, serve__occupancy=0.2)
+    assert det.poll(now=10.0) == []
+
+
 class TestMemorySlopeDetector:
   def test_fires_on_monotonic_creep(self):
     sink = FakeSink(eids=(0,))

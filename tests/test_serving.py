@@ -17,9 +17,9 @@ import jax.numpy as jnp
 
 from tensorflowonspark_tpu.models import transformer as tfm
 from tensorflowonspark_tpu.serving import (
-    DEFAULT_BUCKETS, DeadlineExceeded, PoisonedRequest, Request,
-    RequestCancelled, RequestQueue, ServingEngine, ServingOverloaded,
-    SlotDecoder, chunk_plan)
+    DEFAULT_BUCKETS, DeadlineExceeded, PagePool, PoisonedRequest,
+    PrefixCache, Request, RequestCancelled, RequestQueue, ServingEngine,
+    ServingOverloaded, SlotDecoder, chunk_plan)
 from tensorflowonspark_tpu.utils import chaos
 
 EOS = 7
@@ -537,6 +537,262 @@ class TestFailFast:
     eng.stop()
 
 
+class TestPagePool:
+  def test_alloc_ref_unref_exactly_once(self):
+    pool = PagePool(6)                      # 5 allocatable, page 0 trash
+    assert pool.capacity == 5 and pool.free_pages == 5
+    pages = pool.alloc(3)
+    assert len(pages) == 3 and 0 not in pages
+    assert pool.in_use == 3
+    pool.ref(pages[0])                      # a second reader (prefix fork)
+    assert pool.unref(pages[0]) is False    # still held by the reader
+    assert pool.unref(pages[0]) is True     # last ref: freed
+    with pytest.raises(ValueError, match="double free"):
+      pool.unref(pages[0])
+    assert pool.alloc(10) is None           # all-or-nothing
+    for p in pages[1:]:
+      pool.unref(p)
+    assert pool.free_pages == 5
+
+  def test_trash_page_never_allocated_or_freed(self):
+    pool = PagePool(3)
+    got = pool.alloc(2)
+    assert sorted(got) == [1, 2]
+    with pytest.raises(ValueError):
+      pool.unref(0)
+    with pytest.raises(ValueError, match="num_pages"):
+      PagePool(1)
+
+
+class TestPrefixCacheTrie:
+  def test_lookup_register_longest_match(self):
+    c = PrefixCache(page_size=2, max_pages=8)
+    assert c.lookup([1, 2, 3, 4, 5]) == []
+    assert c.register([1, 2, 3, 4, 5], [10, 11]) == [10, 11]
+    assert c.pages_held == 2
+    # same full pages hit; the partial tail page never enters the trie
+    assert c.lookup([1, 2, 3, 4, 9, 9]) == [10, 11]
+    assert c.lookup([1, 2, 9, 9]) == [10]   # diverges at the second page
+    # re-registering an existing path adds nothing; a divergent branch
+    # adds only its own page
+    assert c.register([1, 2, 3, 4], [20, 21]) == []
+    assert c.register([1, 2, 9, 9], [10, 30]) == [30]
+    assert c.pages_held == 3
+
+  def test_lru_eviction_leaf_first(self):
+    c = PrefixCache(page_size=2, max_pages=2)
+    c.register([1, 2, 3, 4], [10, 11])
+    c.lookup([1, 2])                        # touch the interior node
+    released = c.evict(1)
+    assert released == [11]                 # leaf goes first, LRU or not
+    assert c.pages_held == 1
+    assert c.lookup([1, 2, 3, 4]) == [10]
+    assert c.evict(5) == [10]               # drains to empty, no crash
+    assert c.evict(1) == []
+
+
+class TestPagedSlab:
+  # prompt lengths / budgets across this module's paged/prefix/spec
+  # tests deliberately reuse the (plen, budget) pairs other tests
+  # already compiled — the parity oracle is a fresh jit per pair, and
+  # novel shapes were the slowest thing in the module
+
+  def test_paged_parity_and_page_release(self, tiny_state):
+    """Paged-slab acceptance pin: mixed-length traffic through page
+    tables + the pool is bit-identical per request, and every page is
+    released once its request completes (refcount accounting)."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, 64, (int(p),)).astype(np.int32)
+               for p in (4, 7, 11, 16, 7, 4)]
+    budgets = [3, 8, 14, 8, 3, 8]
+    with ServingEngine(state.params, cfg, num_slots=3, eos_id=EOS,
+                       page_size=4) as eng:
+      rids = [eng.submit(p, max_new_tokens=b)
+              for p, b in zip(prompts, budgets)]
+      outs = [eng.result(r, timeout=120) for r in rids]
+      assert eng.kv_pages_in_use == 0       # everything returned
+    for p, b, out in zip(prompts, budgets, outs):
+      np.testing.assert_array_equal(out,
+                                    _reference(state.params, cfg, p, b))
+
+  def test_tight_pool_waits_for_pages_then_serves(self, tiny_state):
+    """More slots than the pool can host at once: requests WAIT in the
+    queue for completions to free pages (never fail, never corrupt) —
+    the slot-count-exceeds-HBM regime paging exists for."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(1, 64, (int(p),)).astype(np.int32)
+               for p in (16, 11, 7, 4)]
+    # the length-16 request needs ceil((16+8)/4)=6 pages; 12 allocatable
+    # pages host at most two such concurrently across 4 slots
+    with ServingEngine(state.params, cfg, num_slots=4, eos_id=EOS,
+                       page_size=4, num_pages=13) as eng:
+      rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+      outs = [eng.result(r, timeout=120) for r in rids]
+    for p, out in zip(prompts, outs):
+      np.testing.assert_array_equal(out,
+                                    _reference(state.params, cfg, p, 8))
+
+  def test_oversized_for_pool_rejected_at_submit(self, tiny_state):
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, page_size=4,
+                        num_pages=4)
+    with pytest.raises(ValueError, match="KV pages"):
+      eng.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=20)
+    eng.stop()
+
+  def test_env_knobs_register_and_apply(self, tiny_state, monkeypatch):
+    cfg, state = tiny_state
+    monkeypatch.setenv("TOS_SERVE_PAGE_SIZE", "4")
+    monkeypatch.setenv("TOS_SERVE_NUM_PAGES", "20")
+    monkeypatch.setenv("TOS_SERVE_PREFIX_PAGES", "6")
+    monkeypatch.setenv("TOS_SERVE_SPEC_DEPTH", "3")
+    monkeypatch.setenv("TOS_SERVE_SPEC_LAYERS", "1")
+    eng = ServingEngine(state.params, cfg)
+    assert eng.page_size == 4
+    assert eng.decoder.paged and eng.decoder.num_pages == 20
+    assert eng.prefix_pages == 6
+    assert eng.spec_depth == 3 and eng.decoder.spec_layers == 1
+    # explicit arguments beat the env knobs (the num_slots rule)
+    eng2 = ServingEngine(state.params, cfg, page_size=0, prefix_pages=0,
+                         spec_depth=0)
+    assert not eng2.decoder.paged and eng2.spec_depth == 0
+
+  def test_prefix_cache_requires_paging(self, tiny_state):
+    cfg, state = tiny_state
+    with pytest.raises(ValueError, match="TOS_SERVE_PAGE_SIZE"):
+      ServingEngine(state.params, cfg, prefix_pages=4)
+
+
+class TestPrefixSharing:
+  def test_shared_prefix_parity_hits_release_and_drain(self, tiny_state):
+    """Requests sharing a system prefix prefill it once (prefix_hits),
+    stay bit-identical, and after every request completes the ONLY
+    pages still allocated are the prefix cache's own refs — completion
+    released each request's refs exactly once. A second wave then rides
+    `drain()`: admission closes, accepted work finishes (zero shed),
+    and the drain path releases its ref-counted pages exactly once too
+    (the loud-double-free PagePool would raise otherwise)."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(23)
+    prefix = rng.randint(1, 64, (12,)).astype(np.int32)
+    prompts = [np.concatenate([prefix,
+                               rng.randint(1, 64, (n,)).astype(np.int32)])
+               for n in (3, 5, 2, 6)]
+    eng = ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS,
+                        page_size=4, prefix_pages=8).start()
+    rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    outs = [eng.result(r, timeout=120) for r in rids]
+    assert eng.stats["prefix_hits"] >= len(prompts) - 1
+    # exactly-once release: live pages == the cache's holdings
+    assert eng.kv_pages_in_use == eng._prefix.pages_held > 0
+    drain_rids = [eng.submit(p, max_new_tokens=8) for p in prompts[:2]]
+    assert eng.drain(timeout=120) is True
+    for p, out in zip(prompts, outs):
+      np.testing.assert_array_equal(out,
+                                    _reference(state.params, cfg, p, 8))
+    for p, rid in zip(prompts[:2], drain_rids):
+      np.testing.assert_array_equal(eng.result(rid, timeout=5),
+                                    _reference(state.params, cfg, p, 8))
+    assert not eng.alive
+
+  def test_eviction_under_budget_keeps_parity(self, tiny_state):
+    """A prefix budget too small for the traffic evicts LRU pages
+    (counter moves) without ever corrupting decodes — ref-counted pages
+    survive until their last reader finishes."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(29)
+    pre_a = rng.randint(1, 64, (12,)).astype(np.int32)
+    pre_b = rng.randint(1, 64, (12,)).astype(np.int32)
+    prompts = []
+    for pre in (pre_a, pre_b, pre_a, pre_b):
+      prompts.append(np.concatenate(
+          [pre, rng.randint(1, 64, (3,)).astype(np.int32)]))
+    with ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS,
+                       page_size=4, prefix_pages=3) as eng:
+      outs = [eng.result(eng.submit(p, max_new_tokens=8), timeout=120)
+              for p in prompts]
+      assert eng.stats["prefix_evictions"] > 0
+      assert eng._prefix.pages_held <= 3
+    for p, out in zip(prompts, outs):
+      np.testing.assert_array_equal(out,
+                                    _reference(state.params, cfg, p, 8))
+
+
+class TestSpeculativeDecode:
+  def test_spec_parity_and_counters(self, tiny_state):
+    """Self-speculative decode is a SPEED knob, never a semantics knob:
+    outputs stay bit-identical to single-request decodes while the
+    accept/reject counters show the mechanism actually ran."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(37)
+    prompts = [rng.randint(1, 64, (int(p),)).astype(np.int32)
+               for p in (4, 7, 11, 16, 7)]
+    budgets = [3, 8, 14, 8, 3]
+    with ServingEngine(state.params, cfg, num_slots=3, eos_id=EOS,
+                       spec_depth=3) as eng:
+      rids = [eng.submit(p, max_new_tokens=b)
+              for p, b in zip(prompts, budgets)]
+      outs = [eng.result(r, timeout=120) for r in rids]
+      assert eng.stats["spec_accepted"] + eng.stats["spec_rejected"] > 0
+    for p, b, out in zip(prompts, budgets, outs):
+      np.testing.assert_array_equal(out,
+                                    _reference(state.params, cfg, p, b))
+
+  def test_full_stack_parity(self, tiny_state):
+    """Paged slab + prefix sharing + speculation COMPOSED keep the
+    bit-identical contract (the combined-stack acceptance gate)."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(41)
+    prefix = rng.randint(1, 64, (12,)).astype(np.int32)
+    prompts = [np.concatenate([prefix,
+                               rng.randint(1, 64, (n,)).astype(np.int32)])
+               for n in (3, 5, 4, 6)]
+    with ServingEngine(state.params, cfg, num_slots=3, eos_id=EOS,
+                       page_size=4, prefix_pages=8, spec_depth=2) as eng:
+      rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+      outs = [eng.result(r, timeout=120) for r in rids]
+    for p, out in zip(prompts, outs):
+      np.testing.assert_array_equal(out,
+                                    _reference(state.params, cfg, p, 8))
+
+  def test_spec_overshoot_at_max_seq_len_keeps_parity(self, tiny_state):
+    """A verify window may transiently overshoot max_seq_len on a lane
+    whose remaining budget < spec_depth at the cap. The overflow writes
+    must DROP (contiguous: OOB scatter; paged: forced to the trash
+    page) — a clamped/clipped write would overwrite live attended KV
+    below the cursor and break bit-parity. Regression for the review
+    finding: prompt+budget pinned exactly at max_seq_len, depth 6."""
+    cfg, state = tiny_state                 # max_seq_len = 48
+    rng = np.random.RandomState(47)
+    prompt = rng.randint(1, 64, (34,)).astype(np.int32)
+    budget = cfg.max_seq_len - len(prompt)  # 14: flush against the cap
+    ref = _reference(state.params, cfg, prompt, budget)
+    for paged in (dict(), dict(page_size=4)):
+      with ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS,
+                         spec_depth=6, **paged) as eng:
+        out = eng.result(eng.submit(prompt, max_new_tokens=budget),
+                         timeout=120)
+      np.testing.assert_array_equal(out, ref, err_msg=str(paged))
+
+  def test_spec_depth_invariant(self, tiny_state):
+    """Like the horizon: spec depth changes dispatch shape only —
+    spec off and spec depth 2 emit identical streams."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(43)
+    prompts = [rng.randint(1, 64, (int(p),)).astype(np.int32)
+               for p in (4, 7, 11, 16)]
+    results = {}
+    for depth in (0, 2):
+      with ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS,
+                         spec_depth=depth) as eng:
+        results[depth] = eng.generate(prompts, max_new_tokens=8,
+                                      timeout=120)
+    for a, b in zip(results[0], results[2]):
+      np.testing.assert_array_equal(a, b)
+
+
 @pytest.mark.chaos
 class TestServingChaos:
   """TOS_CHAOS_SERVE-driven recovery proofs (make chaos-serve): the
@@ -572,6 +828,36 @@ class TestServingChaos:
     assert stats["replay_mismatches"] == 0
     assert stats["poisoned"] == 0
     assert len(log) == 1 and log[0]["duration_s"] >= 0.01
+    for p, out in zip(prompts, outs):
+      np.testing.assert_array_equal(
+          out, _reference(state.params, cfg, p, 8))
+
+  def test_decode_crash_replays_paged_stack_bit_identical(
+      self, tiny_state, monkeypatch):
+    """Crash-replay OVER THE PAGED SLAB (+ prefix cache + spec): the
+    recovery rebuilds the page pool, page tables and prefix trie from
+    nothing and replays every in-flight request — outputs stay
+    bit-identical with stream dedup, and the rebuilt pool's accounting
+    balances (no pages leaked across the crash)."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(51)
+    prefix = rng.randint(1, 64, (12,)).astype(np.int32)
+    prompts = [np.concatenate([prefix,
+                               rng.randint(1, 64, (n,)).astype(np.int32)])
+               for n in (3, 5, 4, 6, 2, 3)]
+    monkeypatch.setenv(chaos.ENV_SERVE, "decode#2:raise")
+    with ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS,
+                       page_size=4, prefix_pages=6, spec_depth=2,
+                       poison_crashes=3, restart_backoff=0.01) as eng:
+      outs = eng.generate(prompts, max_new_tokens=8, timeout=120)
+      stats = dict(eng.stats)
+      assert eng.alive
+      # the post-crash pool balances: only the rebuilt prefix cache
+      # still holds pages once every request finished
+      assert eng.kv_pages_in_use == eng._prefix.pages_held
+    assert stats["engine_restarts"] == 1
+    assert stats["replays"] >= 1
+    assert stats["replay_mismatches"] == 0
     for p, out in zip(prompts, outs):
       np.testing.assert_array_equal(
           out, _reference(state.params, cfg, p, 8))

@@ -109,6 +109,45 @@ class TestServeBenchCompareSmoke:
     assert result["static"]["fixed_steps"] in result["workload"]["budgets"]
 
 
+class TestServeBenchPrefixSmoke:
+  def test_prefix_workload_smoke_holds_parity_per_stage(self):
+    """`serve_bench --prefix-workload --smoke` drives the REAL staged
+    decode-speed stack (paged KV at equal HBM, shared-prefix cache,
+    self-speculative decode) on CPU: every stage's bit-parity with
+    single-request decodes is re-verified on each CI run, the prefix
+    cache demonstrably hits, and paging admits more slots at the same
+    HBM budget. The ≥1.5× stack speedup is the FULL shape's claim
+    (bench_artifacts/serve_bench_prefix.json) — the smoke shape is
+    dispatch-dominated, so only parity/shape/mechanism are asserted."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "serve_bench.py"),
+         "--prefix-workload", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "serving_prefix_stack_tokens_per_sec"
+    assert result["parity_ok"] is True
+    legs = result["legs"]
+    assert set(legs) == {"baseline", "paged", "paged_prefix",
+                         "full_stack"}
+    for leg in legs.values():
+      assert leg["parity_mismatches"] == 0
+      assert leg["tok_s"] > 0
+    assert legs["paged_prefix"]["prefix_hits"] > 0
+    acc = legs["full_stack"].get("spec_accept_rate")
+    assert acc is not None and 0.0 <= acc <= 1.0
+    slots = result["slots_at_equal_hbm"]
+    assert slots["paged"] > slots["contiguous"]
+
+
 class TestServeBenchChaosSmoke:
   def test_chaos_smoke_recovers_with_bit_parity(self):
     """`serve_bench --chaos --smoke` injects a REAL deterministic decode

@@ -135,6 +135,13 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "engine_restarts": m.get("serve.engine_restarts"),
         "replays": m.get("serve.replays"),
         "rejected": m.get("serve.rejected"),
+        # decode-speed stack telemetry (paged KV / prefix cache / spec)
+        "kv_pages_in_use": m.get("serve.kv_pages_in_use"),
+        "kv_pages_free": m.get("serve.kv_pages_free"),
+        "prefix_hits": m.get("serve.prefix_hits"),
+        "prefills": m.get("serve.prefills"),
+        "spec_accepted": m.get("serve.spec_accepted"),
+        "spec_rejected": m.get("serve.spec_rejected"),
         "mem_in_use": m.get("device.bytes_in_use"),
         "mem_peak": m.get("device.peak_bytes"),
         "compiles": m.get("xla.compiles"),
@@ -174,6 +181,22 @@ def render(snap, clear=True):
       # moment any recovery/rejection counter moves
       feed += "  serve[" + " ".join("%s %d" % (lbl, v)
                                     for lbl, v in srv) + "]"
+    kv = []
+    if row.get("kv_pages_in_use") is not None \
+        and row.get("kv_pages_free") is not None:
+      kv.append("pages %d/%d" % (row["kv_pages_in_use"],
+                                 row["kv_pages_in_use"]
+                                 + row["kv_pages_free"]))
+    hits, pf = row.get("prefix_hits"), row.get("prefills")
+    if hits is not None and pf:
+      kv.append("prefix-hit %.0f%%" % (100.0 * hits / pf))
+    sa, sr = row.get("spec_accepted"), row.get("spec_rejected")
+    if sa is not None and sr is not None and sa + sr > 0:
+      kv.append("spec-acc %.0f%%" % (100.0 * sa / (sa + sr)))
+    if kv:
+      # the decode-speed stack's health at a glance: page headroom,
+      # prefix-cache hit rate, draft acceptance
+      feed += "  kv[" + " ".join(kv) + "]"
     lines.append(
         "%-4s %-9s %8s %8s %6s %6s %9s %8s %7s %7s%s" % (
             eid, row["state"] or "?",
