@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test lint analyze check native bench serve-bench train-bench \
 	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
 	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
-	bench-check
+	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -59,9 +59,11 @@ train-bench-smoke:
 	  $(PY) tools/train_bench.py --smoke
 
 # fast pre-commit gate: static analysis + style + the fast test subset +
-# the obs plumbing smokes + the train-loop fusion smoke
+# the obs plumbing smokes + the train-loop fusion smoke + the serving
+# fleet (replica-kill chaos suite + router/zero-shed-swap bench smoke)
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
-check: analyze obs-smoke obs-top-smoke train-bench-smoke
+check: analyze obs-smoke obs-top-smoke train-bench-smoke fleet-chaos \
+	serve-bench-fleet-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 
@@ -79,6 +81,25 @@ chaos:
 # docs/ROBUSTNESS.md; also tier-1 (not slow)
 chaos-serve:
 	$(PY) -m pytest tests/test_serving.py -q -m chaos
+
+# fleet fault injection only (TOS_CHAOS_FLEET): replica kill mid-decode,
+# ejection, cross-replica failover replay bit-parity, stream dedup
+# across the replica hop — docs/ROBUSTNESS.md §Fleet; tier-1 (not slow)
+fleet-chaos:
+	$(PY) -m pytest tests/test_fleet.py -q -m chaos
+
+# ServingFleet (N replicas + mid-run rolling swap) vs a single engine on
+# the seeded Zipf workload; parity + zero-shed gated; writes the
+# artifact + a serve_bench_fleet history line
+serve-bench-fleet:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --fleet \
+	  --json-out bench_artifacts/serve_bench_fleet.json
+
+# fleet router plumbing check: tiny fleet + swap, parity/zero-shed gated
+serve-bench-fleet-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --fleet --smoke
 
 # degraded goodput + recovery latency under injected serving faults,
 # paired against a clean pass (parity re-verified); writes the artifact

@@ -34,6 +34,20 @@ catalogue every pass:
                     ``TOS_SERVE_NUM_PAGES``, shrink
                     ``TOS_SERVE_PREFIX_PAGES``, or shed load
                     (docs/PERFORMANCE.md §paged KV)
+``fleet_degraded``  ``fleet.replicas_active`` + ``replicas_draining`` below
+                    ``fleet.replicas_total``: one or more serving replicas
+                    were EJECTED (terminal death or failed health probes;
+                    a draining replica is a rolling swap, not lost
+                    capacity) — failover replay keeps accepted requests
+                    completing, but the fleet is running without
+                    redundancy; restore capacity (docs/ROBUSTNESS.md
+                    §Fleet)
+``fleet_saturated`` the fleet-aggregate queue is at/over
+                    ``TOS_OBS_QUEUE_SAT`` per active replica with mean
+                    occupancy ~1 while at FULL replica strength: every
+                    replica is goodput-bound — the scale-up signal (the
+                    ``serving_saturated`` thresholds applied fleet-wide):
+                    add a replica
 ``mem_slope``       ``device.bytes_in_use`` grew monotonically by more than
                     ``TOS_OBS_MEM_SLOPE_PCT`` percent across the window (a
                     leak-shaped creep toward OOM)
@@ -119,6 +133,9 @@ _SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
             "serve.queue_depth", "serve.occupancy",
             "serve.engine_restarts", "serve.replays",
             "serve.kv_pages_free", "serve.kv_pages_in_use",
+            "fleet.replicas_total", "fleet.replicas_active",
+            "fleet.replicas_draining", "fleet.queue_depth",
+            "fleet.occupancy",
             "device.bytes_in_use")
 
 
@@ -271,6 +288,7 @@ class AnomalyDetector(object):
         new.extend(self._check_serving(eid, dq, span, now))
         new.extend(self._check_serve_crash_loop(eid, dq, span, now))
         new.extend(self._check_kv_pages(eid, dq, span, now))
+        new.extend(self._check_fleet(eid, dq, span, now))
         new.extend(self._check_mem_slope(eid, dq, span, now))
     except Exception:  # noqa: BLE001 - the detector must outlive any
       # single evaluation bug; failures are counted and visible
@@ -406,6 +424,49 @@ class AnomalyDetector(object):
         "%d queued request(s) — paging is the admission bottleneck: "
         "raise TOS_SERVE_NUM_PAGES, shrink TOS_SERVE_PREFIX_PAGES, or "
         "shed load" % (eid, span, int(depth)))
+
+  def _check_fleet(self, eid, dq, span, now) -> List[dict]:
+    """The serving-fleet pair: ``fleet_degraded`` when the router runs
+    below its configured replica count (ejection visible online, not
+    just in the event log), and ``fleet_saturated`` — the SCALE-UP
+    signal — when the fleet is at full strength yet every replica is
+    goodput-bound (the ``serving_saturated`` thresholds applied to the
+    fleet aggregate: queue ≥ ``TOS_OBS_QUEUE_SAT`` per active replica at
+    mean occupancy ~1). Degraded and saturated are different verdicts on
+    purpose: the first says restore capacity, the second says add it."""
+    latest = dq[-1][1]
+    total = latest.get("fleet.replicas_total")
+    active = latest.get("fleet.replicas_active")
+    if total is None or active is None or total <= 0:
+      return []
+    # a DRAINING replica is a rolling swap in progress — healthy,
+    # operator-initiated, zero-shed — not lost capacity: alarming on it
+    # would train operators to ignore the real ejection signal
+    draining = latest.get("fleet.replicas_draining") or 0.0
+    if active + draining < total:
+      return self._fire(
+          "fleet_degraded", eid, span, now,
+          {"replicas_active": active, "replicas_draining": draining,
+           "replicas_total": total},
+          "serving fleet on executor %d running %d/%d replicas — "
+          "ejected replica(s) failed over; accepted requests keep "
+          "completing but redundancy is gone: restore capacity"
+          % (eid, int(active), int(total)))
+    if active < total:
+      return []   # mid-swap: saturation readings are perturbed anyway
+    depth = latest.get("fleet.queue_depth")
+    occ = latest.get("fleet.occupancy")
+    if depth is None or occ is None:
+      return []
+    if depth < self.queue_sat * max(1.0, active) or occ < 0.9:
+      return []
+    return self._fire(
+        "fleet_saturated", eid, span, now,
+        {"queue_depth": depth, "occupancy": occ,
+         "replicas_active": active},
+        "serving fleet on executor %d saturated at full strength: %d "
+        "queued request(s) across %d replicas at occupancy %.2f — "
+        "scale up: add a replica" % (eid, int(depth), int(active), occ))
 
   def _check_mem_slope(self, eid, dq, span, now) -> List[dict]:
     series = [(t, v["device.bytes_in_use"]) for t, v in dq

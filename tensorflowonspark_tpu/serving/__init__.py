@@ -19,6 +19,11 @@ Public surface:
   :class:`~tensorflowonspark_tpu.serving.scheduler.PrefixCache` — the
   paged-KV host state: the ref-counted page allocator and the
   shared-prefix radix trie (page-granular, LRU-evicted).
+* :class:`~tensorflowonspark_tpu.serving.fleet.ServingFleet` — the
+  driver-side replica router: load-aware dispatch over N engines,
+  retry-with-backoff on overload, health ejection + cross-replica
+  failover replay (stream positions exactly-once), and zero-shed
+  :meth:`rolling_swap` (docs/ROBUSTNESS.md §Fleet).
 
 Decode-speed stack (docs/PERFORMANCE.md §"Paged KV, prefix cache &
 speculative decode"): ``TOS_SERVE_PAGE_SIZE`` pages the KV slab,
@@ -36,6 +41,10 @@ from tensorflowonspark_tpu.serving.engine import (            # noqa: F401
     ENV_SERVE_PAGE_SIZE, ENV_SERVE_POLL, ENV_SERVE_PREFIX_PAGES,
     ENV_SERVE_SLOTS, ENV_SERVE_SPEC_DEPTH, ENV_SERVE_SPEC_LAYERS,
     ENV_SERVE_TTL, ServingEngine)
+from tensorflowonspark_tpu.serving.fleet import (             # noqa: F401
+    ENV_FLEET_ADMIT_TIMEOUT, ENV_FLEET_MAX_FAILOVERS, ENV_FLEET_POLL,
+    ENV_FLEET_PROBE_FAILS, ENV_FLEET_REPLICAS, FleetRequest, Replica,
+    ServingFleet)
 from tensorflowonspark_tpu.serving.scheduler import (         # noqa: F401
     ENV_SERVE_BUCKETS, DeadlineExceeded, PagePool, PoisonedRequest,
     PrefixCache, Request, RequestCancelled, RequestQueue,

@@ -152,6 +152,10 @@ _DEFAULT_RESTART_BACKOFF = 0.05
 _DEFAULT_POISON_CRASHES = 2
 #: restart backoff never exceeds this many seconds
 _BACKOFF_CAP = 2.0
+#: retry-after hint while the tokens/s EMA is still cold (no decode has
+#: completed yet): a bounded default, never "retry immediately" — a cold
+#: engine's first decode pass is at least a prefill + dispatch away
+_COLD_RETRY_AFTER = 0.25
 #: restart_log keeps this many most-recent recovery records
 _RESTART_LOG_CAP = 64
 
@@ -497,7 +501,9 @@ class ServingEngine(object):
       raise sched.ServingOverloaded(
           "serving engine is draining — admission is closed",
           queue_depth=len(self._queue),
-          queued_tokens=self._queue.token_mass, draining=True)
+          queued_tokens=self._queue.token_mass,
+          retry_after=self._retry_after(self._queue.token_mass),
+          draining=True)
     if self._loop_error is not None:
       raise RuntimeError("serving loop died") from self._loop_error
     with self._lock:
@@ -523,10 +529,14 @@ class ServingEngine(object):
 
   def _retry_after(self, queued_tokens: int) -> float:
     """Backpressure hint: how long until the live decode rate clears the
-    current backlog (bounded; a cold engine answers one poll tick)."""
+    current backlog. Before the first decode completes the tokens/s EMA
+    is 0 and the backlog estimate is undefined — a cold engine answers
+    the bounded ``_COLD_RETRY_AFTER`` default instead of a
+    retry-immediately hint that would have clients hammering an engine
+    still compiling its first dispatch."""
     rate = self._tok_rate
     if rate <= 0:
-      return round(max(self._poll, 0.1), 3)
+      return round(max(self._poll, _COLD_RETRY_AFTER), 3)
     return round(min(60.0, max(self._poll, queued_tokens / rate)), 3)
 
   def cancel(self, rid: int, timeout: float) -> bool:
@@ -697,6 +707,53 @@ class ServingEngine(object):
     if not steps:
       return 0.0
     return self.stats["live_slot_steps"] / float(steps * self.num_slots)
+
+  # -- load telemetry (the fleet router's dispatch inputs) -------------------
+  # The same numbers the HEALTH wire carries as serve.* gauges, exposed
+  # as cheap properties so a driver-side router (serving.fleet) can
+  # score replicas without the obs plane being on.
+
+  @property
+  def queue_depth(self) -> int:
+    """Queued (not yet admitted) request count."""
+    return len(self._queue)
+
+  @property
+  def queued_tokens(self) -> int:
+    """Queued token mass: sum of prompt+budget over the backlog."""
+    return self._queue.token_mass
+
+  @property
+  def tokens_per_sec(self) -> float:
+    """Live tokens/s EMA over decode passes (0.0 before the first)."""
+    return self._tok_rate
+
+  @property
+  def slots_in_use(self) -> int:
+    with self._lock:
+      return sum(1 for r in self._slots if r is not None)
+
+  @property
+  def occupancy_now(self) -> float:
+    """Instantaneous occupied-slot fraction (vs the historical
+    :attr:`occupancy` goodput proxy)."""
+    return self.slots_in_use / float(self.num_slots)
+
+  def kill(self, cause: Optional[BaseException] = None,
+           timeout: float = 5.0) -> None:
+    """Terminal-death injection seam: die AS IF the loop exhausted its
+    restart budget — the loop thread exits, :attr:`alive` flips False,
+    and every waiter (queued, in flight, future) fails fast with
+    ``cause``. The fleet's chaos path (``TOS_CHAOS_FLEET`` kill actions,
+    ``serving.fleet``) and the failover tests drive this; production
+    code should use :meth:`stop`/:meth:`drain`."""
+    err = cause if cause is not None else RuntimeError(
+        "serving engine killed")
+    self._stop_evt.set()                   # the loop exits its next pass
+    self._die(err)
+    t = self._thread
+    if t is not None:
+      t.join(timeout=timeout)
 
   # -- engine loop ----------------------------------------------------------
 

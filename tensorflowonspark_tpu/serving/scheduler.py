@@ -69,18 +69,40 @@ class ServingOverloaded(RuntimeError):
     self.retry_after = retry_after
     self.draining = bool(draining)
 
+  def __reduce__(self):
+    # BaseManager proxies (and any other pickle boundary a fleet replica
+    # crosses) replay __init__ with the default Exception reduction's
+    # single formatted-message arg — here that would DROP the structured
+    # fields (queue_depth, retry_after, draining) the retry logic keys
+    # on. Same manager-proxy bug class as feedhub.QueueFull.
+    return (type(self), (self.args[0] if self.args else "",
+                         self.queue_depth, self.queued_tokens,
+                         self.retry_after, self.draining))
+
 
 class DeadlineExceeded(TimeoutError):
   """The request's deadline/TTL expired before it finished."""
+
+  def __reduce__(self):
+    # explicit args-based reduction: keeps the round-trip honest even if
+    # a structured field is ever added (the QueueFull lesson — a custom
+    # __init__ without this surfaces as TypeError across the boundary)
+    return (type(self), tuple(self.args))
 
 
 class RequestCancelled(RuntimeError):
   """The client cancelled the request (``ServingEngine.cancel``)."""
 
+  def __reduce__(self):
+    return (type(self), tuple(self.args))
+
 
 class PoisonedRequest(RuntimeError):
   """Failed instead of replayed: the request was in flight across N
   consecutive engine crashes (the crash-loop breaker)."""
+
+  def __reduce__(self):
+    return (type(self), tuple(self.args))
 
 
 class QueueClosed(RuntimeError):

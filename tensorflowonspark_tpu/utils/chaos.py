@@ -48,6 +48,17 @@ Env vars (all optional; absent ⇒ every hook is a no-op):
     can name, so ``"prefill@13#1:raise,prefill@13#2:raise"`` makes every
     length-13 prompt a deterministic poison request while its neighbors
     sail through (docs/ROBUSTNESS.md).
+
+``TOS_CHAOS_FLEET`` = ``"point[@replica][#nth]:kill"`` or
+    ``"point[@replica][#nth]:stall:seconds"`` (comma-separated)
+    Replica-granularity fault at a named :func:`fleet_fault` point
+    (``serving.fleet`` arms ``dispatch`` with the replica id as index):
+    ``kill`` tells the caller to terminally kill that REPLICA the nth
+    time the point fires — e.g. ``"dispatch@1#3:kill"`` kills replica 1
+    at its 3rd dispatch, with everything it already accepted mid-decode
+    (exercising ejection + cross-replica failover replay); ``stall``
+    sleeps at the dispatch (a slow router hop). Without ``@replica``
+    the nth count is global across all dispatches.
 """
 
 import logging
@@ -65,6 +76,7 @@ ENV_STALL = "TOS_CHAOS_STALL"
 ENV_RV_DROP = "TOS_CHAOS_RV_DROP"
 ENV_RV_DELAY = "TOS_CHAOS_RV_DELAY"
 ENV_SERVE = "TOS_CHAOS_SERVE"
+ENV_FLEET = "TOS_CHAOS_FLEET"
 
 
 class InjectedFault(RuntimeError):
@@ -77,7 +89,8 @@ _stalled = set()
 _rv_counts = {}
 _lock = threading.Lock()
 
-_KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY, ENV_SERVE)
+_KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY, ENV_SERVE,
+              ENV_FLEET)
 _ENV_PREFIX = "TOS_CHAOS_"
 #: cache of the last validated env signature (validation is consulted from
 #: hot paths like the rendezvous client's per-request chaos check)
@@ -150,6 +163,14 @@ def check_config() -> None:
                        "'point[@index][#nth]:raise' or "
                        "'point[@index][#nth]:stall:seconds')"
                        % (ENV_SERVE, spec))
+  for spec in _split_specs(os.environ.get(ENV_FLEET)):
+    try:
+      _parse_fleet_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed fleet spec %r (want "
+                       "'point[@replica][#nth]:kill' or "
+                       "'point[@replica][#nth]:stall:seconds')"
+                       % (ENV_FLEET, spec))
   _validated = sig
 
 
@@ -221,15 +242,16 @@ def _parse_delay_spec(spec: str):
           int(parts[2]) if len(parts) == 3 else None)
 
 
-def _parse_serve_spec(spec: str):
-  """``"point[@index][#nth]:raise"`` / ``"...:stall:seconds"`` →
-  ((name, index, nth), action, seconds_or_None)."""
+def _parse_action_spec(spec: str, hard_action: str):
+  """``"point[@index][#nth]:<hard_action>"`` / ``"...:stall:seconds"`` →
+  ((name, index, nth), action, seconds_or_None). The shared grammar
+  behind the serve (``raise``) and fleet (``kill``) knobs."""
   parts = spec.split(":")
   if len(parts) < 2 or not parts[0]:
     raise ValueError(spec)
   target = _parse_point_spec(parts[0])
   action = parts[1]
-  if action == "raise":
+  if action == hard_action:
     if len(parts) != 2:
       raise ValueError(spec)
     return target, action, None
@@ -238,6 +260,16 @@ def _parse_serve_spec(spec: str):
       raise ValueError(spec)
     return target, action, float(parts[2])
   raise ValueError(spec)
+
+
+def _parse_serve_spec(spec: str):
+  """``"point[@index][#nth]:raise"`` / ``"...:stall:seconds"``."""
+  return _parse_action_spec(spec, "raise")
+
+
+def _parse_fleet_spec(spec: str):
+  """``"point[@replica][#nth]:kill"`` / ``"...:stall:seconds"``."""
+  return _parse_action_spec(spec, "kill")
 
 
 def _sentinel_path(name: str, index) -> str:
@@ -347,6 +379,51 @@ def serve_fault(name: str, index: Optional[int] = None) -> None:
     raise InjectedFault(
         "chaos: injected fault at serving point %r (occurrence %d)"
         % (name, nth))
+
+
+def fleet_fault(name: str, index: Optional[int] = None) -> Optional[str]:
+  """Deterministic fleet-plane fault site (``serving.fleet`` arms
+  ``dispatch`` with the target replica id as ``index``): returns
+  ``"kill"`` when a ``TOS_CHAOS_FLEET`` kill spec matches this
+  invocation — the CALLER then terminally kills that replica (the fault
+  target is a replica, not the calling thread, so this hook signals
+  instead of raising). Stall specs sleep inline (a slow dispatch hop)
+  and return None, as does a disarmed/unmatched consult.
+
+  Counters mirror :func:`serve_fault`: a GLOBAL per-point count (specs
+  without ``@replica``: "the nth dispatch overall") and a per-index one
+  (specs with it: "the nth dispatch routed to THIS replica").
+  """
+  _first_consult()
+  spec_env = os.environ.get(ENV_FLEET)
+  if not spec_env:
+    return None
+  check_config()
+  point = "fleet." + name
+  with _lock:
+    gcount = _counts[(point, None)] = _counts.get((point, None), 0) + 1
+    icount = gcount
+    if index is not None:
+      icount = _counts[(point, index)] = \
+          _counts.get((point, index), 0) + 1
+  for spec in _split_specs(spec_env):
+    (sname, sindex, nth), action, secs = _parse_fleet_spec(spec)
+    if sname != name:
+      continue
+    if sindex is None:
+      if gcount != nth:
+        continue
+    elif sindex != index or icount != nth:
+      continue
+    if action == "stall":
+      logger.warning("chaos: stalling %.2fs at fleet point %r replica %r "
+                     "(occurrence %d)", secs, name, index, nth)
+      time.sleep(secs)
+      continue
+    logger.warning("chaos: kill verdict at fleet point %r replica %r "
+                   "(occurrence %d)", name, index, nth)
+    return "kill"
+  return None
 
 
 def message_fault(verb) -> Tuple[bool, float]:

@@ -80,29 +80,33 @@ def test_tos001_ignores_driver_only_code():
 
 
 TOS001_SERVE_BAD = '''
-def make_task_fn(eng):
+def make_task_fn(eng, fleet):
   def _task(it):
     eng.cancel()
     eng.drain()
+    fleet.rolling_swap()
   return _task
 '''
 
 TOS001_SERVE_GOOD = '''
-def make_task_fn(eng, rec):
+def make_task_fn(eng, rec, fleet):
   def _task(it):
     eng.cancel(timeout=5.0)
     eng.drain(timeout=30.0)
+    fleet.rolling_swap(timeout=60.0)
     rec.drain(512)          # nonblocking drain(max_items) idiom: exempt
   return _task
 '''
 
 
 def test_tos001_flags_unbounded_serving_waits():
-  """The serving engine's bounded waits (cancel parks on slot release,
-  drain on in-flight work) need explicit deadlines like wait/join."""
+  """The serving engine/fleet's bounded waits (cancel parks on slot
+  release, drain on in-flight work, rolling_swap on each replica's
+  drain) need explicit deadlines like wait/join."""
   result = analyze_snippet(TOS001_SERVE_BAD)
   tos1 = [f for f in result["findings"] if f.rule == "TOS001"]
-  assert {f.detail for f in tos1} == {"serve.cancel", "serve.drain"}
+  assert {f.detail for f in tos1} == {"serve.cancel", "serve.drain",
+                                      "serve.rolling_swap"}
   assert not [f for f in analyze_snippet(TOS001_SERVE_GOOD)["findings"]
               if f.rule == "TOS001"]
 
@@ -520,6 +524,8 @@ class TestChaosConfigValidation:
     monkeypatch.setenv(chaos.ENV_SERVE,
                        "decode#3:raise,prefill@13#2:raise,"
                        "decode#1:stall:0.5")
+    monkeypatch.setenv(chaos.ENV_FLEET,
+                       "dispatch@1#2:kill,dispatch#1:stall:0.5")
     chaos.reset()
     assert chaos.enabled()
     chaos.check_config()   # must not raise
@@ -545,6 +551,10 @@ class TestChaosConfigValidation:
       (chaos.ENV_SERVE, "decode#1:stall:x"),   # non-float seconds
       (chaos.ENV_SERVE, "decode#1:raise:2"),   # raise takes no operand
       (chaos.ENV_SERVE, "prefill@x#1:raise"),  # non-int index
+      (chaos.ENV_FLEET, "dispatch#1"),         # missing action
+      (chaos.ENV_FLEET, "dispatch#1:raise"),   # serve action, not fleet
+      (chaos.ENV_FLEET, "dispatch#1:kill:2"),  # kill takes no operand
+      (chaos.ENV_FLEET, "dispatch@x#1:kill"),  # non-int replica
   ])
   def test_malformed_specs_rejected(self, monkeypatch, env, value):
     monkeypatch.setenv(env, value)

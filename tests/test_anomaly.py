@@ -351,6 +351,94 @@ class TestKvPagesExhaustedDetector:
     assert det.poll(now=10.0) == []
 
 
+class TestFleetDetectors:
+  def test_degraded_fires_below_full_strength(self):
+    """A ServingFleet running fewer active replicas than configured =
+    an ejection happened — visible online, not just in the event log."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=3)
+    det.poll(now=0.0)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=2)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["fleet_degraded"]
+    assert alerts[0]["evidence"]["replicas_active"] == 2
+    assert alerts[0]["evidence"]["replicas_total"] == 3
+
+  def test_full_strength_stays_quiet(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=3,
+             fleet__queue_depth=0, fleet__occupancy=0.4)
+    det.poll(now=0.0)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=3,
+             fleet__queue_depth=0, fleet__occupancy=0.4)
+    assert det.poll(now=10.0) == []
+
+  def test_saturated_fires_scale_up_signal(self):
+    """At FULL strength with every replica goodput-bound (the
+    serving_saturated thresholds applied to the fleet aggregate), the
+    detector says scale up — add a replica."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)            # queue_sat default 8, per replica
+    sink.set(0, fleet__replicas_total=2, fleet__replicas_active=2,
+             fleet__queue_depth=16, fleet__occupancy=0.95)
+    det.poll(now=0.0)
+    sink.set(0, fleet__replicas_total=2, fleet__replicas_active=2,
+             fleet__queue_depth=20, fleet__occupancy=0.97)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["fleet_saturated"]
+    assert "add a replica" in alerts[0]["message"]
+
+  def test_rolling_swap_in_progress_stays_quiet(self):
+    """A DRAINING replica is a healthy operator-initiated swap, not
+    lost capacity — firing fleet_degraded on every rolling swap would
+    train operators to ignore the real ejection signal (and mid-swap
+    saturation readings are suppressed too)."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=2,
+             fleet__replicas_draining=1, fleet__queue_depth=99,
+             fleet__occupancy=1.0)
+    det.poll(now=0.0)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=2,
+             fleet__replicas_draining=1, fleet__queue_depth=99,
+             fleet__occupancy=1.0)
+    assert det.poll(now=10.0) == []
+
+  def test_saturated_below_per_replica_queue_stays_quiet(self):
+    """Just below the aggregate bound: queue_sat × active − 1."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, fleet__replicas_total=2, fleet__replicas_active=2,
+             fleet__queue_depth=15, fleet__occupancy=0.97)
+    det.poll(now=0.0)
+    sink.set(0, fleet__replicas_total=2, fleet__replicas_active=2,
+             fleet__queue_depth=15, fleet__occupancy=0.97)
+    assert det.poll(now=10.0) == []
+
+  def test_no_fleet_executor_is_exempt(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__queue_depth=9)
+    det.poll(now=0.0)
+    sink.set(0, serve__queue_depth=9)
+    assert det.poll(now=10.0) == []
+
+  def test_degraded_wins_over_saturated(self):
+    """A degraded fleet that is ALSO saturated reports degraded — the
+    remedy (restore the ejected replica) subsumes the scale-up advice."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=2,
+             fleet__queue_depth=99, fleet__occupancy=1.0)
+    det.poll(now=0.0)
+    sink.set(0, fleet__replicas_total=3, fleet__replicas_active=2,
+             fleet__queue_depth=99, fleet__occupancy=1.0)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["fleet_degraded"]
+
+
 class TestMemorySlopeDetector:
   def test_fires_on_monotonic_creep(self):
     sink = FakeSink(eids=(0,))

@@ -50,12 +50,13 @@ def _kill_victim(spec, cwd):
 class TestChaosPrimitives:
   def test_disarmed_points_are_noops(self, monkeypatch):
     for var in (chaos.ENV_KILL, chaos.ENV_STALL, chaos.ENV_RV_DROP,
-                chaos.ENV_RV_DELAY, chaos.ENV_SERVE):
+                chaos.ENV_RV_DELAY, chaos.ENV_SERVE, chaos.ENV_FLEET):
       monkeypatch.delenv(var, raising=False)
     chaos.kill_point("anything", index=3)      # must not kill us
     assert chaos.stall_point("anything") == 0.0
     assert chaos.message_fault("BEAT") == (False, 0.0)
     chaos.serve_fault("decode")                # must not raise
+    assert chaos.fleet_fault("dispatch", index=0) is None
 
   def test_serve_fault_raises_on_nth_global_occurrence(self, monkeypatch):
     monkeypatch.setenv(chaos.ENV_SERVE, "decode#3:raise")
@@ -88,6 +89,26 @@ class TestChaosPrimitives:
     t0 = time.monotonic()
     chaos.serve_fault("decode")                # 2nd: stalls, returns
     assert time.monotonic() - t0 >= 0.2
+
+  def test_fleet_fault_kill_verdict_per_replica(self, monkeypatch):
+    """@replica specs count per replica: the kill verdict lands on
+    exactly the named replica's nth dispatch, and is RETURNED (the
+    fault target is the replica, not the calling thread)."""
+    monkeypatch.setenv(chaos.ENV_FLEET, "dispatch@1#2:kill")
+    assert chaos.fleet_fault("dispatch", index=0) is None
+    assert chaos.fleet_fault("dispatch", index=1) is None   # @1 count 1
+    assert chaos.fleet_fault("dispatch", index=0) is None
+    assert chaos.fleet_fault("dispatch", index=1) == "kill"  # @1 count 2
+    assert chaos.fleet_fault("dispatch", index=1) is None   # budget spent
+
+  def test_fleet_fault_global_count_and_stall(self, monkeypatch):
+    monkeypatch.setenv(chaos.ENV_FLEET,
+                       "dispatch#3:kill,dispatch#1:stall:0.2")
+    t0 = time.monotonic()
+    assert chaos.fleet_fault("dispatch", index=0) is None   # stalls
+    assert time.monotonic() - t0 >= 0.2
+    assert chaos.fleet_fault("dispatch", index=1) is None
+    assert chaos.fleet_fault("dispatch", index=0) == "kill"  # 3rd overall
 
   def test_kill_point_sigkills_on_nth_invocation(self, monkeypatch, tmp_path):
     """A kill spec 'p@idx#n' SIGKILLs the calling process on invocation n

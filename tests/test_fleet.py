@@ -1,0 +1,449 @@
+"""ServingFleet tests: the driver-side replica router (serving/fleet.py).
+
+The load-bearing claim is the one the engine suite pins per engine,
+lifted across replicas: whatever the router does — load-aware dispatch,
+overload retries, replica ejection + failover replay, rolling swaps —
+every request's output must equal its own single-request
+``greedy_generate_kv`` decode, and ``stream()`` consumers must see each
+position exactly once across the replica hop. Replica death is driven
+deterministically via ``TOS_CHAOS_FLEET`` (``make fleet-chaos``).
+"""
+
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import transformer as tfm
+from tensorflowonspark_tpu.serving import (
+    DeadlineExceeded, RequestCancelled, ServingEngine, ServingFleet,
+    ServingOverloaded)
+from tensorflowonspark_tpu.serving import fleet as fleet_mod
+from tensorflowonspark_tpu.utils import chaos
+
+EOS = 7
+PAD = 0
+
+
+def _tiny(max_seq_len=48, **kw):
+  return tfm.TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                               d_model=32, d_ff=64,
+                               max_seq_len=max_seq_len, remat=False,
+                               dtype=jnp.float32, **kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+  cfg = _tiny()
+  return cfg, tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+
+
+def _reference(params, cfg, prompt, budget, eos_id=EOS):
+  """Single-request decode truncated at its stop — the parity oracle."""
+  out = np.asarray(tfm.greedy_generate_kv(
+      params, cfg, jnp.asarray(prompt)[None], budget, eos_id=eos_id,
+      pad_id=PAD))[0]
+  gen = out[len(prompt):]
+  stops = np.where(gen == eos_id)[0]
+  stop = (int(stops[0]) + 1) if len(stops) else budget
+  return np.concatenate([prompt, gen[:stop]])
+
+
+def _factory(tiny_state, **kw):
+  cfg, state = tiny_state
+  kw.setdefault("num_slots", 2)
+  kw.setdefault("horizon", 2)
+  return lambda: ServingEngine(state.params, cfg, eos_id=EOS, pad_id=PAD,
+                               **kw)
+
+
+def _workload(seed, n=8, plens=(3, 5, 7), budgets=(4, 8)):
+  rng = np.random.RandomState(seed)
+  return [(rng.randint(1, 64, (int(rng.choice(plens)),)).astype(np.int32),
+           int(rng.choice(budgets))) for _ in range(n)]
+
+
+class TestFleetRouting:
+  def test_mixed_workload_parity_across_replicas(self, tiny_state):
+    """Requests spread over replicas and every output is bit-identical
+    to its single-request decode — replicas are interchangeable."""
+    cfg, state = tiny_state
+    with ServingFleet(_factory(tiny_state), num_replicas=2) as fl:
+      work = _workload(3, n=10)
+      frids = [fl.submit(p, max_new_tokens=b) for p, b in work]
+      outs = [fl.result(fr, timeout=120) for fr in frids]
+      stats = dict(fl.stats)
+      # both replicas took traffic (10 requests over 2×2 slots must
+      # overflow one replica's backlog score)
+      dispatches = [r.dispatches for r in fl._replicas.values()]
+    for (p, b), out in zip(work, outs):
+      np.testing.assert_array_equal(
+          out, _reference(state.params, cfg, p, b))
+    assert stats["completed"] == 10 and stats["shed"] == 0
+    assert all(d > 0 for d in dispatches)
+
+  def test_dispatch_prefers_less_loaded_replica(self, tiny_state):
+    """Load-aware routing: with one replica's queue pre-loaded, a new
+    request goes to the idle one (backlog-clear-time score)."""
+    with ServingFleet(_factory(tiny_state), num_replicas=2) as fl:
+      reps = fl._dispatch_order()
+      busy = reps[0]
+      # park backlog on one replica's queue directly (below the router)
+      for p, b in _workload(5, n=6, budgets=(16,)):
+        busy.engine.submit(p, max_new_tokens=b)
+      idle = [r for r in fl._replicas.values() if r is not busy][0]
+      order = fl._dispatch_order()
+      assert order[0] is idle
+      frid = fl.submit(np.asarray([1, 2, 3], np.int32), max_new_tokens=4)
+      assert fl.request(frid).cur_replica == idle.rid
+      fl.result(frid, timeout=120)
+
+  def test_generate_and_stream_roundtrip(self, tiny_state):
+    cfg, state = tiny_state
+    with ServingFleet(_factory(tiny_state), num_replicas=2) as fl:
+      p = np.asarray([2, 9, 4], np.int32)
+      frid = fl.submit(p, max_new_tokens=6)
+      handle = fl.request(frid)
+      toks = list(fl.stream(frid, timeout=120))
+      ref = _reference(state.params, cfg, p, 6)
+      assert toks == [int(t) for t in ref[len(p):]]
+      # the consumer records the verdict itself — it must not race the
+      # monitor sweep: done set, output recorded, completion counted
+      assert handle.done.is_set() and handle.error is None
+      np.testing.assert_array_equal(handle.output, ref)
+      assert fl.stats["completed"] == 1
+      outs = fl.generate([p, p[:2]], max_new_tokens=5, timeout=120)
+      np.testing.assert_array_equal(
+          outs[0], _reference(state.params, cfg, p, 5))
+
+  def test_env_knobs_register_and_apply(self, tiny_state, monkeypatch):
+    monkeypatch.setenv(fleet_mod.ENV_FLEET_REPLICAS, "3")
+    monkeypatch.setenv(fleet_mod.ENV_FLEET_MAX_FAILOVERS, "7")
+    monkeypatch.setenv(fleet_mod.ENV_FLEET_PROBE_FAILS, "5")
+    monkeypatch.setenv(fleet_mod.ENV_FLEET_ADMIT_TIMEOUT, "11.5")
+    monkeypatch.setenv(fleet_mod.ENV_FLEET_POLL, "0.02")
+    fl = ServingFleet(_factory(tiny_state))
+    assert fl.num_replicas == 3
+    assert fl.max_failovers == 7
+    assert fl.probe_fails == 5
+    assert fl.admit_timeout == 11.5
+    assert fl._poll == 0.02
+    # explicit arguments beat the env knobs (the num_slots rule)
+    fl2 = ServingFleet(_factory(tiny_state), num_replicas=1,
+                       max_failovers=2)
+    assert fl2.num_replicas == 1 and fl2.max_failovers == 2
+
+
+class TestFleetAdmission:
+  def test_retry_then_admit_when_backlog_clears(self, tiny_state):
+    """All replicas overloaded → submit retries with backoff (honoring
+    retry_after) inside the admission window and lands once capacity
+    frees — the client sees one slow submit, not a rejection."""
+    cfg, state = tiny_state
+    fac = _factory(tiny_state, num_slots=1, max_queue=1)
+    with ServingFleet(fac, num_replicas=2, admit_timeout=60.0) as fl:
+      work = _workload(11, n=8, budgets=(6,))
+      frids = [fl.submit(p, max_new_tokens=b) for p, b in work]
+      outs = [fl.result(fr, timeout=120) for fr in frids]
+      stats = dict(fl.stats)
+    assert stats["retries"] >= 1          # at least one submit waited
+    assert stats["shed"] == 0
+    for (p, b), out in zip(work, outs):
+      np.testing.assert_array_equal(
+          out, _reference(state.params, cfg, p, b))
+
+  def test_admit_deadline_bounds_retries(self, tiny_state):
+    """When the backlog can't clear inside the fleet admission window,
+    submit re-raises a structured fleet-level ServingOverloaded with a
+    retry_after hint instead of blocking forever."""
+    fac = _factory(tiny_state, num_slots=1, max_queue=1)
+    fl = ServingFleet(fac, num_replicas=2, admit_timeout=0.3)
+    # engines never started: queues accept one request each, then
+    # every replica rejects and nothing ever drains
+    for rep in fl._replicas.values():
+      rep.engine.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+    t0 = time.monotonic()
+    with pytest.raises(ServingOverloaded) as ei:
+      fl.submit(np.asarray([5, 6], np.int32), max_new_tokens=4)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.retry_after is not None
+    assert fl.stats["rejected"] == 1
+
+  def test_ttl_bounds_retries_below_admit_timeout(self, tiny_state):
+    """A request's own TTL is the retry bound when tighter than the
+    fleet window — retries never outlive the request, and TTL expiry
+    mid-retry surfaces as the structured DeadlineExceeded verdict (the
+    request died of old age, not of backpressure)."""
+    fac = _factory(tiny_state, num_slots=1, max_queue=1)
+    fl = ServingFleet(fac, num_replicas=1, admit_timeout=60.0)
+    fl._replicas[0].engine.submit(np.asarray([1, 2], np.int32),
+                                  max_new_tokens=4)
+    t0 = time.monotonic()
+    with pytest.raises((DeadlineExceeded, ServingOverloaded)):
+      fl.submit(np.asarray([3], np.int32), max_new_tokens=4, ttl=0.3)
+    assert time.monotonic() - t0 < 5.0
+
+  def test_drain_closes_admission_and_finishes_work(self, tiny_state):
+    cfg, state = tiny_state
+    fl = ServingFleet(_factory(tiny_state), num_replicas=2).start()
+    work = _workload(13, n=6)
+    frids = [fl.submit(p, max_new_tokens=b) for p, b in work]
+    handles = [fl.request(fr) for fr in frids]
+    assert fl.drain(timeout=120)
+    for (p, b), h in zip(work, handles):
+      assert h.error is None
+      np.testing.assert_array_equal(
+          h.output, _reference(state.params, cfg, p, b))
+    with pytest.raises(ServingOverloaded) as ei:
+      fl.submit(np.asarray([1], np.int32), max_new_tokens=2)
+    assert ei.value.draining
+    # a usable hint, never None (the engine draining-rejection rule)
+    assert ei.value.retry_after is not None and ei.value.retry_after > 0
+
+  def test_cancel_inflight_and_pending(self, tiny_state):
+    with ServingFleet(_factory(tiny_state), num_replicas=1) as fl:
+      frid = fl.submit(np.asarray([4, 2, 5], np.int32),
+                       max_new_tokens=32)
+      assert fl.cancel(frid, timeout=60)
+      with pytest.raises(RequestCancelled):
+        fl.result(frid, timeout=10)
+
+  def test_dead_on_arrival_deadline(self, tiny_state):
+    with ServingFleet(_factory(tiny_state), num_replicas=1) as fl:
+      with pytest.raises(DeadlineExceeded):
+        fl.submit(np.asarray([1, 2], np.int32), max_new_tokens=4,
+                  deadline=time.monotonic() - 1.0)
+
+
+class TestFleetHealth:
+  def test_probe_failures_eject_and_fail_over(self, tiny_state):
+    """A replica that stops answering its health probe (the HEALTH-wire
+    analogue) is ejected after ``probe_fails`` consecutive misses and
+    its accepted work replays on a live replica, bit-identical."""
+    cfg, state = tiny_state
+    sick = {"rid": None}
+
+    def probe(rep):
+      return rep.rid != sick["rid"]
+
+    with ServingFleet(_factory(tiny_state), num_replicas=2,
+                      probe_fails=2, poll_interval=0.01,
+                      health_probe=probe) as fl:
+      work = _workload(17, n=6, budgets=(32,))
+      frids = [fl.submit(p, max_new_tokens=b) for p, b in work]
+      victim = fl.request(frids[0]).cur_replica
+      sick["rid"] = victim
+      outs = [fl.result(fr, timeout=120) for fr in frids]
+      stats = dict(fl.stats)
+      states = fl.replica_states()
+      events = [e["event"] for e in fl.events]
+    assert states[victim] == fleet_mod.EJECTED
+    assert stats["ejections"] == 1 and stats["shed"] == 0
+    assert stats["failovers"] >= 1
+    assert "eject" in events and "failover" in events
+    for (p, b), out in zip(work, outs):
+      np.testing.assert_array_equal(
+          out, _reference(state.params, cfg, p, b))
+
+  def test_all_replicas_dead_fails_fast(self, tiny_state):
+    fl = ServingFleet(_factory(tiny_state), num_replicas=1,
+                      poll_interval=0.02).start()
+    frid = fl.submit(np.asarray([3, 1], np.int32), max_new_tokens=32)
+    fl._kill_replica(fl._replicas[0], RuntimeError("boom"))
+    with pytest.raises(RuntimeError):
+      fl.result(frid, timeout=30)
+    assert not fl.alive
+    # ejection released the dead engine's device state: kill/_die leave
+    # the slab allocated, so _eject must stop() even a dead engine or a
+    # degraded fleet pins one slab's HBM per ejection
+    assert fl._replicas[0].engine._slabs is None
+    with pytest.raises(RuntimeError):
+      fl.submit(np.asarray([1], np.int32), max_new_tokens=2)
+    fl.stop()
+
+  def test_failover_budget_sheds_after_max(self, tiny_state):
+    """A request that loses more than max_failovers replicas is failed
+    (the fleet-level poison analogue), visibly — shed counts, waiter
+    gets the root cause chain."""
+    fl = ServingFleet(_factory(tiny_state), num_replicas=1,
+                      max_failovers=0, poll_interval=0.02).start()
+    frid = fl.submit(np.asarray([2, 2, 2], np.int32), max_new_tokens=32)
+    fl._kill_replica(fl._replicas[0], RuntimeError("boom"))
+    with pytest.raises(RuntimeError):
+      fl.result(frid, timeout=30)
+    assert fl.stats["shed"] == 1
+    fl.stop()
+
+
+class TestRollingSwap:
+  def test_swap_mid_flight_sheds_nothing(self, tiny_state):
+    """The zero-shed contract fleet-wide: every replica drained and
+    replaced while requests are in flight; every accepted request
+    completes bit-identical; new engines serve follow-up traffic."""
+    cfg, state = tiny_state
+    with ServingFleet(_factory(tiny_state), num_replicas=2) as fl:
+      work = _workload(23, n=8, budgets=(8, 16))
+      frids = [fl.submit(p, max_new_tokens=b) for p, b in work]
+      rep = fl.rolling_swap(timeout=120.0)
+      outs = [fl.result(fr, timeout=120) for fr in frids]
+      assert rep["swapped"] == 2
+      assert all(r["drained"] for r in rep["replicas"])
+      assert fl.stats["swaps"] == 2 and fl.stats["shed"] == 0
+      gens = [r.generation for r in fl._replicas.values()]
+      assert gens == [1, 1]
+      for (p, b), out in zip(work, outs):
+        np.testing.assert_array_equal(
+            out, _reference(state.params, cfg, p, b))
+      # the swapped-in engines take traffic
+      p = np.asarray([9, 9, 1], np.int32)
+      np.testing.assert_array_equal(
+          fl.result(fl.submit(p, max_new_tokens=4), timeout=120),
+          _reference(state.params, cfg, p, 4))
+
+  def test_swap_factory_reparams_the_fleet(self, tiny_state):
+    """rolling_swap(engine_factory=...) swaps every replica to engines
+    built by the NEW factory — the param-swap path — and keeps it for
+    future rebuilds."""
+    cfg, state = tiny_state
+    built = []
+
+    def new_factory():
+      eng = ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS,
+                          pad_id=PAD, horizon=2)
+      built.append(eng)
+      return eng
+
+    with ServingFleet(_factory(tiny_state), num_replicas=2) as fl:
+      fl.rolling_swap(timeout=60.0, engine_factory=new_factory)
+      assert len(built) == 2
+      assert [r.engine for r in fl._replicas.values()] == built
+      assert fl._factory is new_factory
+
+
+class TestFleetChaos:
+  """TOS_CHAOS_FLEET-driven proofs (make fleet-chaos): replica death is
+  injected deterministically at dispatch granularity, never simulated by
+  hand. Chaos counters are per-process — every test resets them."""
+
+  pytestmark = pytest.mark.chaos
+
+  @pytest.fixture(autouse=True)
+  def _fresh_chaos(self, monkeypatch):
+    chaos.reset()
+    yield
+    monkeypatch.delenv(chaos.ENV_FLEET, raising=False)
+    chaos.reset()
+
+  def test_replica_kill_mid_decode_fails_over_bit_identical(
+      self, tiny_state, monkeypatch):
+    """THE acceptance pin: N=3 replicas, one killed mid-flight at a
+    deterministic dispatch while another rolling-swaps — zero accepted
+    requests shed, every completion bit-identical to its reference
+    decode, and the ejection/failover visible as structured events."""
+    cfg, state = tiny_state
+    monkeypatch.setenv(chaos.ENV_FLEET, "dispatch@1#2:kill")
+    with ServingFleet(_factory(tiny_state), num_replicas=3,
+                      poll_interval=0.02) as fl:
+      work = _workload(31, n=9, budgets=(8, 16))
+      frids = [fl.submit(p, max_new_tokens=b) for p, b in work]
+      swap = fl.rolling_swap(timeout=120.0)   # mid-flight, post-kill
+      outs = [fl.result(fr, timeout=120) for fr in frids]
+      stats = dict(fl.stats)
+      states = fl.replica_states()
+      events = list(fl.events)
+    assert states[1] == fleet_mod.EJECTED
+    assert stats["ejections"] == 1
+    assert stats["failovers"] >= 1 and stats["replays"] >= 1
+    assert stats["shed"] == 0
+    assert stats["replay_mismatches"] == 0
+    # the dead replica is skipped, the live ones swap
+    assert swap["swapped"] == 2
+    kinds = [e["event"] for e in events]
+    assert "eject" in kinds and "failover" in kinds \
+        and "swap_done" in kinds
+    eject = next(e for e in events if e["event"] == "eject")
+    assert eject["replica"] == 1 and "InjectedFault" in eject["cause"]
+    for (p, b), out in zip(work, outs):
+      np.testing.assert_array_equal(
+          out, _reference(state.params, cfg, p, b))
+
+  def test_stream_positions_exactly_once_across_replica_hop(
+      self, tiny_state, monkeypatch):
+    """A stream() consumer sees each position exactly once even when the
+    request hops replicas mid-stream: the fleet suppresses (and
+    verifies) the already-delivered prefix of the replayed decode."""
+    cfg, state = tiny_state
+    # replica 0's 2nd dispatch CONSULT: the streamed request below is
+    # its 1st (an empty fleet dispatches in rid order); the consult that
+    # trips the kill is forced mid-stream, with tokens already delivered
+    monkeypatch.setenv(chaos.ENV_FLEET, "dispatch@0#2:kill")
+    fac = _factory(tiny_state, num_slots=1)
+    with ServingFleet(fac, num_replicas=2, poll_interval=0.02) as fl:
+      p = np.asarray([5, 3, 8, 2], np.int32)
+      frid = fl.submit(p, max_new_tokens=24)
+      got, kicked = [], False
+      for tok in fl.stream(frid, timeout=120):
+        got.append(tok)
+        if not kicked and len(got) == 2:
+          kicked = True
+          # occupy replica 1 (the idle one scores first), then force a
+          # round that reaches replica 0 again — both busy, so the tie
+          # breaks to rid 0, whose 2nd consult kills it mid-stream
+          fl.submit(np.asarray([1, 1], np.int32), max_new_tokens=4)
+          fl.submit(np.asarray([2, 2], np.int32), max_new_tokens=4)
+      stats = dict(fl.stats)
+      states = fl.replica_states()
+    ref = _reference(state.params, cfg, p, 24)
+    assert got == [int(t) for t in ref[len(p):]]
+    assert states[0] == fleet_mod.EJECTED
+    assert stats["failovers"] >= 1
+    assert stats["replay_mismatches"] == 0
+
+  def test_stall_spec_delays_dispatch_only(self, tiny_state,
+                                           monkeypatch):
+    cfg, state = tiny_state
+    monkeypatch.setenv(chaos.ENV_FLEET, "dispatch#1:stall:0.2")
+    with ServingFleet(_factory(tiny_state), num_replicas=1) as fl:
+      t0 = time.monotonic()
+      frid = fl.submit(np.asarray([6, 4], np.int32), max_new_tokens=4)
+      assert time.monotonic() - t0 >= 0.2
+      np.testing.assert_array_equal(
+          fl.result(frid, timeout=120),
+          _reference(state.params, cfg, np.asarray([6, 4], np.int32), 4))
+
+  def test_malformed_fleet_spec_raises(self, monkeypatch):
+    monkeypatch.setenv(chaos.ENV_FLEET, "dispatch@1#2:raise")
+    with pytest.raises(ValueError, match="fleet spec"):
+      chaos.check_config()
+
+
+class TestFleetExceptionPickle:
+  """The four structured serving exceptions must round-trip pickle with
+  their fields intact (manager proxies / any process boundary a fleet
+  crosses) — the feedhub.QueueFull bug class, pinned per exception."""
+
+  def test_serving_overloaded_roundtrip(self):
+    e = ServingOverloaded("queue full", queue_depth=7, queued_tokens=123,
+                          retry_after=1.5, draining=True)
+    e2 = pickle.loads(pickle.dumps(e))
+    assert type(e2) is ServingOverloaded
+    assert str(e2) == "queue full"
+    assert e2.queue_depth == 7 and e2.queued_tokens == 123
+    assert e2.retry_after == 1.5 and e2.draining is True
+
+  def test_deadline_exceeded_roundtrip(self):
+    e = pickle.loads(pickle.dumps(DeadlineExceeded("too late")))
+    assert type(e) is DeadlineExceeded and str(e) == "too late"
+
+  def test_request_cancelled_roundtrip(self):
+    e = pickle.loads(pickle.dumps(RequestCancelled("gone")))
+    assert type(e) is RequestCancelled and str(e) == "gone"
+
+  def test_poisoned_request_roundtrip(self):
+    from tensorflowonspark_tpu.serving import PoisonedRequest
+    e = pickle.loads(pickle.dumps(PoisonedRequest("bad req")))
+    assert type(e) is PoisonedRequest and str(e) == "bad req"

@@ -365,6 +365,46 @@ class TestAdmissionControl:
       eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
     eng.stop()
 
+  def test_cold_start_retry_after_is_bounded_default(self, tiny_state):
+    """Before the first decode completes the tokens/s EMA is 0 — the
+    retry_after hint must be the bounded cold-start default, never a
+    retry-immediately value that has clients hammering an engine still
+    compiling its first dispatch."""
+    from tensorflowonspark_tpu.serving import engine as engine_mod
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, max_queue=1,
+                        max_queued_tokens=0)      # not started: cold EMA
+    assert eng.tokens_per_sec == 0.0
+    eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+    with pytest.raises(ServingOverloaded) as ei:
+      eng.submit(np.asarray([3, 4], np.int32), max_new_tokens=4)
+    assert ei.value.retry_after >= engine_mod._COLD_RETRY_AFTER
+    assert ei.value.retry_after <= 60.0
+    eng.stop()
+
+  def test_draining_rejection_carries_retry_after(self, tiny_state):
+    """The drain-time turn-away is a retryable condition too (another
+    replica will serve it) — it must carry a usable hint, not None."""
+    cfg, state = tiny_state
+    with ServingEngine(state.params, cfg, num_slots=1) as eng:
+      eng._draining = True
+      with pytest.raises(ServingOverloaded) as ei:
+        eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+      assert ei.value.draining
+      assert ei.value.retry_after is not None
+      assert ei.value.retry_after > 0
+
+  def test_load_telemetry_properties(self, tiny_state):
+    """The fleet router's dispatch inputs: queue depth / token mass /
+    occupancy_now reflect the backlog without the obs plane on."""
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=2)   # not started
+    assert (eng.queue_depth, eng.queued_tokens) == (0, 0)
+    assert eng.slots_in_use == 0 and eng.occupancy_now == 0.0
+    eng.submit(np.asarray([1, 2, 3], np.int32), max_new_tokens=5)
+    assert eng.queue_depth == 1 and eng.queued_tokens == 8
+    eng.stop()
+
   def test_env_knobs_register_and_apply(self, tiny_state, monkeypatch):
     cfg, state = tiny_state
     monkeypatch.setenv("TOS_SERVE_MAX_QUEUE", "3")
@@ -535,6 +575,26 @@ class TestFailFast:
                                   _reference(state.params, cfg, p, 3))
     eng.stop()
     eng.stop()
+
+
+  def test_kill_seam_fails_waiters_fast_with_cause(self, tiny_state):
+    """The terminal-death injection seam (the fleet's chaos kill): the
+    engine dies AS IF restarts were exhausted — alive flips, waiters get
+    the cause in ms, submit fails fast."""
+    cfg, state = tiny_state
+    # not started: the queued request cannot win a race with the kill
+    eng = ServingEngine(state.params, cfg, num_slots=1)
+    rid = eng.submit(np.asarray([1, 2], np.int32), max_new_tokens=32)
+    cause = chaos.InjectedFault("killed by test")
+    eng.kill(cause)
+    assert not eng.alive
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError) as ei:
+      eng.result(rid, timeout=30)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.__cause__ is cause
+    with pytest.raises(RuntimeError):
+      eng.submit(np.asarray([3], np.int32), max_new_tokens=2)
 
 
 class TestPagePool:

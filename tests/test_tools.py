@@ -181,6 +181,39 @@ class TestServeBenchChaosSmoke:
     assert rec["events"] >= 1 and rec["median"] is not None
 
 
+class TestServeBenchFleetSmoke:
+  def test_fleet_smoke_zero_shed_swap_with_bit_parity(self):
+    """`serve_bench --fleet --smoke` drives the REAL ServingFleet: N
+    replicas behind the router serving the seeded workload with a FULL
+    rolling param swap fired mid-run. Tier-1 re-proves on every CI run
+    that the swap sheds zero accepted requests, that every replica
+    actually swapped, and that fleet outputs stay bit-identical to
+    single-request decodes with zero cross-replica replay mismatches."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "serve_bench.py"),
+         "--fleet", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "serving_fleet_vs_single_tokens_per_sec"
+    assert result["parity_ok"] is True
+    assert result["zero_shed"] is True
+    assert result["fleet"]["swaps"] == result["workload"]["replicas"]
+    assert result["fleet"]["shed"] == 0
+    assert result["fleet"]["swap_drained_all"] is True
+    assert result["fleet"]["replay_mismatches"] == 0
+    assert result["single"]["tok_s"] > 0 and result["fleet"]["tok_s"] > 0
+    assert result["fleet"]["p99_s"] >= result["fleet"]["p50_s"]
+
+
 class TestObsReportSmoke:
   def test_smoke_merges_aligned_trace_from_cluster_run(self, tmp_path):
     """`obs_report --smoke` drives a REAL 2-process LocalEngine

@@ -135,12 +135,13 @@ def check_tos001(model: RepoModel, fn: FuncInfo) -> Iterator[Finding]:
                     ".wait() without timeout= blocks forever if the event "
                     "is never set / the process never exits")
       continue
-    if name in ("cancel", "drain") and not node.args \
+    if name in ("cancel", "drain", "rolling_swap") and not node.args \
         and "timeout" not in kws:
-      # serving.ServingEngine's bounded waits: cancel parks until the
-      # slot is actually released, drain until accepted work finishes —
-      # ServingEngine REQUIRES the timeout (wait_alert house style), and
-      # this keeps future call sites on other engines honest. Zero-arg
+      # serving.ServingEngine/ServingFleet's bounded waits: cancel parks
+      # until the slot is actually released, drain until accepted work
+      # finishes, rolling_swap on each replica's drain in turn — the
+      # engines REQUIRE the timeout (wait_alert house style), and this
+      # keeps future call sites on other engines honest. Zero-arg
       # only, like wait/join: positional-arg calls are the nonblocking
       # drain(max_items)/cancel(rid, t) idioms. Known residual: a
       # zero-arg nonblocking .cancel() (threading.Timer) in

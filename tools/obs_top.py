@@ -142,6 +142,13 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "prefills": m.get("serve.prefills"),
         "spec_accepted": m.get("serve.spec_accepted"),
         "spec_rejected": m.get("serve.spec_rejected"),
+        # fleet router telemetry (serving.fleet, docs/ROBUSTNESS.md):
+        # replica strength + the ejection/failover/swap counters
+        "fleet_replicas_active": m.get("fleet.replicas_active"),
+        "fleet_replicas_total": m.get("fleet.replicas_total"),
+        "fleet_failovers": m.get("fleet.failovers"),
+        "fleet_ejections": m.get("fleet.ejections"),
+        "fleet_swaps": m.get("fleet.swaps"),
         "mem_in_use": m.get("device.bytes_in_use"),
         "mem_peak": m.get("device.peak_bytes"),
         "compiles": m.get("xla.compiles"),
@@ -197,6 +204,15 @@ def render(snap, clear=True):
       # the decode-speed stack's health at a glance: page headroom,
       # prefix-cache hit rate, draft acceptance
       feed += "  kv[" + " ".join(kv) + "]"
+    if row.get("fleet_replicas_total"):
+      # replica strength at a glance (N/M < full = running degraded),
+      # plus whichever recovery counters have moved
+      fl = ["%d/%d act" % (row.get("fleet_replicas_active") or 0,
+                           row["fleet_replicas_total"])]
+      fl.extend("%s %d" % (lbl, row[key]) for lbl, key in
+                (("ej", "fleet_ejections"), ("fo", "fleet_failovers"),
+                 ("swap", "fleet_swaps")) if row.get(key))
+      feed += "  fleet[" + " ".join(fl) + "]"
     lines.append(
         "%-4s %-9s %8s %8s %6s %6s %9s %8s %7s %7s%s" % (
             eid, row["state"] or "?",

@@ -28,6 +28,15 @@ baseline at the HBM budget's slot count, then one engine per added stage
 decode) — so every stage's bit-parity and contribution are gated
 independently; `slots_at_equal_hbm` carries the capacity comparison.
 
+`--fleet` measures the REPLICA ROUTER (`serving.ServingFleet`,
+docs/ROBUSTNESS.md §Fleet): the same seeded Zipf workload through one
+engine vs N same-shape replicas behind the fleet's load-aware dispatch,
+with a FULL rolling param swap fired mid-run (swap-in engines
+pre-warmed, the canary pattern). Reports fleet vs single goodput and
+p50/p99 latency and GATES the fleet claims: zero accepted requests shed
+through the swap, every output bit-identical to its single-request
+decode, zero cross-replica replay mismatches.
+
 `--chaos` measures the engine's SELF-HEALING cost (docs/ROBUSTNESS.md):
 the same workload runs paired — one clean pass, one with deterministic
 `TOS_CHAOS_SERVE` faults injected into the decode dispatch — through
@@ -40,6 +49,7 @@ BIT-IDENTICAL to its single-request decode (greedy replay parity).
 Usage: python tools/serve_bench.py [--batch 8] [--prompt 128] [--steps 128]
        python tools/serve_bench.py --compare [--smoke] [--json-out f.json]
        python tools/serve_bench.py --chaos [--smoke] [--json-out f.json]
+       python tools/serve_bench.py --fleet [--smoke] [--json-out f.json]
 """
 
 import argparse
@@ -521,6 +531,199 @@ def run_prefix(args):
   return 0 if parity_ok else 3
 
 
+# --- fleet mode: replica router vs single engine (--fleet) ------------------
+
+#: fleet-mode shapes (full, smoke): the single-engine leg serves the
+#: workload on ``slots`` slots; the fleet leg runs ``replicas`` engines
+#: of the SAME slot count behind the ServingFleet router with a rolling
+#: param swap fired mid-run — the claim under test is the ROUTER's
+#: (load-aware dispatch + zero-shed swap), not raw decode speed
+_FLEET_FULL = dict(layers=2, heads=4, d_model=128, d_ff=256, vocab=512,
+                   requests=48, slots=4, replicas=3,
+                   plens=(4, 8, 12, 16), budgets=(8, 16, 32, 64),
+                   max_seq=96, horizon=8)
+_FLEET_SMOKE = dict(layers=2, heads=2, d_model=32, d_ff=64, vocab=64,
+                    requests=10, slots=2, replicas=2, plens=(4, 6, 8),
+                    budgets=(4, 8), max_seq=24, horizon=4)
+
+
+def _warm_engine(eng, workload):
+  """Warm one engine's jit caches (one request per distinct prompt
+  length covers the prefill bucket decompositions; any request warms the
+  fused step) — the canary pattern: a swap-in replica is warmed BEFORE
+  it takes traffic, so the timed pass measures the drain/handoff, not
+  XLA compiles."""
+  seen, probe = set(), []
+  for p, b in workload:
+    if len(p) not in seen:
+      seen.add(len(p))
+      probe.append((p, b))
+  eng.start()
+  eng.generate([p for p, _ in probe],
+               max_new_tokens=max(b for _, b in probe), timeout=600)
+
+
+def run_fleet_pass(fleet, workload, swap_factory=None, swap_timeout=600.0):
+  """One fleet pass; optionally fires a rolling swap mid-run (requests
+  are in flight when the first replica starts draining). Returns
+  (wall_s, latencies, outputs, stats delta, swap report)."""
+  snap = fleet.stats_snapshot()
+  t0 = time.perf_counter()
+  frids = [fleet.submit(p, max_new_tokens=b) for p, b in workload]
+  reqs = [fleet.request(fr) for fr in frids]
+  swap = None
+  if swap_factory is not None:
+    swap = fleet.rolling_swap(timeout=swap_timeout,
+                              engine_factory=swap_factory)
+  outs = [fleet.result(fr, timeout=600) for fr in frids]
+  wall = time.perf_counter() - t0
+  return wall, [r.latency for r in reqs], outs, snap.delta(), swap
+
+
+def measure_fleet(params, cfg, workload, shape, eos_id, useful, reps):
+  """Paired single-engine vs fleet reps (median-by-speedup reported).
+  Every rep's fleet pass includes a full rolling swap to PRE-WARMED
+  replacement engines; parity, zero-shed and swap completion are gated
+  per rep."""
+  import numpy as np
+  from tensorflowonspark_tpu.serving import ServingEngine, ServingFleet
+
+  slots, replicas = shape["slots"], shape["replicas"]
+  total_useful = float(sum(len(s) for s in useful))
+
+  def factory():
+    return ServingEngine(params, cfg, num_slots=slots, eos_id=eos_id,
+                         pad_id=0, horizon=shape["horizon"])
+
+  single = factory().start()
+  fleet = ServingFleet(factory, num_replicas=replicas).start()
+  rows = []
+  spares = []
+  try:
+    run_continuous_pass(single, workload)          # warm the single leg
+    run_fleet_pass(fleet, workload)                # warm every replica
+    for _ in range(reps):
+      spares = [factory() for _ in range(replicas)]
+      for eng in spares:
+        _warm_engine(eng, workload)
+      s_wall, s_lat, s_outs, _ = run_continuous_pass(single, workload)
+      f_wall, f_lat, f_outs, delta, swap = run_fleet_pass(
+          fleet, workload, swap_factory=lambda: spares.pop(0))
+      mismatches = sum(
+          1 for (prompt, _), out, ref in zip(workload, f_outs, useful)
+          if not np.array_equal(out, np.concatenate([prompt, ref])))
+      rows.append({
+          "single": {
+              "tok_s": round(total_useful / s_wall, 2),
+              "wall_s": round(s_wall, 3),
+              "p50_s": round(float(np.percentile(s_lat, 50)), 3),
+              "p99_s": round(float(np.percentile(s_lat, 99)), 3),
+          },
+          "fleet": {
+              "tok_s": round(total_useful / f_wall, 2),
+              "wall_s": round(f_wall, 3),
+              "p50_s": round(float(np.percentile(f_lat, 50)), 3),
+              "p99_s": round(float(np.percentile(f_lat, 99)), 3),
+              "dispatched": int(delta.get("dispatched", 0)),
+              "retries": int(delta.get("retries", 0)),
+              "failovers": int(delta.get("failovers", 0)),
+              "shed": int(delta.get("shed", 0)),
+              "swaps": int(delta.get("swaps", 0)),
+              "replay_mismatches":
+                  int(delta.get("replay_mismatches", 0)),
+              "swap_drained_all": bool(
+                  swap and all(r.get("drained")
+                               for r in swap["replicas"]
+                               if "drained" in r)),
+              "parity_mismatches": mismatches,
+          },
+          "speedup": round((total_useful / f_wall)
+                           / max(1e-9, total_useful / s_wall), 2),
+      })
+  finally:
+    single.stop()
+    fleet.stop()
+    for eng in spares:
+      eng.stop()
+  rows.sort(key=lambda r: r["speedup"])
+  return rows[len(rows) // 2], rows
+
+
+def run_fleet(args):
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+
+  shape = _FLEET_SMOKE if args.smoke else _FLEET_FULL
+  if args.requests:
+    shape = dict(shape, requests=args.requests)
+  if args.slots:
+    shape = dict(shape, slots=args.slots)
+  if args.replicas:
+    shape = dict(shape, replicas=args.replicas)
+  cfg = tfm.TransformerConfig(
+      vocab_size=shape["vocab"], num_layers=shape["layers"],
+      num_heads=shape["heads"], d_model=shape["d_model"],
+      d_ff=shape["d_ff"], max_seq_len=shape["max_seq"], remat=False,
+      dtype=jnp.float32)   # f32: the bit-parity check must be exact
+  state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+  eos_id = 2
+  workload = make_workload(shape, args.seed)
+  useful = _reference_streams(state.params, cfg, workload, eos_id)
+  reps = args.reps if args.reps else (1 if args.smoke else 2)
+  median, rows = measure_fleet(state.params, cfg, workload, shape,
+                               eos_id, useful, reps)
+  zero_shed = all(r["fleet"]["shed"] == 0 and
+                  r["fleet"]["swaps"] == shape["replicas"]
+                  for r in rows)
+  parity_ok = all(r["fleet"]["parity_mismatches"] == 0 and
+                  r["fleet"]["replay_mismatches"] == 0 for r in rows)
+  result = {
+      "metric": "serving_fleet_vs_single_tokens_per_sec",
+      "mode": "smoke" if args.smoke else "full",
+      "seed": args.seed, "reps": reps,
+      "workload": {"requests": shape["requests"], "slots": shape["slots"],
+                   "replicas": shape["replicas"],
+                   "useful_tokens": int(sum(len(s) for s in useful))},
+      "model": {k: shape[k] for k in ("layers", "heads", "d_model",
+                                      "d_ff", "vocab", "max_seq")},
+      "single": median["single"],
+      "fleet": median["fleet"],
+      "speedup": median["speedup"],
+      "per_rep_speedups": [r["speedup"] for r in rows],
+      "zero_shed": zero_shed,
+      "parity_ok": parity_ok,
+      "note": "same seeded Zipf workload through one engine vs a "
+              "ServingFleet of N same-shape replicas with a FULL "
+              "rolling param swap fired mid-run (every replica drained "
+              "and replaced while requests were in flight; swap-in "
+              "engines pre-warmed — the canary pattern — so the pass "
+              "prices the drain/handoff, not XLA compiles). "
+              "zero_shed requires every accepted request to complete "
+              "and all replicas to swap; parity_ok requires every "
+              "fleet output bit-identical to its single-request "
+              "decode with zero cross-replica replay mismatches. "
+              "On a 2-vCPU box the replicas' loop threads contend for "
+              "the same cores, so the speedup understates what "
+              "N-executor deployment delivers — the gated claims are "
+              "parity and zero-shed, not the ratio",
+  }
+  line = json.dumps(result)
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    bench_history.append_record(
+        "serve_bench_fleet", result["fleet"]["tok_s"],
+        "%s-r%d-s%d-n%d-seed%d" % (result["mode"], shape["requests"],
+                                   shape["slots"], shape["replicas"],
+                                   args.seed),
+        extra={"speedup": result["speedup"],
+               "zero_shed": zero_shed})
+  print(line)
+  return 0 if (parity_ok and zero_shed) else 3
+
+
 # --- chaos mode: goodput + recovery latency under injected faults -----------
 
 #: deterministic fault schedules for --chaos (TOS_CHAOS_SERVE grammar,
@@ -768,6 +971,12 @@ def main():
                        "Zipf fan-out) through the staged decode-speed "
                        "stack: baseline vs paged KV (equal HBM, more "
                        "slots) vs +prefix cache vs +speculative decode")
+  ap.add_argument("--fleet", action="store_true",
+                  help="ServingFleet of N replicas vs one engine on the "
+                       "seeded Zipf workload, with a mid-run rolling "
+                       "param swap (parity + zero-shed gated)")
+  ap.add_argument("--replicas", type=int, default=0,
+                  help="--fleet replica count override")
   ap.add_argument("--chaos-spec", default=None,
                   help="--chaos: override the injected TOS_CHAOS_SERVE "
                        "fault schedule")
@@ -790,12 +999,15 @@ def main():
     sys.exit(run_chaos(args))
   if args.prefix_workload:
     sys.exit(run_prefix(args))
+  if args.fleet:
+    sys.exit(run_fleet(args))
   if args.smoke:
     # the per-config modes take their MODEL shape from bench.py, which
     # is fixed at import by TOS_BENCH_SMOKE — a flag can't shrink it
     # retroactively, so refuse a misleading half-smoke
-    sys.exit("--smoke shrinks --compare/--chaos/--prefix-workload; for "
-             "the per-config decode modes set TOS_BENCH_SMOKE=1 instead")
+    sys.exit("--smoke shrinks --compare/--chaos/--prefix-workload/"
+             "--fleet; for the per-config decode modes set "
+             "TOS_BENCH_SMOKE=1 instead")
   if os.environ.get("TOS_BENCH_SMOKE"):
     args.batch, args.prompt, args.steps = 2, 16, 16
   wanted = (set(c.strip() for c in args.configs.split(",") if c.strip())
