@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test lint analyze check native bench serve-bench train-bench \
 	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
 	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
-	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke
+	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke \
+	feed-bench-graph feed-bench-graph-smoke
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -46,6 +47,24 @@ obs-top-smoke:
 bench-check:
 	$(PY) tools/bench_history.py --check
 
+# paired fixed-depth prefetcher (DataFeed + _FetchPipeline + inline
+# maps) vs the autotuned datapipe graph on the skewed hot-stage-rotating
+# workload, both feeding the fused train loop at unroll=8; gates:
+# bit-identical loss trajectories across sides (deterministic mode, the
+# autotuner live), zero fetch-dominant stall windows on the graph side,
+# and >=1.2x median delivered rows/s; writes the committed artifact + a
+# feed_bench_graph history line
+feed-bench-graph:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/feed_bench.py --graph --steps 240 --batch 64 \
+	  --chunk 256 --graph-heavy 120 --graph-light 4 \
+	  --json-out bench_artifacts/feed_bench_graph.json
+
+# datapipe graph plumbing check: tiny paired run, bit-parity gated
+feed-bench-graph-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/feed_bench.py --graph --smoke
+
 # paired per-step vs fused train-loop comparison at the dispatch-
 # dominated harness shape; writes the committed artifact + history line
 train-bench:
@@ -60,10 +79,11 @@ train-bench-smoke:
 
 # fast pre-commit gate: static analysis + style + the fast test subset +
 # the obs plumbing smokes + the train-loop fusion smoke + the serving
-# fleet (replica-kill chaos suite + router/zero-shed-swap bench smoke)
+# fleet (replica-kill chaos suite + router/zero-shed-swap bench smoke) +
+# the datapipe graph smoke (bit-parity through the autotuned executor)
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
 check: analyze obs-smoke obs-top-smoke train-bench-smoke fleet-chaos \
-	serve-bench-fleet-smoke
+	serve-bench-fleet-smoke feed-bench-graph-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 

@@ -16,7 +16,10 @@ catalogue every pass:
 ``feed_stall``      the consumer spent more than ``TOS_OBS_FEED_STALL_FRAC``
                     of the window blocked in the feed plane, with per-stage
                     attribution (fetch vs decode vs assemble — the tf.data
-                    paper's input-bound diagnosis)
+                    paper's input-bound diagnosis; under a ``data.datapipe``
+                    graph the dominant GRAPH stage is named instead,
+                    ``pipe:<stage>``, so the alert points at the starved
+                    transform)
 ``recompile_storm`` ``xla.compiles`` still advancing after the executor's
                     ``TOS_OBS_COMPILE_WARMUP`` grace (a jit seam keying on
                     data-dependent shapes; obs.device is the source)
@@ -127,6 +130,11 @@ MIN_WINDOW_STEPS = 5
 #: memory slope needs at least this many samples across the window
 MIN_MEM_SAMPLES = 3
 
+#: the datapipe executor's per-stage busy gauges: ``feed.stage.<name>.busy_s``
+#: (dynamic stage names — sampled by prefix, not by the fixed list below)
+_PIPE_PREFIX = "feed.stage."
+_PIPE_SUFFIX = ".busy_s"
+
 #: the cumulative/gauge metric names one detector pass reads per executor
 _SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
             "feed.decode_s", "feed.assemble_s", "xla.compiles",
@@ -232,6 +240,13 @@ class AnomalyDetector(object):
       m = metrics_snapshot.get(name)
       if m is not None and "value" in m:
         vals[name] = float(m["value"])
+    # the datapipe executor's per-stage busy gauges (dynamic names —
+    # one per declared graph stage) feed the feed_stall detector's
+    # per-graph-stage attribution
+    for name, m in metrics_snapshot.items():
+      if name.startswith(_PIPE_PREFIX) and name.endswith(_PIPE_SUFFIX) \
+          and m is not None and "value" in m:
+        vals[name] = float(m["value"])
     return vals
 
   def _sample(self, now: float) -> None:
@@ -336,6 +351,15 @@ class AnomalyDetector(object):
   def _check_feed_stall(self, eid, dq, span, now) -> List[dict]:
     stages = {s: self._delta(dq, "feed.%s" % s) or 0.0
               for s in ("fetch_s", "decode_s", "assemble_s")}
+    # per-graph-stage attribution: a datapipe executor exports one
+    # ``feed.stage.<name>.busy_s`` per declared stage (the classic
+    # three stay zero in graph mode and vice versa, so the union never
+    # double-counts). The alert then NAMES the starved transform
+    # (``pipe:map0``), not just "fetch".
+    for name in dq[-1][1]:
+      if name.startswith(_PIPE_PREFIX) and name.endswith(_PIPE_SUFFIX):
+        short = name[len(_PIPE_PREFIX):-len(_PIPE_SUFFIX)]
+        stages["pipe:" + short] = self._delta(dq, name) or 0.0
     total = sum(stages.values())
     batches = self._delta(dq, "feed.batches")
     if batches is None:   # no DataFeed on this executor (FILES mode)

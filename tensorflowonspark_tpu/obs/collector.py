@@ -356,8 +356,14 @@ class ObsSink(object):
       "xla.compiles",
       "device.bytes_in_use", "device.peak_bytes", "device.bytes_limit",
       "clock.offset_ms", "clock.rtt_ms", "clock.samples",
+      "feed.autotune_moves",
       "obs.alerts",
   )
+
+  #: dynamic-name metric families the summary also carries: the datapipe
+  #: executor's per-stage gauges (one small set per declared graph stage
+  #: — bounded by the graph, which is operator-declared)
+  TOP_METRIC_PREFIXES = ("feed.stage.",)
 
   def top_summary(self) -> Dict[str, dict]:
     """{executor_id(str): compact per-executor state} for the HEALTH
@@ -371,6 +377,10 @@ class ObsSink(object):
         for name in self.TOP_METRICS:
           m = e["metrics"].get(name)
           if m is not None and "value" in m:
+            vals[name] = m["value"]
+        for name, m in e["metrics"].items():
+          if name.startswith(self.TOP_METRIC_PREFIXES) \
+              and m is not None and "value" in m:
             vals[name] = m["value"]
         out[str(eid)] = {
             "label": e["label"], "pid": e["pid"], "ships": e["ships"],

@@ -229,14 +229,35 @@ class StatsSnapshot(object):
   ``delta()`` reads the live dict again and returns current-minus-base
   for every key present at snapshot time (new keys are ignored: the
   caller asked about the keys it saw).
+
+  NESTED dicts (``GraphExecutor.stats["stages"]`` — the datapipe
+  executor's per-stage counters, each mutated by that stage's worker
+  pool) snapshot and subtract recursively, so multi-stage bench
+  readouts can't race live worker ``+=`` either.
   """
 
   def __init__(self, live: Dict[str, float]):
     self._live = live
-    self._base = dict(live)
+    self._base = self._copy(live)
+
+  @classmethod
+  def _copy(cls, d: Dict) -> Dict:
+    return {k: (cls._copy(v) if isinstance(v, dict) else v)
+            for k, v in d.items()}
+
+  @classmethod
+  def _sub(cls, live: Dict, base: Dict) -> Dict:
+    out = {}
+    for k, v in base.items():
+      cur = live.get(k, v)
+      if isinstance(v, dict):
+        out[k] = cls._sub(cur if isinstance(cur, dict) else v, v)
+      else:
+        out[k] = cur - v
+    return out
 
   def delta(self) -> Dict[str, float]:
-    return {k: self._live.get(k, v) - v for k, v in self._base.items()}
+    return self._sub(self._live, self._base)
 
 
 def snapshot_stats(live: Dict[str, float]) -> StatsSnapshot:

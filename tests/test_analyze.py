@@ -111,6 +111,37 @@ def test_tos001_flags_unbounded_serving_waits():
               if f.rule == "TOS001"]
 
 
+TOS001_PIPE_BAD = '''
+def make_task_fn(stage):
+  def _task(it):
+    got = stage.inbuf.pipe_get()
+    stage.out.pipe_put(got)
+    return got
+  return _task
+'''
+
+TOS001_PIPE_GOOD = '''
+def make_task_fn(stage):
+  def _task(it):
+    got = stage.inbuf.pipe_get(timeout=0.25)
+    stage.out.pipe_put(got, timeout=0.25)
+    return got
+  return _task
+'''
+
+
+def test_tos001_flags_unbounded_pipe_handoffs():
+  """The datapipe executor's stage hand-off verbs (pipe_get/pipe_put on
+  data.datapipe._Buffer) park on an empty/full hand-off buffer — a
+  worker parked without a timeout outlives its stop flag (the
+  slot-deadlock class), so they carry the queue-verb discipline."""
+  result = analyze_snippet(TOS001_PIPE_BAD)
+  tos1 = [f for f in result["findings"] if f.rule == "TOS001"]
+  assert {f.detail for f in tos1} == {"queue.pipe_get", "queue.pipe_put"}
+  assert not [f for f in analyze_snippet(TOS001_PIPE_GOOD)["findings"]
+              if f.rule == "TOS001"]
+
+
 def test_tos001_subprocess_without_timeout():
   src = '''
 import subprocess
@@ -498,6 +529,11 @@ def test_executor_reachability_spans_the_runtime():
       "tensorflowonspark_tpu.datafeed.DataFeed.next_batch",
       "tensorflowonspark_tpu.control.rendezvous.Client._request",
       "tensorflowonspark_tpu.control.feedhub.FeedQueue.put_many",
+      # the datapipe executor (worker pools + autotuner) runs inside
+      # executors under user main fns
+      "tensorflowonspark_tpu.data.datapipe.GraphExecutor._stage_worker",
+      "tensorflowonspark_tpu.data.datapipe._Buffer.pipe_get",
+      "tensorflowonspark_tpu.data.datapipe._Autotuner.pulse",
   ]
   for qual in expected:
     assert qual in reachable, "%s should be executor-reachable" % qual

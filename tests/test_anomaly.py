@@ -197,6 +197,55 @@ class TestFeedStallDetector:
     sink.set(0, train__steps=0)
     assert det.poll(now=10.0) == []
 
+  def test_graph_stage_attribution_names_the_starved_transform(self):
+    """Under a ``data.datapipe`` graph the per-stage busy gauges
+    (``feed.stage.<name>.busy_s``) join the attribution set: the alert
+    must name the dominant GRAPH stage (``pipe:map0``), not just the
+    classic fetch/decode/assemble trio (which stay ~zero in graph
+    mode)."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, feed__batches=5, feed__fetch_s=0.0, feed__decode_s=0.0,
+             feed__assemble_s=0.0, feed__stage__src__busy_s=0.0,
+             feed__stage__map0__busy_s=0.0,
+             feed__stage__assemble__busy_s=0.0)
+    det.poll(now=0.0)
+    sink.set(0, feed__batches=5, feed__fetch_s=0.1, feed__decode_s=0.0,
+             feed__assemble_s=0.0, feed__stage__src__busy_s=0.4,
+             feed__stage__map0__busy_s=8.0,
+             feed__stage__assemble__busy_s=0.2)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["feed_stall"]
+    assert alerts[0]["evidence"]["stage"] == "pipe:map0"
+
+  def test_graph_flowing_batches_stay_quiet_despite_stage_busy(self):
+    """Detector negative: a saturated-but-DELIVERING graph stage accrues
+    busy seconds by design (that is what the autotuner feeds on) — with
+    fresh batches flowing the stall detector must stay quiet."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, feed__batches=5, feed__fetch_s=0.0, feed__decode_s=0.0,
+             feed__assemble_s=0.0, feed__stage__map0__busy_s=0.0)
+    det.poll(now=0.0)
+    sink.set(0, feed__batches=60, feed__fetch_s=0.0, feed__decode_s=0.0,
+             feed__assemble_s=0.0, feed__stage__map0__busy_s=9.5)
+    assert det.poll(now=10.0) == []
+
+  def test_graph_below_fraction_stays_quiet(self):
+    """Detector negative: starved window but the graph stages were NOT
+    the reason (busy fraction under the threshold — consumer-side
+    pause, not an input-bound pipeline)."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, feed__batches=5, feed__fetch_s=0.0, feed__decode_s=0.0,
+             feed__assemble_s=0.0, feed__stage__src__busy_s=0.0,
+             feed__stage__map0__busy_s=0.0)
+    det.poll(now=0.0)
+    sink.set(0, feed__batches=5, feed__fetch_s=0.0, feed__decode_s=0.0,
+             feed__assemble_s=0.0, feed__stage__src__busy_s=2.0,
+             feed__stage__map0__busy_s=3.0)   # 50% < the 60% default
+    assert det.poll(now=10.0) == []
+
 
 class TestWindowGuards:
   def test_sub_minimum_window_never_evaluates(self):

@@ -99,6 +99,10 @@ EXTRA_ROOT_PATTERNS = [
     # (make_serving_predict_fn's cached engine under TFModel.transform):
     # its loop thread + every client wait get the full TOS discipline
     "*.serving.*",
+    # the declarative input-pipeline executor runs inside executors (its
+    # worker pools + autotuner thread drive user main-fn feeds): every
+    # stage hand-off wait gets the full TOS discipline
+    "*.data.datapipe.*",
 ]
 
 

@@ -50,9 +50,12 @@ _LOG_RECEIVERS = {"logger", "logging", "log", "_logger"}
 # demands the same explicit timeout discipline as the feed-queue verbs.
 # wait_alert: the anomaly detector's alert wait (obs.anomaly) — same
 # class: it parks on a condition until a detector pass fires.
+# pipe_get/pipe_put: the datapipe executor's stage hand-off buffers
+# (data.datapipe._Buffer) — a worker parked on a full/empty hand-off
+# without a timeout outlives its stop flag (the slot-deadlock class).
 _BLOCKING_VERB_QUEUE = ("get", "get_many", "put", "put_many",
                         "get_chunk", "put_chunk", "obs_send", "obs_recv",
-                        "wait_alert")
+                        "wait_alert", "pipe_get", "pipe_put")
 _SOCKET_BLOCKING = ("recv", "recv_into", "recvfrom", "accept", "connect")
 _SUBPROCESS_BLOCKING = ("run", "call", "check_call", "check_output",
                         "communicate")

@@ -77,8 +77,10 @@ def _pin_to_core(core: int) -> None:
     pass
 
 
-def _pin_thread_to_core(name: str, core: int) -> None:
-  """Pin a named live thread (e.g. the feed's fetch thread) to a core.
+def _pin_thread_to_core(prefix: str, core: int) -> None:
+  """Pin every live thread whose name starts with ``prefix`` to a core
+  (e.g. the feed's fetch thread, or the graph executor's worker pools,
+  which grow over time — re-call after autotune moves).
 
   The overlap plane's whole point is that hub RPC + decode run on a HOST
   core while the step owns the device; on this CPU harness the "device"
@@ -92,7 +94,7 @@ def _pin_thread_to_core(name: str, core: int) -> None:
     if n <= 1:
       return
     for t in threading.enumerate():
-      if t.name == name and t.native_id:
+      if t.name.startswith(prefix) and t.native_id:
         os.sched_setaffinity(t.native_id, {core % n})
   except (AttributeError, OSError):
     pass
@@ -125,11 +127,18 @@ def feeder_main(addr_str, total_rows, chunk, mode):
   sent = 0
   while sent < total_rows:
     n = min(chunk, total_rows - sent)
-    rows = full if n == chunk else full[:n]
-    if mode == "columnar":
+    if mode == "graph":
+      # the --graph workload: labels are GLOBAL row indices so the
+      # phase-rotating map stages can derive their hot/cold phase from
+      # the data itself (identical per-row work on both sides)
+      rows = [(image, sent + i) for i in range(n)]
       put_rows_chunk(chan, rows, timeout=120)
     else:
-      chan.put_many(rows, block=True, timeout=120)
+      rows = full if n == chunk else full[:n]
+      if mode == "columnar":
+        put_rows_chunk(chan, rows, timeout=120)
+      else:
+        chan.put_many(rows, block=True, timeout=120)
     sent += n
   chan.put(None)   # end-of-feed marker
 
@@ -310,6 +319,441 @@ def compute_only(steps, batch):
   return (steps - 1) / (time.perf_counter() - t0)
 
 
+# --- the --graph mode: fixed-depth prefetcher vs autotuned graph -------------
+#
+# The tf.data question (PAPERS.md, arXiv 2101.12127): does a declarative
+# transform graph with ONLINE autotuning beat the status-quo fixed-depth
+# prefetcher + user-code transforms at keeping the fused train loop fed?
+# Workload: a skewed, HOT-STAGE-ROTATING pipeline — two map stages whose
+# per-row cost flips between heavy and light as the stream advances
+# (phase derived from the row index column, so both sides do IDENTICAL
+# per-row work regardless of chunking). The fixed side is exactly
+# today's shape: DataFeed + `_FetchPipeline` (depth 2) + maps applied
+# inline in the consumer loop between `slab_batches` and the jitted
+# loop. The graph side is `Dataset.from_feed(feed).map(a).map(b)
+# .slab(B, K)` with the autotuner ON and its workers pinned to the host
+# core. Both sides drive the SAME fused train loop (unroll=8) over the
+# SAME feeder stream (mid-stream EndPartition + a short tail, so the
+# skip/split semantics are exercised in the measured run), and the loss
+# trajectories must be BIT-IDENTICAL across the two sides — the
+# deterministic-mode contract, re-verified with the autotuner live.
+
+
+def _make_phase_maps(phase_rows: int, heavy: int, light: int):
+  """Two columnar map stages with OPPOSITE hot phases: map A is heavy
+  while ``(row_index // phase_rows)`` is even, map B while odd — the
+  hot stage rotates through the run. Cost is per ROW (data-derived), so
+  chunk/batch boundaries cannot change the total work."""
+  import numpy as np
+
+  def _work(x, iters):
+    t = x
+    for _ in range(iters):
+      t = np.sqrt(t * t + 1.0)
+    return t
+
+  def _phased(x, y, hot_phase):
+    ph = (y // phase_rows) % 2 == hot_phase
+    out = np.empty_like(x)
+    if ph.any():
+      out[ph] = _work(x[ph], heavy)
+    if (~ph).any():
+      out[~ph] = _work(x[~ph], light)
+    return out, y
+
+  def map_a(x, y):
+    return _phased(x, y, 0)
+
+  def map_b(x, y):
+    return _phased(x, y, 1)
+
+  return map_a, map_b
+
+
+def _graph_problem(unroll: int):
+  """The fused-loop consumer both sides share: an MNIST-class MLP under
+  ``make_train_loop(unroll=K)`` (labels are row indices; the loss
+  reduces them mod 10)."""
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from flax import linen as nn
+  from flax.training import train_state
+  from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+  from tensorflowonspark_tpu.parallel import sharding
+
+  class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+      x = nn.Dense(512)(x)
+      x = nn.relu(x)
+      return nn.Dense(10)(x)
+
+  model = MLP()
+  params0 = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+
+  def fresh_state():
+    params = jax.tree.map(jnp.array, params0)
+    return train_state.TrainState.create(apply_fn=model.apply,
+                                         params=params, tx=optax.sgd(0.01))
+
+  def loss_fn(p, b):
+    logits = model.apply({"params": p}, b["x"])
+    labels = b["y"] % 10
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+  mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=-1),
+                             devices=jax.devices()[:1])
+
+  def make_loop():
+    return sharding.make_train_loop(loss_fn, mesh, unroll=unroll)
+
+  return fresh_state, make_loop
+
+
+class _StallSampler(object):
+  """Window sampler for feed_stall-attributable windows: every
+  ``window`` seconds, snapshot-subtract the live stage seconds and the
+  delivered-row counter; a window with ZERO delivered rows whose stage
+  busy total covers >= ``frac`` of it is a stall, attributed to the
+  dominant stage (the detector's criterion, evaluated bench-side)."""
+
+  def __init__(self, stage_delta_fn, rows_ref, window=1.0, frac=0.6):
+    import threading
+    self._fn = stage_delta_fn       # () -> {stage: busy seconds since last}
+    self._rows = rows_ref
+    self.window = window
+    self.frac = frac
+    self.samples = []
+    self._stop = threading.Event()
+    self._prev_rows = rows_ref[0]
+    self._thread = threading.Thread(target=self._run, daemon=True,
+                                    name="tos-bench-stall-sampler")
+
+  def start(self):
+    self._thread.start()
+    return self
+
+  def stop(self):
+    self._stop.set()
+    self._thread.join(timeout=5.0)
+
+  def _run(self):
+    while not self._stop.wait(self.window):
+      stages = self._fn()
+      delivered = self._rows[0] - self._prev_rows
+      self._prev_rows = self._rows[0]
+      total = sum(stages.values())
+      dominant = max(stages, key=stages.get) if stages else None
+      self.samples.append({
+          "delivered_rows": int(delivered),
+          "dominant": dominant,
+          "busy_frac": round(total / self.window, 3),
+          "stalled": delivered == 0 and total >= self.frac * self.window,
+      })
+
+  def counts(self):
+    stalled = [s for s in self.samples if s["stalled"]]
+    return {
+        "windows": len(self.samples),
+        "stalled": len(stalled),
+        "fetch_dominant": len([s for s in stalled
+                               if s["dominant"] == "fetch"]),
+        "by_stage": {d: len([s for s in stalled if s["dominant"] == d])
+                     for d in {s["dominant"] for s in stalled}},
+    }
+
+
+def _graph_feed(total_rows, chunk, batch):
+  """Start a hub + graph-mode feeder subprocess; returns (hub, proc,
+  feed). The feeder labels rows with global indices and inserts an
+  EndPartition marker mid-stream (skipped in train mode — exercised
+  inside the measured run)."""
+  from tensorflowonspark_tpu.control import feedhub
+  from tensorflowonspark_tpu.datafeed import DataFeed
+
+  hub = feedhub.start(AUTHKEY, ["input", "output", "error", "control"],
+                      mode="remote")
+  try:
+    os.sched_setaffinity(hub._manager._process.pid,
+                         {1 % (os.cpu_count() or 1)})
+  except (AttributeError, OSError):
+    pass
+  proc = subprocess.Popen(
+      [sys.executable, os.path.abspath(__file__), "--feeder",
+       "%s:%d" % hub.addr, str(total_rows), str(chunk), "graph"],
+      env={k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"})
+  feed = DataFeed(hub, train_mode=True,
+                  input_mapping={"c0_image": "x", "c1_label": "y"},
+                  pipeline_depth=0)
+  return hub, proc, feed
+
+
+def _rows_of(item):
+  from tensorflowonspark_tpu.data.readers import Slab
+  if isinstance(item, Slab):
+    leaf = item.data["x"]
+    return int(leaf.shape[0] * leaf.shape[1]) if leaf.ndim > 2 \
+        else int(leaf.shape[0])
+  return len(item["x"])
+
+
+def _drive(items, make_loop, fresh_state, rows_ref, on_item=None):
+  """Consume ``items`` through a fresh fused loop; returns
+  (rows_per_sec over the post-warmup window, loss trajectory)."""
+  import jax
+  import numpy as np
+  loop = make_loop()
+  state = fresh_state()
+  traj = []
+  it = iter(items)
+  first = next(it)
+  state, losses = loop(state, first)             # compile warmup
+  jax.block_until_ready(losses)
+  traj.extend(np.asarray(losses).reshape(-1).tolist())
+  rows_ref[0] += _rows_of(first)
+  if on_item is not None:
+    on_item()
+  t0 = time.perf_counter()
+  timed_rows = 0
+  for item in it:
+    state, losses = loop(state, item)
+    jax.block_until_ready(losses)
+    traj.extend(np.asarray(losses).reshape(-1).tolist())
+    n = _rows_of(item)
+    rows_ref[0] += n
+    timed_rows += n
+    if on_item is not None:
+      on_item()
+  dt = time.perf_counter() - t0
+  return timed_rows / dt, traj
+
+
+def _run_fixed(args, maps, make_loop, fresh_state, total_rows):
+  """The status quo: DataFeed + fixed-depth fetch pipeline + inline
+  maps in the consumer loop, feeding the fused train loop."""
+  from tensorflowonspark_tpu.data.readers import Slab, slab_batches
+  from tensorflowonspark_tpu.datafeed import prefetch_to_device
+
+  map_a, map_b = maps
+  map_s = [0.0]
+  hub, proc, feed = _graph_feed(total_rows, args.chunk, args.batch)
+  # the fixed side DOES use the fetch pipeline (that is the baseline
+  # being challenged: one fixed-depth fetch thread)
+  feed._pipeline_depth = 2
+  rows_ref = [0]
+  sampler_ref = [None]   # set by on_item; the finally stops THIS, so an
+  try:                   # error inside _drive can't leak the thread
+    def items():
+      for item in slab_batches(feed, args.batch, args.unroll):
+        t0 = time.perf_counter()
+        if isinstance(item, Slab):
+          d = item.data
+          x = d["x"].reshape((-1,) + d["x"].shape[2:])
+          y = d["y"].reshape(-1)
+          x, y = map_a(x, y)
+          x, y = map_b(x, y)
+          out = Slab({"x": x.reshape(d["x"].shape),
+                      "y": y.reshape(d["y"].shape)})
+        else:
+          x, y = map_a(item["x"], item["y"])
+          x, y = map_b(x, y)
+          out = {"x": x, "y": y}
+        map_s[0] += time.perf_counter() - t0
+        yield out
+
+    snap = [feed.stats_snapshot(), map_s[0]]
+
+    def stage_delta():
+      d = snap[0].delta()
+      m = map_s[0] - snap[1]
+      snap[0] = feed.stats_snapshot()
+      snap[1] = map_s[0]
+      return {"fetch": d["fetch_s"], "decode": d["decode_s"],
+              "assemble": d["assemble_s"], "map": m}
+
+    started = [False]
+
+    def on_item():
+      _pin_thread_to_core("tos-feed-fetch", 1)
+      if not started[0]:
+        started[0] = True
+        sampler_ref[0] = _StallSampler(stage_delta, rows_ref).start()
+
+    rate, traj = _drive(prefetch_to_device(items(), size=2), make_loop,
+                        fresh_state, rows_ref, on_item=on_item)
+    sampler = sampler_ref[0]
+    if sampler is not None:
+      sampler.stop()
+    stalls = sampler.counts() if sampler is not None else {}
+    return rate, traj, stalls, {"map_s": round(map_s[0], 3)}
+  finally:
+    if sampler_ref[0] is not None:
+      sampler_ref[0].stop()
+    proc.terminate()
+    proc.wait(timeout=10)
+    hub.shutdown()
+
+
+def _run_graph(args, maps, make_loop, fresh_state, total_rows):
+  """The challenger: the declarative graph with the online autotuner,
+  worker pools pinned to the host core."""
+  from tensorflowonspark_tpu.data.datapipe import Dataset
+  from tensorflowonspark_tpu.datafeed import prefetch_to_device
+
+  map_a, map_b = maps
+  hub, proc, feed = _graph_feed(total_rows, args.chunk, args.batch)
+  rows_ref = [0]
+  sampler_ref = [None]   # set by on_item; the finally stops THIS, so an
+  ex = None              # error inside _drive can't leak the thread
+  try:
+    ds = (Dataset.from_feed(feed)
+          .map(map_a, columnar=True)
+          .map(map_b, columnar=True)
+          .slab(args.batch, args.unroll))
+    ex = ds.start(deterministic=True, autotune=True)
+    _pin_thread_to_core("tos-pipe", 1)
+
+    snap = [ex.stats_snapshot()]
+
+    def stage_delta():
+      d = snap[0].delta()["stages"]
+      snap[0] = ex.stats_snapshot()
+      out = {"fetch": d["src"]["fetch_s"], "decode": d["src"]["decode_s"]}
+      for name, sd in d.items():
+        if name != "src":
+          out[name] = sd.get("busy_s", 0.0)
+      return out
+
+    started = [False]
+
+    def on_item():
+      # worker pools grow under autotuning: re-pin them to the host core
+      _pin_thread_to_core("tos-pipe", 1)
+      if not started[0]:
+        started[0] = True
+        sampler_ref[0] = _StallSampler(stage_delta, rows_ref).start()
+
+    rate, traj = _drive(prefetch_to_device(ex.batches(), size=2),
+                        make_loop, fresh_state, rows_ref, on_item=on_item)
+    sampler = sampler_ref[0]
+    if sampler is not None:
+      sampler.stop()
+    stalls = sampler.counts() if sampler is not None else {}
+    summary = ex.stage_summary()
+    tuned = {
+        "moves": ex.stats["autotune_moves"],
+        "events": list(ex.autotune_events)[-8:],
+        "stages": {name: {"workers": d["workers"], "depth": d["depth"],
+                          "busy_s": round(d.get("busy_s",
+                                                d.get("fetch_s", 0.0)), 3)}
+                   for name, d in summary.items()},
+    }
+    return rate, traj, stalls, tuned
+  finally:
+    if sampler_ref[0] is not None:
+      sampler_ref[0].stop()
+    if ex is not None:
+      ex.stop()
+    proc.terminate()
+    proc.wait(timeout=10)
+    hub.shutdown()
+
+
+def graph_main(args):
+  """``--graph``: paired fixed-vs-graph reps on the skewed workload."""
+  _pin_to_core(0)
+  os.environ.setdefault("TOS_DATA_AUTOTUNE_INTERVAL", "0.25")
+  if obs_metrics.enabled():
+    from tensorflowonspark_tpu.obs import device as obs_device
+    obs_device.install_compile_listener()
+
+  # a short tail (3 full batches + a remainder) past the slab-aligned
+  # span: the end-of-feed split path runs inside the measured window
+  tail = 3 * args.batch + max(1, args.batch // 4)
+  total_rows = args.steps * args.batch + tail
+  phase_rows = max(args.batch * args.unroll,
+                   (args.steps * args.batch) // 4)
+  maps = _make_phase_maps(phase_rows, heavy=args.graph_heavy,
+                          light=args.graph_light)
+  fresh_state, make_loop = _graph_problem(args.unroll)
+
+  reps = []
+  parity = True
+  for _ in range(max(1, args.reps)):
+    f_rate, f_traj, f_stalls, f_extra = _run_fixed(
+        args, maps, make_loop, fresh_state, total_rows)
+    g_rate, g_traj, g_stalls, g_tuned = _run_graph(
+        args, maps, make_loop, fresh_state, total_rows)
+    rep_parity = f_traj == g_traj
+    parity = parity and rep_parity
+    reps.append({
+        "fixed_rows_per_sec": round(f_rate, 1),
+        "graph_rows_per_sec": round(g_rate, 1),
+        "speedup": round(g_rate / f_rate, 3) if f_rate else None,
+        "trajectory_bit_identical": rep_parity,
+        "fixed_stall_windows": f_stalls,
+        "graph_stall_windows": g_stalls,
+        "fixed_map_s": f_extra.get("map_s"),
+        "autotune": g_tuned,
+    })
+
+  speedups = [r["speedup"] for r in reps if r["speedup"]]
+  fetch_stalls = sum(r["graph_stall_windows"].get("fetch_dominant", 0)
+                     for r in reps)
+  med = _median(speedups) if speedups else None
+  result = {
+      "metric": "feed_graph_speedup",
+      "speedup_median": round(med, 3) if med else None,
+      "speedup_reps": speedups,
+      "fixed_rows_per_sec": _median([r["fixed_rows_per_sec"]
+                                     for r in reps]),
+      "graph_rows_per_sec": _median([r["graph_rows_per_sec"]
+                                     for r in reps]),
+      "deterministic_parity": parity,
+      "graph_fetch_dominant_stall_windows": fetch_stalls,
+      "reps": reps,
+      "config": {"steps": args.steps, "batch": args.batch,
+                 "unroll": args.unroll, "chunk": args.chunk,
+                 "tail_rows": tail, "phase_rows": phase_rows,
+                 "heavy_iters": args.graph_heavy,
+                 "light_iters": args.graph_light,
+                 "smoke": bool(args.smoke)},
+      "note": "paired reps: fixed = DataFeed + depth-2 _FetchPipeline + "
+              "inline maps; graph = datapipe Dataset (map.map.slab) with "
+              "the online autotuner, workers pinned to the host core. "
+              "Loss trajectories must be bit-identical across sides "
+              "(deterministic-mode contract, autotuner live). "
+              "stall windows use the feed_stall detector criterion "
+              "(zero delivered rows + busy >= 0.6*window), attributed "
+              "to the dominant stage.",
+  }
+  line = json.dumps(result)
+  print(line)
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    if result["graph_rows_per_sec"]:
+      bench_history.append_record(
+          "feed_bench_graph", result["graph_rows_per_sec"],
+          "graph-b%d-u%d-s%d-c%d" % (args.batch, args.unroll, args.steps,
+                                     args.chunk),
+          extra={"speedup": result["speedup_median"],
+                 "obs": int(obs_metrics.enabled())})
+  ok = parity
+  if not args.smoke:
+    ok = ok and (med or 0) >= 1.2 and fetch_stalls == 0
+  if not ok:
+    sys.stderr.write("feed_bench --graph GATES FAILED: parity=%s "
+                     "speedup=%s fetch_stalls=%d\n"
+                     % (parity, med, fetch_stalls))
+    return 1
+  return 0
+
+
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument("--steps", type=int, default=60)
@@ -321,13 +765,28 @@ def main():
                   help="tiny run (CPU CI / plumbing check)")
   ap.add_argument("--compare", action="store_true",
                   help="also measure the legacy row path per transport")
+  ap.add_argument("--graph", action="store_true",
+                  help="paired fixed-depth prefetcher vs autotuned "
+                       "datapipe graph on the skewed hot-stage-rotating "
+                       "workload (fused train loop consumer)")
+  ap.add_argument("--unroll", type=int, default=8,
+                  help="--graph: fused train-loop unroll (slab depth)")
+  ap.add_argument("--graph-heavy", type=int, default=24,
+                  help="--graph: sqrt-iterations for a map's hot phase")
+  ap.add_argument("--graph-light", type=int, default=2,
+                  help="--graph: sqrt-iterations for a map's cold phase")
   ap.add_argument("--json-out", default=None,
                   help="additionally write the JSON result to this path")
   args = ap.parse_args()
   if args.smoke or os.environ.get("TOS_BENCH_SMOKE"):
     # chunk must be < steps*batch or the whole feed is ONE chunk that the
     # warmup batch consumes, zeroing the steady-state stage counters
-    args.steps, args.batch, args.chunk, args.reps = 8, 32, 32, 1
+    if args.graph:
+      args.steps, args.batch, args.chunk, args.reps = 24, 16, 32, 1
+    else:
+      args.steps, args.batch, args.chunk, args.reps = 8, 32, 32, 1
+  if args.graph:
+    sys.exit(graph_main(args))
   _pin_to_core(0)   # before jax's first use so XLA threads inherit it
   if obs_metrics.enabled():
     # the obs-overhead A/B (BENCH_NOTES) must price the device tier too:

@@ -111,6 +111,20 @@ def build_snapshot(reply, prev=None, dt=0.0):
       if r is not None:
         # seconds-per-second inside the stage = fraction of wall time
         stage_rates[s] = r
+    # datapipe graph stages (feed.stage.<name>.busy_s/.workers): busy
+    # fraction per stage + the autotuner's live worker allocation
+    pipe_stages = {}
+    for name in m:
+      if name.startswith("feed.stage.") and name.endswith(".busy_s"):
+        short = name[len("feed.stage."):-len(".busy_s")]
+        ent = {}
+        r = _rate({"metrics": m}, pobs, name, dt)
+        if r is not None:
+          ent["busy_frac"] = r
+        w = m.get("feed.stage.%s.workers" % short)
+        if w is not None:
+          ent["workers"] = w
+        pipe_stages[short] = ent
     # step/token rates come from the retained multi-poll window, not the
     # last pair: fused loops deliver steps in K-bursts (TOS_TRAIN_UNROLL)
     hist = list(prev_series.get(eid, []))
@@ -127,6 +141,9 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "step_rate": _series_rate(series[eid], 0),
         "token_rate": _series_rate(series[eid], 1),
         "feed_stage_frac": stage_rates,
+        # autotuned input-pipeline telemetry (data.datapipe)
+        "pipe_stages": pipe_stages,
+        "autotune_moves": m.get("feed.autotune_moves"),
         "occupancy": m.get("serve.occupancy"),
         "queue_depth": m.get("serve.queue_depth"),
         # serving robustness counters (docs/ROBUSTNESS.md): restarts =
@@ -204,6 +221,22 @@ def render(snap, clear=True):
       # the decode-speed stack's health at a glance: page headroom,
       # prefix-cache hit rate, draft acceptance
       feed += "  kv[" + " ".join(kv) + "]"
+    pipes = row.get("pipe_stages") or {}
+    if pipes:
+      # the autotuned graph at a glance: per-stage busy fraction and
+      # worker allocation, plus the autotuner's cumulative move count
+      parts = []
+      for sname in sorted(pipes):
+        ent = pipes[sname]
+        frac = ent.get("busy_frac")
+        label = "%s %s" % (sname, "%.0f%%" % (100 * frac)
+                           if frac is not None else "-")
+        if (ent.get("workers") or 1) > 1:
+          label += "x%d" % ent["workers"]
+        parts.append(label)
+      if row.get("autotune_moves"):
+        parts.append("mv %d" % row["autotune_moves"])
+      feed += "  pipe[" + " ".join(parts) + "]"
     if row.get("fleet_replicas_total"):
       # replica strength at a glance (N/M < full = running degraded),
       # plus whichever recovery counters have moved
