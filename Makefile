@@ -8,7 +8,7 @@ PY ?= python
 	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
 	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
 	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke \
-	feed-bench-graph feed-bench-graph-smoke
+	feed-bench-graph feed-bench-graph-smoke slo-smoke
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -41,6 +41,16 @@ obs-smoke:
 obs-top-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  $(PY) tools/obs_top.py --smoke
+
+# request-tracing + SLO plumbing check: a 2-process LocalEngine SERVE
+# run (per-executor ServingEngines) with the obs plane + a declared TTFT
+# objective on — asserts linked request traces (queue→prefill→decode on
+# one trace id) in the merged JSONL, SLO status over the HEALTH wire,
+# and a compliant objective table (docs/OBSERVABILITY.md §Request
+# tracing & SLOs)
+slo-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/slo_report.py --smoke
 
 # bench trajectory gate: newest history.jsonl record per series vs the
 # trailing median (tools/bench_history.py; benches append on --json-out)
@@ -82,8 +92,8 @@ train-bench-smoke:
 # fleet (replica-kill chaos suite + router/zero-shed-swap bench smoke) +
 # the datapipe graph smoke (bit-parity through the autotuned executor)
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
-check: analyze obs-smoke obs-top-smoke train-bench-smoke fleet-chaos \
-	serve-bench-fleet-smoke feed-bench-graph-smoke
+check: analyze obs-smoke obs-top-smoke slo-smoke train-bench-smoke \
+	fleet-chaos serve-bench-fleet-smoke feed-bench-graph-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 

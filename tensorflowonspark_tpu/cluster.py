@@ -406,15 +406,27 @@ class TPUCluster(object):
 
   def obs_summary(self) -> dict:
     """The in-process equivalent of the HEALTH verb's obs payload:
-    liveness snapshot + per-executor metric state + live alerts — the
-    driver summary ``tools/obs_top.py`` renders when embedded."""
+    liveness snapshot + per-executor metric state + live alerts + SLO
+    status — the driver summary ``tools/obs_top.py`` renders when
+    embedded."""
     out = {"data": {str(k): v for k, v in
                     self.server.liveness.snapshot().items()}}
     if self.obs_sink is not None:
       out["obs"] = self.obs_sink.top_summary()
     if self.detector is not None:
       out["alerts"] = self.detector.recent_alerts()
+      slo = self.detector.slo_status()
+      if slo is not None:
+        out["slo"] = slo
     return out
+
+  def slo_status(self) -> Optional[dict]:
+    """Live SLO burn-rate verdicts (``obs.slo``; None when the obs
+    plane/detector is off or no objectives are declared) — the
+    driver-side read the train→serve canary phase consumes."""
+    if self.detector is None:
+      return None
+    return self.detector.slo_status()
 
   @staticmethod
   def _span(name: str, **attrs):

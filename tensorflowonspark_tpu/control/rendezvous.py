@@ -580,6 +580,18 @@ class Server(MessageSocket):
         except Exception as e:  # noqa: BLE001 - reply stays alert-free
           self.health_obs_failures += 1
           logger.warning("alert ring for HEALTH failed: %s", e)
+        # the SLO plane's live status (obs.slo via the detector): the
+        # burn-rate verdicts out-of-process monitors and the canary
+        # phase read — best-effort like the rest of the enrichment
+        slo_fn = getattr(alerts, "slo_status", None)
+        if slo_fn is not None:
+          try:
+            slo = slo_fn()
+            if slo is not None:
+              reply["slo"] = slo
+          except Exception as e:  # noqa: BLE001 - reply stays slo-free
+            self.health_obs_failures += 1
+            logger.warning("slo status for HEALTH failed: %s", e)
       self.send(sock, reply)
     elif mtype == "QINFO":
       self.send(sock, {"type": "COUNT",

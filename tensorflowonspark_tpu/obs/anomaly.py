@@ -54,6 +54,12 @@ catalogue every pass:
 ``mem_slope``       ``device.bytes_in_use`` grew monotonically by more than
                     ``TOS_OBS_MEM_SLOPE_PCT`` percent across the window (a
                     leak-shaped creep toward OOM)
+``slo_burn``        an ``obs.slo`` objective (availability / p-quantile
+                    TTFT / e2e) is burning its error budget at/over
+                    ``TOS_SLO_BURN`` on BOTH the fast (``TOS_OBS_WINDOW``)
+                    and slow (``TOS_SLO_SLOW_MULT`` ×) windows — the
+                    service-level verdict the canary phase reads; cluster
+                    scope, so ``executor_id`` is −1
 ==================  =========================================================
 
 Every alert is a plain msgpack/json-safe dict (see :func:`make_alert`)
@@ -81,6 +87,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from tensorflowonspark_tpu.obs import metrics as metrics_mod
+from tensorflowonspark_tpu.obs import slo as slo_mod
 from tensorflowonspark_tpu.obs import spans as spans_mod
 
 logger = logging.getLogger(__name__)
@@ -187,7 +194,8 @@ class AnomalyDetector(object):
   def __init__(self, sink, supervisor=None, jsonl=None,
                interval: Optional[float] = None,
                window: Optional[float] = None,
-               registry=None, recorder=None, time_fn=time.monotonic):
+               registry=None, recorder=None, time_fn=time.monotonic,
+               slo_tracker=None):
     self.sink = sink
     self.supervisor = supervisor
     self.jsonl = jsonl
@@ -218,6 +226,12 @@ class AnomalyDetector(object):
     self._time = time_fn
     self._reg = registry if registry is not None else metrics_mod.active()
     self._rec = recorder if recorder is not None else spans_mod.active()
+    #: the SLO plane (obs.slo): objectives declared via TOS_SLO_* ride
+    #: this loop's cadence — sample + burn-rate evaluate per pass, with
+    #: ``slo_burn`` fanned out exactly like every other alert. Falsy
+    #: (no objectives) = the whole check is one truthiness test.
+    self.slo = slo_tracker if slo_tracker is not None else \
+        slo_mod.SLOTracker(window=self.window)
     # eid -> deque[(t, {name: float})]; capped well past window/interval
     self._samples: Dict[int, deque] = {}
     self._first_seen: Dict[int, float] = {}
@@ -228,6 +242,8 @@ class AnomalyDetector(object):
     self.alerts_total = 0
     self.counts_by_kind: Dict[str, int] = {}
     self.eval_failures = 0
+    # last pass's full per-executor metric snapshots (set by _sample)
+    self._pass_metrics: Dict[int, Dict] = {}
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
 
@@ -250,12 +266,19 @@ class AnomalyDetector(object):
     return vals
 
   def _sample(self, now: float) -> None:
+    # full per-executor snapshots for THIS pass: the scalar extract
+    # below feeds the component detectors, while the SLO check needs
+    # the raw state (quantile sketches aren't scalars) — one fetch
+    # serves both
+    self._pass_metrics = {}
     for eid in list(getattr(self.sink, "executors", {})):
       try:
-        vals = self._extract(self.sink.metrics(eid))
+        snap = self.sink.metrics(eid)
+        vals = self._extract(snap)
       except Exception:  # noqa: BLE001 - a sink hiccup skips one sample
         self.eval_failures += 1
         continue
+      self._pass_metrics[int(eid)] = snap
       dq = self._samples.setdefault(int(eid), deque(maxlen=4096))
       self._first_seen.setdefault(int(eid), now)
       dq.append((now, vals))
@@ -305,6 +328,7 @@ class AnomalyDetector(object):
         new.extend(self._check_kv_pages(eid, dq, span, now))
         new.extend(self._check_fleet(eid, dq, span, now))
         new.extend(self._check_mem_slope(eid, dq, span, now))
+      new.extend(self._check_slo(now))
     except Exception:  # noqa: BLE001 - the detector must outlive any
       # single evaluation bug; failures are counted and visible
       self.eval_failures += 1
@@ -492,6 +516,50 @@ class AnomalyDetector(object):
         "queued request(s) across %d replicas at occupancy %.2f — "
         "scale up: add a replica" % (eid, int(depth), int(active), occ))
 
+  def _check_slo(self, now) -> List[dict]:
+    """Sample + burn-rate-evaluate the declared SLO objectives
+    (``obs.slo``). Latency objectives read the cluster-MERGED quantile
+    sketches straight off the sink's per-executor state (not the
+    ``_SAMPLED`` float path — sketches aren't scalars), availability the
+    summed serve counters; ``slo_burn`` fires per objective (its own
+    cooldown key) at cluster scope, executor_id −1."""
+    if not self.slo:
+      return []
+    self.slo.sample(now, self._pass_metrics)
+    out = []
+    for v in self.slo.evaluate(now):
+      if not v.get("burning"):
+        continue
+      if v["kind"] == "latency":
+        detail = ("%s=%.1fms over the %.0fms bound"
+                  % (v["name"], v["observed"] or 0.0, v["threshold_ms"]))
+      else:
+        detail = "availability %.5f vs target %.5f" % (
+            v["observed"] if v["observed"] is not None else 1.0,
+            v["target"])
+      out.extend(self._fire(
+          "slo_burn", -1, v["window_slow"], now,
+          {"objective": v["name"], "burn_fast": v["burn_fast"],
+           "burn_slow": v["burn_slow"],
+           "bad_frac_fast": v["bad_frac_fast"],
+           "bad_frac_slow": v["bad_frac_slow"],
+           "events_slow": v["events_slow"],
+           "budget": v["budget"], "observed": v["observed"]},
+          "SLO %s burning its error budget at %.1fx (fast) / %.1fx "
+          "(slow) over the %.0fs/%.0fs windows — %s" % (
+              v["name"], v["burn_fast"], v["burn_slow"],
+              v["window_fast"], v["window_slow"], detail),
+          key=("slo_burn", v["name"])))
+    return out
+
+  def slo_status(self) -> Optional[dict]:
+    """The HEALTH-wire SLO payload (None when no objectives are
+    declared) — ``Server`` attaches it to HEALTH replies next to the
+    alert ring, and ``obs_top`` renders the ``slo[...]`` row off it."""
+    if not self.slo:
+      return None
+    return self.slo.status(self._time())
+
   def _check_mem_slope(self, eid, dq, span, now) -> List[dict]:
     series = [(t, v["device.bytes_in_use"]) for t, v in dq
               if "device.bytes_in_use" in v]
@@ -515,8 +583,11 @@ class AnomalyDetector(object):
 
   # -- alert fan-out ---------------------------------------------------------
 
-  def _fire(self, kind, eid, span, now, evidence, message) -> List[dict]:
-    key = (kind, int(eid))
+  def _fire(self, kind, eid, span, now, evidence, message,
+            key=None) -> List[dict]:
+    # default cooldown key is (kind, executor); cluster-scope detectors
+    # (slo_burn) pass their own so two objectives don't share a cooldown
+    key = key if key is not None else (kind, int(eid))
     last = self._last_fired.get(key)
     if last is not None and now - last < self.cooldown:
       return []

@@ -160,15 +160,59 @@ def anchored_window(proc: dict) -> Optional[tuple]:
   return min(starts), max(ends)
 
 
+def _flow_id(trace: str) -> int:
+  """Stable positive int id for a hex trace id (chrome flow ``id``).
+  13 hex chars = 52 bits: trace viewers parse JSON numbers into float64,
+  so ids must stay inside the 2**53 exact-integer range or two distinct
+  traces can collapse onto one arrow chain after rounding."""
+  try:
+    return int(str(trace)[:13], 16) or 1
+  except ValueError:
+    return abs(hash(trace)) % (1 << 52) or 1
+
+
+def _flow_events(spans_by_trace: Dict[str, List[dict]]) -> List[dict]:
+  """Chrome flow events binding each trace's spans into one arrow chain.
+
+  For every trace with >= 2 spans, the time-ordered chain gets a flow
+  start (``ph: "s"``) on the first span, a step (``"t"``) on each
+  middle one and a finish (``"f", bp: "e"``) on the last — all sharing
+  ``id = _flow_id(trace)``, which is what renders the CROSS-PROCESS
+  arrows (fleet dispatch → replica prefill → decode → stream, including
+  a failover hop: both replicas' spans carry the same trace).
+  """
+  out = []
+  for trace, spans in spans_by_trace.items():
+    if len(spans) < 2:
+      continue
+    spans.sort(key=lambda e: e["ts"])
+    fid = _flow_id(trace)
+    for i, ev in enumerate(spans):
+      ph = "s" if i == 0 else ("f" if i == len(spans) - 1 else "t")
+      flow = {"ph": ph, "id": fid, "name": "req", "cat": "trace",
+              "pid": ev["pid"], "tid": ev["tid"],
+              # bind INSIDE the span's duration (chrome rejects flow
+              # points outside their enclosing slice)
+              "ts": ev["ts"] + min(1.0, ev.get("dur", 0.0) / 2.0)}
+      if ph == "f":
+        flow["bp"] = "e"
+      out.append(flow)
+  return out
+
+
 def chrome_trace(procs: List[dict]) -> dict:
   """Perfetto/chrome://tracing JSON from merged proc logs.
 
   One trace "process" per log (pid = the real pid, disambiguated on
   collision), timestamps anchored with each proc's clock offset so every
-  track shares the driver's monotonic timeline.
+  track shares the driver's monotonic timeline. Spans carrying a
+  request ``trace`` id additionally get FLOW events (``ph: s/t/f``)
+  chaining them across tracks/processes — the request waterfall's
+  arrows (``obs_report --request`` renders the same chain as a table).
   """
   events = []
   used_pids = set()
+  spans_by_trace: Dict[str, List[dict]] = {}
   for proc in procs:
     meta = proc.get("meta") or {}
     pid = int(meta.get("pid") or 0)
@@ -198,7 +242,14 @@ def chrome_trace(procs: List[dict]) -> dict:
         ev["ph"] = "X"
         ev["dur"] = rec.get("dur", 0.0) * 1e6
       if rec.get("attrs"):
-        ev["args"] = rec["attrs"]
+        ev["args"] = dict(rec["attrs"])
+      trace = rec.get("trace")
+      if trace is not None:
+        # surfaced in args (clickable in Perfetto) AND collected for
+        # the flow-arrow chain below; instants join args-only
+        ev.setdefault("args", {})["trace"] = trace
+        if ev["ph"] == "X":
+          spans_by_trace.setdefault(str(trace), []).append(ev)
       events.append(ev)
     for rec in proc.get("alerts") or []:
       # detector alerts land as GLOBAL instants: on the trace they mark
@@ -209,6 +260,7 @@ def chrome_trace(procs: List[dict]) -> dict:
                      "cat": "alert",
                      "args": {k: v for k, v in rec.items()
                               if k not in ("kind", "t")}})
+  events.extend(_flow_events(spans_by_trace))
   return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -254,4 +306,17 @@ def prometheus_text(snapshot: Dict[str, dict],
       lines.append("%s_sum%s %s" % (pname, _prom_labels(labels), m["sum"]))
       lines.append("%s_count%s %d" % (pname, _prom_labels(labels),
                                       m["count"]))
+    elif kind == "sketch":
+      # quantile sketches (obs.quantiles) render as a Prometheus
+      # SUMMARY: the canonical quantile set straight off the sketch
+      from tensorflowonspark_tpu.obs import quantiles as _q
+      sk = _q.QuantileSketch.from_dict(m.get("data") or {})
+      lines.append("# TYPE %s summary" % pname)
+      for q in (0.5, 0.9, 0.99):
+        v = sk.quantile(q)
+        if v is not None:
+          lines.append("%s%s %g" % (
+              pname, _prom_labels(labels, 'quantile="%g"' % q), v))
+      lines.append("%s_count%s %d" % (pname, _prom_labels(labels),
+                                      sk.count))
   return "\n".join(lines) + ("\n" if lines else "")

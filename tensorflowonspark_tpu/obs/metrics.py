@@ -31,6 +31,8 @@ import os
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from tensorflowonspark_tpu.obs import quantiles as quantiles_mod
+
 #: master switch for the observability plane (env registry: TOS008).
 #: ``TOS_OBS=1`` activates the per-process registry/tracer and the
 #: executor-side delta shipper; unset/``0`` keeps every hot-path hook on
@@ -110,6 +112,42 @@ class Histogram(object):
             "count": self.count}
 
 
+class Quantiles(object):
+  """Mergeable streaming-quantile metric (``obs.quantiles``): the
+  first-class latency object — TTFT / per-output-token time / e2e /
+  queue wait record here, and the driver merges per-executor sketches
+  into cluster-true percentiles (fixed-bucket histograms can't: their
+  p99 is whichever bucket edge it straddles).
+
+  ``observe`` is the hot path: one sketch ``add`` (list append +
+  occasional compaction), GIL-only like every other metric here.
+  """
+
+  __slots__ = ("name", "sketch")
+
+  def __init__(self, name: str, k: Optional[int] = None):
+    self.name = name
+    self.sketch = quantiles_mod.QuantileSketch(
+        k if k else quantiles_mod.DEFAULT_K)
+
+  def observe(self, v) -> None:
+    self.sketch.add(v)
+
+  @property
+  def count(self) -> int:
+    return self.sketch.count
+
+  def quantile(self, q: float):
+    return self.sketch.quantile(q)
+
+  def snapshot(self) -> dict:
+    # the full sketch IS the snapshot: fixed memory, so shipping it
+    # whole (see snapshot_delta) keeps the wire bounded and makes
+    # retries idempotent (last-write at the sink, merge at read time)
+    return {"type": "sketch", "count": self.sketch.count,
+            "data": self.sketch.to_dict()}
+
+
 class MetricsRegistry(object):
   """Get-or-create metric store; handles are the hot-path objects."""
 
@@ -138,6 +176,9 @@ class MetricsRegistry(object):
                 bounds: Optional[Sequence[float]] = None) -> Histogram:
     return self._get(name, Histogram, bounds)
 
+  def quantiles(self, name: str, k: Optional[int] = None) -> Quantiles:
+    return self._get(name, Quantiles, k)
+
   def names(self) -> List[str]:
     with self._lock:
       return sorted(self._metrics)
@@ -157,9 +198,14 @@ def snapshot_delta(cur: Dict[str, dict],
   """What changed between two :meth:`MetricsRegistry.snapshot` calls.
 
   Counters/histograms subtract (a metric absent from ``prev`` ships its
-  full value); gauges ship their current value when it changed. Metrics
-  with no change are omitted — including settled gauges — so an idle
-  process ships empty deltas and the shipper's keep-the-wire-quiet
+  full value); gauges ship their current value when it changed. Quantile
+  sketches (``"sketch"``) ship their FULL fixed-memory state whenever the
+  observation count moved: a sketch cannot subtract, but it is bounded
+  (~KiB) and last-write idempotent, so re-shipping after a failed ack is
+  harmless and the read plane merges per-executor last-writes
+  (``obs.quantiles.merge_snapshots``) into cluster-true percentiles.
+  Metrics with no change are omitted — including settled gauges — so an
+  idle process ships empty deltas and the shipper's keep-the-wire-quiet
   short-circuit can actually fire.
   """
   out: Dict[str, dict] = {}
@@ -167,13 +213,17 @@ def snapshot_delta(cur: Dict[str, dict],
     old = prev.get(name)
     kind = snap["type"]
     if old is None or old.get("type") != kind:
-      if kind == "histogram" and snap["count"] == 0:
+      if kind in ("histogram", "sketch") and snap["count"] == 0:
         continue
-      if kind != "histogram" and snap["value"] == 0:
+      if kind not in ("histogram", "sketch") and snap["value"] == 0:
         continue
       out[name] = snap
       continue
-    if kind == "histogram":
+    if kind == "sketch":
+      if snap["count"] == old["count"]:
+        continue
+      out[name] = snap
+    elif kind == "histogram":
       if snap["count"] == old["count"]:
         continue
       out[name] = {"type": kind, "bounds": snap["bounds"],
@@ -202,7 +252,12 @@ def apply_delta(total: Dict[str, dict], delta: Dict[str, dict]) -> None:
       total[name] = {k: (list(v) if isinstance(v, list) else v)
                      for k, v in d.items()}
       continue
-    if kind == "histogram":
+    if kind == "sketch":
+      # last-write: the shipped sketch is the executor's full cumulative
+      # state (cross-executor aggregation merges at read time)
+      total[name] = {"type": "sketch", "count": d["count"],
+                     "data": d["data"]}
+    elif kind == "histogram":
       if list(cur["bounds"]) != list(d["bounds"]):
         total[name] = {k: (list(v) if isinstance(v, list) else v)
                        for k, v in d.items()}

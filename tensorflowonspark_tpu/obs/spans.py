@@ -21,6 +21,7 @@ import contextlib
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -29,6 +30,15 @@ from typing import Dict, List, Optional
 ENV_OBS_SPAN_BUFFER = "TOS_OBS_SPAN_BUFFER"
 
 _DEFAULT_CAPACITY = 4096
+
+
+def new_trace_id() -> str:
+  """A fresh request-scoped trace id (16 hex chars, unique across
+  processes). Minted once per logical request at the submit boundary
+  (``ServingFleet.submit`` / ``ServingEngine.submit``) and stamped onto
+  every span the request touches — including across a cross-replica
+  failover hop, which is what keeps one request ONE trace."""
+  return uuid.uuid4().hex[:16]
 
 
 def _coerce(v):
@@ -100,6 +110,12 @@ class SpanRecorder(object):
        "tid": <thread name>, "attrs": {...}}       # span
       {"name": "cluster.stop", "ph": "i", "t0": <monotonic>, ...}  # event
 
+  Request-scoped records additionally carry a TOP-LEVEL ``"trace"`` key
+  (the :func:`new_trace_id` minted at submit): the export plane keys
+  flow events and the ``obs_report --request`` waterfall on it, so it is
+  a record field, not an attr. ``span``/``record_span``/``event`` take
+  it as the ``trace=`` kwarg.
+
   ``add`` never blocks: past ``capacity`` the record is dropped and
   ``dropped`` incremented (the drop counter ships with every OBS delta,
   so lost spans are visible, not silent).
@@ -128,7 +144,7 @@ class SpanRecorder(object):
     self._buf.append(record)
 
   @contextlib.contextmanager
-  def span(self, name: str, **attrs):
+  def span(self, name: str, trace: Optional[str] = None, **attrs):
     t0 = time.monotonic()
     try:
       yield
@@ -136,22 +152,29 @@ class SpanRecorder(object):
       dur = time.monotonic() - t0
       rec = {"name": name, "ph": "X", "t0": t0, "dur": dur,
              "tid": threading.current_thread().name}
+      if trace is not None:
+        rec["trace"] = trace
       if attrs:
         rec["attrs"] = {k: _coerce(v) for k, v in attrs.items()}
       self.add(rec)
 
-  def record_span(self, name: str, t0: float, dur: float, **attrs) -> None:
+  def record_span(self, name: str, t0: float, dur: float,
+                  trace: Optional[str] = None, **attrs) -> None:
     """Record a span from caller-measured timestamps (for seams that
     already hold a ``perf_counter``-free monotonic pair)."""
     rec = {"name": name, "ph": "X", "t0": t0, "dur": dur,
            "tid": threading.current_thread().name}
+    if trace is not None:
+      rec["trace"] = trace
     if attrs:
       rec["attrs"] = {k: _coerce(v) for k, v in attrs.items()}
     self.add(rec)
 
-  def event(self, name: str, **attrs) -> None:
+  def event(self, name: str, trace: Optional[str] = None, **attrs) -> None:
     rec = {"name": name, "ph": "i", "t0": time.monotonic(),
            "tid": threading.current_thread().name}
+    if trace is not None:
+      rec["trace"] = trace
     if attrs:
       rec["attrs"] = {k: _coerce(v) for k, v in attrs.items()}
     self.add(rec)

@@ -141,6 +141,12 @@ ENV_SERVE_SPEC_DEPTH = "TOS_SERVE_SPEC_DEPTH"
 #: self-speculative decode: shallow-exit draft depth in layers
 #: (0 = auto: num_layers // 2)
 ENV_SERVE_SPEC_LAYERS = "TOS_SERVE_SPEC_LAYERS"
+#: request-trace detail spans (``serve.decode.slot`` per lane per
+#: dispatch + ``serve.prefill.chunk`` per bucket chunk): ``0`` keeps
+#: request tracing on (queue/prefill/stream spans, trace ids, ledger)
+#: but drops the high-volume detail records — the knob to reach for if
+#: the span buffer's drop counter moves on a large deployment
+ENV_OBS_TRACE_DETAIL = "TOS_OBS_TRACE_DETAIL"
 
 _DEFAULT_SLOTS = 4
 _DEFAULT_POLL = 0.05
@@ -277,7 +283,8 @@ class ServingEngine(object):
     # gauges (kv_pages_in_use/free) live on the obs registry and the
     # kv_pages_in_use/kv_pages_free properties instead
     self.stats = {"steps": 0, "live_slot_steps": 0, "emitted_tokens": 0,
-                  "prefills": 0, "completed": 0, "rejected": 0,
+                  "prefills": 0, "submitted": 0, "completed": 0,
+                  "rejected": 0,
                   "expired": 0, "cancelled": 0, "replays": 0,
                   "engine_restarts": 0, "poisoned": 0,
                   "replay_mismatches": 0, "prefix_hits": 0,
@@ -286,9 +293,12 @@ class ServingEngine(object):
     # obs seam (docs/OBSERVABILITY.md): cached handles; disabled = one
     # None check per decode dispatch
     self._rec = obs_spans.active()
+    self._trace_detail = os.environ.get(ENV_OBS_TRACE_DETAIL,
+                                        "1") not in ("0",)
     reg = obs_metrics.active()
     self._obs_m = None if reg is None else {
         "tokens": reg.counter("serve.tokens"),
+        "submitted": reg.counter("serve.submitted"),
         "completed": reg.counter("serve.completed"),
         "prefills": reg.counter("serve.prefills"),
         "steps": reg.counter("serve.steps"),
@@ -308,6 +318,16 @@ class ServingEngine(object):
         "kv_pages_in_use": reg.gauge("serve.kv_pages_in_use"),
         "kv_pages_free": reg.gauge("serve.kv_pages_free"),
         "decode_ms": reg.histogram("serve.decode_ms"),
+    }
+    # the SLO plane's latency objects (obs.quantiles): mergeable
+    # streaming sketches — per-executor sketches ship whole over the OBS
+    # verb and the driver MERGES them, so a cluster p99 is a real p99,
+    # not an average of per-process ones (docs/OBSERVABILITY.md)
+    self._obs_q = None if reg is None else {
+        "ttft_ms": reg.quantiles("serve.ttft_ms"),
+        "tpot_ms": reg.quantiles("serve.tpot_ms"),
+        "e2e_ms": reg.quantiles("serve.e2e_ms"),
+        "queue_wait_ms": reg.quantiles("serve.queue_wait_ms"),
     }
 
   def _count(self, key: str, n: int = 1) -> None:
@@ -450,7 +470,8 @@ class ServingEngine(object):
 
   def submit(self, prompt, max_new_tokens: Optional[int] = None,
              deadline: Optional[float] = None,
-             ttl: Optional[float] = None) -> int:
+             ttl: Optional[float] = None,
+             trace_id: Optional[str] = None) -> int:
     """Queue one prompt; returns the request id.
 
     ``deadline`` is an absolute ``time.monotonic()`` bound; ``ttl`` is
@@ -459,6 +480,9 @@ class ServingEngine(object):
     without ever taking a slot; in flight, at the next horizon boundary.
     Raises ``ServingOverloaded`` (structured: queue depth, queued token
     mass, retry-after hint) instead of queueing without bound.
+    ``trace_id`` joins an existing request-scoped trace (the fleet
+    passes the FleetRequest's, so a cross-replica failover hop stays ONE
+    trace); None mints a fresh one on the Request.
     """
     budget = int(max_new_tokens if max_new_tokens is not None
                  else self.default_max_new_tokens)
@@ -471,7 +495,8 @@ class ServingEngine(object):
       ttl = self.default_ttl
     if ttl is not None:
       deadline = now + float(ttl)
-    req = sched.Request(prompt, budget, deadline=deadline)
+    req = sched.Request(prompt, budget, deadline=deadline,
+                        trace_id=trace_id)
     if len(req.prompt) < 1:
       # reject here, not in the loop thread: a chunk_plan(0) crash there
       # would take every other in-flight request down with it
@@ -491,6 +516,11 @@ class ServingEngine(object):
             "the trash page)" % (len(req.prompt), budget, needed,
                                  self.decoder.num_pages - 1,
                                  self.decoder.num_pages))
+    # past validation: this IS traffic — the availability SLO's
+    # denominator (obs.slo: bad = rejected + poisoned over submitted).
+    # Malformed requests (the ValueErrors above) are caller bugs, not
+    # unavailability, and stay out of both sides of the ratio.
+    self._count("submitted")
     if req.expired(now):
       self._count("expired")
       raise sched.DeadlineExceeded(
@@ -640,6 +670,7 @@ class ServingEngine(object):
     once. Fails fast on a dead/never-started engine."""
     req = self._req(rid)
     deadline = time.monotonic() + timeout
+    t0 = time.monotonic()
     emitted = 0
     while True:
       remaining = deadline - time.monotonic()
@@ -654,6 +685,10 @@ class ServingEngine(object):
         break
       emitted += 1
       yield tok
+    if self._rec is not None:
+      # the delivery phase of the waterfall: stream attach → sentinel
+      self._rec.record_span("serve.stream", t0, time.monotonic() - t0,
+                            trace=req.trace_id, rid=rid, tokens=emitted)
     with self._lock:
       self._requests.pop(rid, None)
     err = req.error
@@ -666,12 +701,19 @@ class ServingEngine(object):
 
   def generate(self, prompts: Sequence,
                max_new_tokens: Optional[int] = None,
-               timeout: float = 600.0) -> List[np.ndarray]:
+               timeout: float = 600.0,
+               detailed: bool = False) -> List:
     """Submit a batch of prompts and wait for all outputs (in order).
 
     If a mid-list submit is rejected (overload/validation), the
     already-submitted prefix is cancelled before re-raising — no
-    orphaned work keeps burning slots for a caller that went away."""
+    orphaned work keeps burning slots for a caller that went away.
+
+    ``detailed=True`` returns ``{"tokens": ndarray, "trace_id": str,
+    "timing": dict}`` per prompt instead of the bare array — the
+    per-request timing ledger (``Request.timing``: submitted/admitted/
+    prefill_done/first_token/finished stamps + ttft/e2e/queue_wait/tpot)
+    and the trace id for ``obs_report --request``."""
     rids = []
     try:
       for p in prompts:
@@ -684,8 +726,14 @@ class ServingEngine(object):
     deadline = time.monotonic() + timeout
     outs = []
     for rid in rids:
-      outs.append(self.result(rid, timeout=max(0.001,
-                                               deadline - time.monotonic())))
+      req = self._req(rid)      # hold the handle: result() pops the map
+      out = self.result(rid, timeout=max(0.001,
+                                         deadline - time.monotonic()))
+      if detailed:
+        outs.append({"tokens": out, "trace_id": req.trace_id,
+                     "timing": req.timing()})
+      else:
+        outs.append(out)
     return outs
 
   @property
@@ -715,13 +763,22 @@ class ServingEngine(object):
 
   @property
   def queue_depth(self) -> int:
-    """Queued (not yet admitted) request count."""
-    return len(self._queue)
+    """Queued-or-admitting request count: a request the loop popped but
+    has not finished prefilling into a slot is still BACKLOG — without
+    counting it, a replica mid-prefill reads (queue 0, occupancy 0) and
+    a load-aware router double-books exactly the replica that is busiest
+    admitting (the drain _idle rule, applied to the scoring read)."""
+    adm = self._admitting
+    return len(self._queue) + (1 if adm is not None else 0)
 
   @property
   def queued_tokens(self) -> int:
-    """Queued token mass: sum of prompt+budget over the backlog."""
-    return self._queue.token_mass
+    """Queued-or-admitting token mass: sum of prompt+budget over the
+    backlog (same mid-admission rule as :attr:`queue_depth`)."""
+    adm = self._admitting
+    extra = (len(adm.prompt) + adm.max_new_tokens) if adm is not None \
+        else 0
+    return self._queue.token_mass + extra
 
   @property
   def tokens_per_sec(self) -> float:
@@ -858,6 +915,15 @@ class ServingEngine(object):
       return False
     if replay:
       self._count("replays", len(replay))
+      if self._rec is not None:
+        for req in replay:
+          # the crash-replay suppression window on the request's own
+          # trace: the next len(tokens) emits re-derive delivered
+          # positions (docs/ROBUSTNESS.md); the waterfall shows it as
+          # an instant on the trace, streak-stamped
+          self._rec.event("serve.replay", trace=req.trace_id,
+                          rid=req.rid, suppressed=len(req.tokens),
+                          streak=streak)
     if poisoned:
       # removing the suspected cause IS progress: don't let a healed
       # poison sequence burn the restart budget of a real crash loop
@@ -1039,7 +1105,15 @@ class ServingEngine(object):
         table = pages + [0] * (self.decoder.pages_per_slot - len(pages))
       if req.started_at is None:
         req.started_at = time.monotonic()
-      cm = self._rec.span("serve.prefill", rid=req.rid,
+        if self._rec is not None:
+          # the queue-wait phase of the waterfall: submit → admitted.
+          # Recorded once, at FIRST admission (a crash-replay
+          # re-admission is not a second client-visible queue wait)
+          self._rec.record_span("serve.queue", req.submitted_at,
+                                req.started_at - req.submitted_at,
+                                trace=req.trace_id, rid=req.rid)
+      cm = self._rec.span("serve.prefill", trace=req.trace_id,
+                          rid=req.rid,
                           prompt_len=len(req.prompt), slot=slot,
                           shared_tokens=shared_tokens) \
           if self._rec is not None else contextlib.nullcontext()
@@ -1052,9 +1126,12 @@ class ServingEngine(object):
           row = self.decoder.gather_pages(self._slabs, table,
                                           shared_tokens)
           resume = (row, shared_tokens)
-        row_cache, first = self.decoder.prefill(self.params, req.prompt,
-                                                self.buckets,
-                                                resume=resume)
+        row_cache, first = self.decoder.prefill(
+            self.params, req.prompt, self.buckets, resume=resume,
+            trace=req.trace_id if self._rec is not None
+            and self._trace_detail else None)
+      if req.prefill_done_at is None:   # replays keep the original stamp
+        req.prefill_done_at = time.monotonic()
       self.stats["prefills"] += 1
       if self._obs_m is not None:
         self._obs_m["prefills"].inc()
@@ -1102,6 +1179,20 @@ class ServingEngine(object):
     if self._obs_m is not None:
       self._obs_m["completed"].inc()
     req.finish(None)
+    if self._obs_q is not None:
+      # the request's timing ledger feeds the mergeable latency
+      # sketches — the SLO plane's per-engine TTFT/TPOT/e2e/queue-wait
+      # objects (completed requests only: a rejected request has no
+      # latency, it has an availability verdict)
+      q = self._obs_q
+      if req.ttft is not None:
+        q["ttft_ms"].observe(req.ttft * 1e3)
+      if req.tpot is not None:
+        q["tpot_ms"].observe(req.tpot * 1e3)
+      if req.latency is not None:
+        q["e2e_ms"].observe(req.latency * 1e3)
+      if req.queue_wait is not None:
+        q["queue_wait_ms"].observe(req.queue_wait * 1e3)
 
   def _decode_once(self) -> None:
     """One fused ``horizon``-step dispatch + host-side harvest.
@@ -1118,9 +1209,9 @@ class ServingEngine(object):
         [0 if r is None else r.max_new_tokens - r.generated
          for r in self._slots], np.int32)
     if self.spec_depth > 0:
-      steps = self._decode_spec(active, remaining)
+      steps, lanes = self._decode_spec(active, remaining)
     else:
-      steps = self._decode_plain(active, remaining)
+      steps, lanes = self._decode_plain(active, remaining)
     dt = time.monotonic() - t0
     emitted = self.stats["emitted_tokens"] - tokens_before
     if dt > 0 and emitted:
@@ -1134,6 +1225,17 @@ class ServingEngine(object):
         self._rec.record_span("serve.decode", t0, dt,
                               horizon=self.horizon,
                               active=int(active.sum()))
+        # slot-attributed decode horizons: one child span per lane that
+        # decoded in this dispatch, carrying the request's trace and its
+        # per-lane emitted count (from the harvest of step_many's
+        # [horizon, slots] token matrix) — the decode phase of the
+        # per-request waterfall (obs_report --request). TRACE_DETAIL
+        # gated: the one span family that scales with slots × dispatches
+        if self._trace_detail:
+          for slot, trace, emitted_lane in lanes:
+            self._rec.record_span("serve.decode.slot", t0, dt,
+                                  trace=trace, slot=slot,
+                                  tokens=emitted_lane)
       m = self._obs_m
       if m is not None:
         m["steps"].inc(steps)
@@ -1164,34 +1266,44 @@ class ServingEngine(object):
       freed.append(slot)
     return True
 
-  def _decode_plain(self, active, remaining) -> int:
-    """The non-speculative fused horizon (SlotDecoder.step_many)."""
+  def _decode_plain(self, active, remaining):
+    """The non-speculative fused horizon (SlotDecoder.step_many).
+    Returns ``(steps, lanes)`` — ``lanes`` is the slot-attributed
+    ``(slot, trace_id, emitted)`` list for the per-request decode spans,
+    built only while the recorder is live (zero work otherwise)."""
     self._slabs, toks, _, _ = self.decoder.step_many(
         self.params, self._slabs, self._last, active, remaining,
         self.horizon)
     toks = np.asarray(toks)                       # [horizon, num_slots]
     self.stats["steps"] += self.horizon
+    want_lanes = self._rec is not None and self._trace_detail
+    lanes: List[tuple] = []
     freed: List[int] = []
     for slot in range(self.num_slots):
       req = self._slots[slot]
       if req is None:
         continue
+      emitted = 0
       for j in range(self.horizon):
+        emitted += 1
         if self._harvest(req, int(toks[j, slot]), slot, freed):
           break
       else:
         self._last[slot] = int(toks[self.horizon - 1, slot])
+      if want_lanes:
+        lanes.append((slot, req.trace_id, emitted))
     self._reset_freed(freed)
-    return self.horizon
+    return self.horizon, lanes
 
-  def _decode_spec(self, active, remaining) -> int:
+  def _decode_spec(self, active, remaining):
     """The self-speculative fused dispatch (SlotDecoder.step_spec).
 
     ``counts[r, lane]`` bounds each lane's valid tokens per round (the
     device's accept/EOS/budget verdict); the host still replays the
     stop rule per token (the step_many contract), so the two views
     cannot diverge. Accepted/rejected draft verdicts feed the
-    ``spec_accepted``/``spec_rejected`` counters.
+    ``spec_accepted``/``spec_rejected`` counters. Returns ``(steps,
+    lanes)`` like :meth:`_decode_plain`.
     """
     k, rounds = self.spec_depth, self._spec_rounds
     self._slabs, toks, counts, acc, rej, _, _ = self.decoder.step_spec(
@@ -1203,16 +1315,20 @@ class ServingEngine(object):
     self.stats["steps"] += rounds * k
     self._count("spec_accepted", int(np.asarray(acc).sum()))
     self._count("spec_rejected", int(np.asarray(rej).sum()))
+    want_lanes = self._rec is not None and self._trace_detail
+    lanes: List[tuple] = []
     freed: List[int] = []
     for slot in range(self.num_slots):
       req = self._slots[slot]
       if req is None:
         continue
       done = False
+      emitted = 0
       last_tok = None
       for r in range(rounds):
         for j in range(int(counts[r, slot])):
           last_tok = int(toks[r, j, slot])
+          emitted += 1
           if self._harvest(req, last_tok, slot, freed):
             done = True
             break
@@ -1220,5 +1336,7 @@ class ServingEngine(object):
           break
       if not done and last_tok is not None:
         self._last[slot] = last_tok
+      if want_lanes:
+        lanes.append((slot, req.trace_id, emitted))
     self._reset_freed(freed)
-    return rounds * k
+    return rounds * k, lanes

@@ -146,11 +146,22 @@ class FleetRequest(object):
   (greedy ⇒ bit-identical; disagreement counts ``replay_mismatches``
   instead of being trusted blindly), and ``stream()`` uses its own
   delivered history the same way to keep each position exactly-once
-  across the replica hop."""
+  across the replica hop.
+
+  The fleet mints the request's ``trace_id`` at submit and hands it to
+  EVERY engine attempt (``ServingEngine.submit(trace_id=...)``), so a
+  cross-replica failover hop stays ONE trace — the spans both replicas
+  emitted share it, which is what lets ``obs_report --request`` render
+  the hop. ``first_token_at`` is the timing-ledger TTFT stamp: the
+  EARLIEST first token any attempt delivered to the client — a failover
+  replay regenerates positions the client already holds, so it never
+  moves this stamp (the engine-side crash-replay rule, applied across
+  replicas)."""
 
   __slots__ = ("frid", "prompt", "max_new_tokens", "deadline", "done",
                "error", "output", "cancelled", "submitted_at",
-               "finished_at", "attempts", "cur_replica", "cur_rid",
+               "finished_at", "first_token_at", "trace_id",
+               "attempts", "cur_replica", "cur_rid",
                "cur_req", "attempt_seq", "prev_tokens", "failovers",
                "next_try")
 
@@ -165,6 +176,8 @@ class FleetRequest(object):
     self.cancelled = threading.Event()
     self.submitted_at = time.monotonic()
     self.finished_at: Optional[float] = None
+    self.first_token_at: Optional[float] = None
+    self.trace_id = obs_spans.new_trace_id()
     self.attempts: List[tuple] = []        # (replica_id, engine_rid)
     self.cur_replica: Optional[int] = None
     self.cur_rid: Optional[int] = None
@@ -178,6 +191,30 @@ class FleetRequest(object):
     if self.deadline is None:
       return False
     return (time.monotonic() if now is None else now) >= self.deadline
+
+  def note_first_token(self, at: Optional[float]) -> None:
+    """Fold one attempt's first-token stamp into the ledger (earliest
+    wins; a replayed attempt's later stamp never resets TTFT)."""
+    if at is not None and (self.first_token_at is None
+                           or at < self.first_token_at):
+      self.first_token_at = at
+
+  @property
+  def ttft(self) -> Optional[float]:
+    if self.first_token_at is None:
+      return None
+    return self.first_token_at - self.submitted_at
+
+  def timing(self) -> dict:
+    """The fleet-level timing ledger (the engine ``Request.timing``
+    shape, plus ``failovers``/``attempts``)."""
+    return {"trace_id": self.trace_id, "frid": self.frid,
+            "submitted": self.submitted_at,
+            "first_token": self.first_token_at,
+            "finished": self.finished_at,
+            "ttft": self.ttft, "e2e": self.latency,
+            "failovers": self.failovers,
+            "attempts": list(self.attempts)}
 
   def finish(self, error: Optional[BaseException],
              output: Optional[np.ndarray] = None) -> bool:
@@ -247,8 +284,14 @@ class ServingFleet(object):
     self._thread: Optional[threading.Thread] = None
     #: bounded structured event log: {"event": eject|failover|swap, ...}
     self.events: collections.deque = collections.deque(maxlen=_EVENT_CAP)
-    # counters ONLY (the engine stats rule: StatsSnapshot subtracts)
-    self.stats = {"dispatched": 0, "completed": 0, "rejected": 0,
+    # counters ONLY (the engine stats rule: StatsSnapshot subtracts).
+    # "submitted" counts CLIENT requests at the fleet boundary — the
+    # availability SLO's denominator (obs.slo): engine-level
+    # serve.submitted counts dispatch ATTEMPTS, which fleet
+    # retries/failovers inflate, and a total-outage submit never
+    # reaches an engine at all
+    self.stats = {"submitted": 0, "dispatched": 0, "completed": 0,
+                  "rejected": 0,
                   "retries": 0, "failovers": 0, "replays": 0,
                   "replay_mismatches": 0, "ejections": 0, "swaps": 0,
                   "shed": 0, "monitor_failures": 0}
@@ -397,10 +440,14 @@ class ServingFleet(object):
             "chaos: fleet replica %d killed at dispatch" % rep.rid))
         continue
       rep.dispatches += 1
+      t0 = time.monotonic()
       try:
+        # the fleet's trace_id rides every attempt: a failover hop's
+        # spans on the NEXT replica join the same trace
         erid = rep.engine.submit(freq.prompt,
                                  max_new_tokens=freq.max_new_tokens,
-                                 deadline=freq.deadline)
+                                 deadline=freq.deadline,
+                                 trace_id=freq.trace_id)
       except sched.ServingOverloaded as e:
         ra = e.retry_after
         if ra is not None and (hint is None or ra < hint):
@@ -412,6 +459,13 @@ class ServingFleet(object):
         # the replica died between the order snapshot and the submit —
         # the monitor's next pass ejects it; try the next one
         continue
+      if self._rec is not None:
+        # the routing phase of the waterfall: which replica took it,
+        # and whether this was a fresh dispatch or a failover re-place
+        self._rec.record_span("fleet.dispatch", t0,
+                              time.monotonic() - t0,
+                              trace=freq.trace_id, replica=rep.rid,
+                              attempt=freq.attempt_seq + 1)
       self._assign(freq, rep, erid)
       return None
     return hint if hint is not None else float("inf")
@@ -446,19 +500,34 @@ class ServingFleet(object):
     now = time.monotonic()
     if ttl is not None:
       deadline = now + float(ttl)
+    if len(np.asarray(prompt, np.int32).ravel()) < 1:
+      # the engine's empty-prompt rule, checked at the fleet boundary:
+      # a malformed request is a caller bug, not traffic, and must stay
+      # out of BOTH sides of the availability ratio
+      raise ValueError("prompt must contain at least one token")
+    # "submitted" is the availability SLO's denominator — client traffic
+    # counted at the fleet boundary, at every OUTCOME point below (never
+    # on a validation error, and paired with "rejected" on every
+    # client-visible admission failure, including a dead fleet: a total
+    # outage must move the ratio it exists to burn)
     if max_new_tokens is None:
       # replicas share one config; any live engine's default applies
       rep = next((r for r in self._replicas.values()
                   if r.state != EJECTED), None)
       if rep is None:
+        self._count("submitted")
+        self._count("rejected")
         raise RuntimeError("serving fleet has no replicas left")
       max_new_tokens = rep.engine.default_max_new_tokens
     freq = FleetRequest(prompt, max_new_tokens, deadline=deadline)
     if freq.expired(now):
+      # traffic, but not unavailability: the engine's expired rule
+      self._count("submitted")
       raise sched.DeadlineExceeded(
           "request dead on arrival: its deadline already passed at "
           "submit")
     if self._draining:
+      self._count("submitted")
       self._count("rejected")
       # a usable hint, never None (the engine's draining-rejection
       # rule): this fleet is going away, so the bounded cold-start
@@ -467,6 +536,8 @@ class ServingFleet(object):
           "serving fleet is draining — admission is closed",
           retry_after=engine_mod._COLD_RETRY_AFTER, draining=True)
     if not self.alive:
+      self._count("submitted")
+      self._count("rejected")
       raise RuntimeError("serving fleet is stopped or has no live "
                          "replicas")
     admit_deadline = min(
@@ -478,11 +549,19 @@ class ServingFleet(object):
     while True:
       try:
         hint = self._try_place(freq)
-      except BaseException:
+      except BaseException as e:
         with self._lock:
           self._requests.pop(freq.frid, None)
+        # engine-side validation (ValueError: e.g. a prompt the paged
+        # pool can never host) is a caller bug — everything else that
+        # escapes the placement loop was real traffic
+        if not isinstance(e, ValueError):
+          self._count("submitted")
+          if not isinstance(e, sched.DeadlineExceeded):
+            self._count("rejected")
         raise
       if hint is None:
+        self._count("submitted")
         return freq.frid
       if not first:
         self._count("retries")
@@ -493,6 +572,7 @@ class ServingFleet(object):
       if remaining <= 0 or not self.alive:
         with self._lock:
           self._requests.pop(freq.frid, None)
+        self._count("submitted")
         self._count("rejected")
         if not self.alive:
           raise RuntimeError("serving fleet has no live replicas")
@@ -501,6 +581,10 @@ class ServingFleet(object):
             "window (%d live)" % self.active_replicas,
             retry_after=sleep if sleep != float("inf") else None)
       # bounded, stop-interruptible backoff honoring retry_after
+      if self._rec is not None:
+        self._rec.event("fleet.backoff", trace=freq.trace_id,
+                        retry_after=float(sleep)
+                        if sleep != float("inf") else None)
       self._stop_evt.wait(min(max(sleep, self._poll), remaining))
 
   # -- client read side ------------------------------------------------------
@@ -553,6 +637,7 @@ class ServingFleet(object):
     (verifying) the prefix it already delivered."""
     freq = self._freq(frid)
     deadline = time.monotonic() + timeout
+    t_attach = time.monotonic()
     chunk = max(0.05, self._poll)
     delivered: List[int] = []
     er = None
@@ -592,6 +677,8 @@ class ServingFleet(object):
           self._count("replay_mismatches")
         pos += 1
         continue
+      if not delivered:
+        freq.note_first_token(time.monotonic())
       delivered.append(int(tok))
       pos += 1
       yield int(tok)
@@ -605,6 +692,14 @@ class ServingFleet(object):
         self._finish_ok(freq, er)
       else:
         freq.finish(er.error)
+    if self._rec is not None:
+      # the delivery phase, fleet-side: the relay that survived the
+      # replica hop (tokens = client-visible positions, exactly once)
+      self._rec.record_span("fleet.stream", t_attach,
+                            time.monotonic() - t_attach,
+                            trace=freq.trace_id, frid=frid,
+                            tokens=len(delivered),
+                            failovers=freq.failovers)
     with self._lock:
       self._requests.pop(frid, None)
     err = freq.error if freq.done.is_set() else \
@@ -618,9 +713,12 @@ class ServingFleet(object):
 
   def generate(self, prompts: Sequence,
                max_new_tokens: Optional[int] = None,
-               timeout: float = 600.0) -> List[np.ndarray]:
+               timeout: float = 600.0, detailed: bool = False) -> List:
     """Submit a batch and wait for all outputs in order; a mid-list
-    rejection cancels the already-submitted prefix (the engine rule)."""
+    rejection cancels the already-submitted prefix (the engine rule).
+    ``detailed=True`` returns ``{"tokens", "trace_id", "timing"}`` per
+    prompt (the fleet timing ledger incl. ``failovers``), mirroring
+    ``ServingEngine.generate``."""
     frids = []
     try:
       for p in prompts:
@@ -631,9 +729,17 @@ class ServingFleet(object):
           self.cancel(frid, timeout=1.0)
       raise
     deadline = time.monotonic() + timeout
-    return [self.result(frid,
+    outs = []
+    for frid in frids:
+      freq = self._freq(frid)   # hold the handle: result() pops the map
+      out = self.result(frid,
                         timeout=max(0.001, deadline - time.monotonic()))
-            for frid in frids]
+      if detailed:
+        outs.append({"tokens": out, "trace_id": freq.trace_id,
+                     "timing": freq.timing()})
+      else:
+        outs.append(out)
+    return outs
 
   def cancel(self, frid: int, timeout: float) -> bool:
     """Cancel a fleet request wherever it currently lives (queued on a
@@ -746,6 +852,7 @@ class ServingFleet(object):
         return
       if len(er.tokens) > len(freq.prev_tokens):
         freq.prev_tokens = list(er.tokens)
+      freq.note_first_token(er.first_token_at)
       freq.cur_req = None
       freq.cur_replica = None
       freq.cur_rid = None
@@ -762,7 +869,7 @@ class ServingFleet(object):
       return
     self._count("failovers")
     self._event("failover", frid=freq.frid, attempt=freq.failovers,
-                emitted=len(freq.prev_tokens))
+                emitted=len(freq.prev_tokens), trace=freq.trace_id)
     with self._lock:
       self._pending.append(freq)
 
@@ -878,6 +985,7 @@ class ServingFleet(object):
 
   def _finish_ok(self, freq: FleetRequest, er) -> None:
     toks = list(er.tokens)
+    freq.note_first_token(er.first_token_at)
     if not freq.finish(None, output=np.concatenate(
         [freq.prompt, np.asarray(toks, np.int32)])):
       return    # someone else (monitor vs stream consumer) got here first

@@ -47,6 +47,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from tensorflowonspark_tpu.obs import spans as spans_mod
+
 #: comma list overriding the default prefill bucket sizes
 #: (``serving.slots.DEFAULT_BUCKETS``)
 ENV_SERVE_BUCKETS = "TOS_SERVE_BUCKETS"
@@ -120,14 +122,28 @@ class Request(object):
   deadline); ``cancelled`` is the client-side cancellation flag the
   engine loop reaps; ``crash_count`` counts engine crashes this request
   was blamed for (poison detection, docs/ROBUSTNESS.md).
+
+  Every request carries a TIMING LEDGER (public read-only fields, all
+  ``time.monotonic``): ``submitted_at`` (submit), ``started_at``
+  (admitted to a slot), ``prefill_done_at``, ``first_token_at`` and
+  ``finished_at``, plus the derived :attr:`ttft` / :attr:`latency` /
+  :attr:`queue_wait` and the :meth:`timing` dict. A crash replay
+  regenerates already-delivered positions but NEVER resets
+  ``first_token_at`` — the client saw its first token once, and that is
+  the moment TTFT measures (pinned by tests). ``trace_id`` is the
+  request-scoped trace (``obs.spans.new_trace_id``) stamped on every
+  span the request touches; pass one in to join an existing trace (the
+  fleet does, so a failover hop stays ONE trace).
   """
 
   __slots__ = ("rid", "prompt", "max_new_tokens", "tokens", "done",
                "stream_q", "error", "submitted_at", "started_at",
+               "prefill_done_at", "first_token_at",
                "finished_at", "deadline", "cancelled", "crash_count",
-               "_suppress")
+               "replays", "trace_id", "_suppress")
 
-  def __init__(self, prompt, max_new_tokens: int, deadline=None):
+  def __init__(self, prompt, max_new_tokens: int, deadline=None,
+               trace_id: Optional[str] = None):
     self.rid = next(_request_ids)
     self.prompt = np.asarray(prompt, np.int32).ravel()
     self.max_new_tokens = int(max_new_tokens)
@@ -137,10 +153,17 @@ class Request(object):
     self.error: Optional[BaseException] = None
     self.submitted_at = time.monotonic()
     self.started_at: Optional[float] = None
+    self.prefill_done_at: Optional[float] = None
+    self.first_token_at: Optional[float] = None
     self.finished_at: Optional[float] = None
     self.deadline = None if deadline is None else float(deadline)
     self.cancelled = threading.Event()
     self.crash_count = 0
+    #: crash replays this request rode (each one regenerates the
+    #: already-emitted prefix; docs/ROBUSTNESS.md)
+    self.replays = 0
+    self.trace_id = trace_id if trace_id is not None \
+        else spans_mod.new_trace_id()
     # crash-replay suppression: how many upcoming emits regenerate
     # already-delivered positions (greedy ⇒ bit-identical) and must not
     # reach tokens/stream a second time
@@ -167,8 +190,12 @@ class Request(object):
 
   def begin_replay(self) -> None:
     """Arm suppression for a crash replay: the next ``len(tokens)``
-    emits re-derive positions the client already holds."""
+    emits re-derive positions the client already holds. The timing
+    ledger is NOT reset: ``first_token_at`` keeps the moment the client
+    first saw a token (a replay re-derives it, the client never waits
+    for it again)."""
     self._suppress = len(self.tokens)
+    self.replays += 1
 
   def emit(self, token: int) -> bool:
     """Record one generated token. Returns replay parity: False when a
@@ -176,6 +203,8 @@ class Request(object):
     greedy bit-identity contract says that never happens; the engine
     counts violations instead of trusting it blindly."""
     token = int(token)
+    if self.first_token_at is None:
+      self.first_token_at = time.monotonic()
     if self._suppress:
       idx = len(self.tokens) - self._suppress
       self._suppress -= 1
@@ -199,6 +228,44 @@ class Request(object):
     if self.finished_at is None:
       return None
     return self.finished_at - self.submitted_at
+
+  @property
+  def ttft(self) -> Optional[float]:
+    """Time to first token (seconds since submit; None before it)."""
+    if self.first_token_at is None:
+      return None
+    return self.first_token_at - self.submitted_at
+
+  @property
+  def queue_wait(self) -> Optional[float]:
+    """Submit → admitted-to-a-slot wait (None while still queued)."""
+    if self.started_at is None:
+      return None
+    return self.started_at - self.submitted_at
+
+  @property
+  def tpot(self) -> Optional[float]:
+    """Per-output-token time: decode seconds per generated token past
+    the first (None until finished with >= 2 tokens)."""
+    if self.finished_at is None or self.first_token_at is None:
+      return None
+    n = len(self.tokens) - 1
+    if n < 1:
+      return None
+    return (self.finished_at - self.first_token_at) / n
+
+  def timing(self) -> dict:
+    """The per-request timing ledger as one plain dict — the fields the
+    canary verdict and ``generate(detailed=True)`` read. Raw stamps are
+    ``time.monotonic``; derived durations are seconds."""
+    return {"trace_id": self.trace_id, "rid": self.rid,
+            "submitted": self.submitted_at, "admitted": self.started_at,
+            "prefill_done": self.prefill_done_at,
+            "first_token": self.first_token_at,
+            "finished": self.finished_at,
+            "ttft": self.ttft, "e2e": self.latency,
+            "queue_wait": self.queue_wait, "tpot": self.tpot,
+            "generated": len(self.tokens), "replays": self.replays}
 
   def output(self) -> np.ndarray:
     """prompt + generated tokens (EOS inclusive, no padding)."""

@@ -60,6 +60,7 @@ are live, per-request budgets/EOS, the page allocator / prefix trie).
 """
 
 import dataclasses
+import time
 from typing import Sequence, Tuple
 
 import jax
@@ -69,6 +70,7 @@ from jax.tree_util import tree_map_with_path
 
 from tensorflowonspark_tpu.models import transformer as tfm
 from tensorflowonspark_tpu.obs import device as obs_device
+from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.utils import chaos
 
 #: prompt-chunk sizes for bucketed prefill, largest-first. The compiled
@@ -215,7 +217,7 @@ class SlotDecoder(object):
     return mutated["cache"], nxt
 
   def prefill(self, params, prompt, buckets: Sequence[int] = DEFAULT_BUCKETS,
-              resume=None) -> Tuple[object, int]:
+              resume=None, trace=None) -> Tuple[object, int]:
     """Prefill one prompt into a fresh [1, ...] row cache.
 
     Returns ``(row_cache, first_token)``: the warm cache (cursor at
@@ -228,6 +230,13 @@ class SlotDecoder(object):
     cached pool pages), so only the tail rides the chunked prefill.
     ``start`` must leave at least one tail token (the last prompt token
     must run through the model to yield g1).
+
+    ``trace`` (a request trace id) turns on per-chunk
+    ``serve.prefill.chunk`` spans when the obs recorder is live — the
+    bucketed-decomposition phase of the request waterfall. Chunk
+    dispatches are async (only the final ``int(nxt[0])`` syncs), so a
+    chunk span measures dispatch-to-dispatch time; the enclosing
+    ``serve.prefill`` span carries the true synced total.
     """
     plen = len(prompt)
     if plen + 1 > self.cfg.max_seq_len:
@@ -254,10 +263,16 @@ class SlotDecoder(object):
         self._zero_row = tfm._zero_cache(self.model, 1)
       cache, off = self._zero_row, 0
     prompt = jnp.asarray(prompt, jnp.int32).reshape(1, plen)
+    rec = obs_spans.active() if trace is not None else None
     nxt = None
     for seg in chunk_plan(plen - off, buckets):
+      t0 = time.monotonic()
       cache, nxt = self._prefill_fn(
           params, cache, lax.dynamic_slice(prompt, (0, off), (1, seg)))
+      if rec is not None:
+        rec.record_span("serve.prefill.chunk", t0,
+                        time.monotonic() - t0, trace=trace,
+                        chunk=seg, offset=off)
       off += seg
     return cache, int(nxt[0])
 

@@ -70,19 +70,29 @@ def _workload(seed, n=8, plens=(3, 5, 7), budgets=(4, 8)):
 class TestFleetRouting:
   def test_mixed_workload_parity_across_replicas(self, tiny_state):
     """Requests spread over replicas and every output is bit-identical
-    to its single-request decode — replicas are interchangeable."""
+    to its single-request decode — replicas are interchangeable. Rides
+    the same run (PR 14): submit is per-request, so the batch read goes
+    through generate(detailed=True) and pins the fleet timing ledger
+    (trace id, TTFT, zero failovers, one attempt) on every request."""
     cfg, state = tiny_state
     with ServingFleet(_factory(tiny_state), num_replicas=2) as fl:
       work = _workload(3, n=10)
-      frids = [fl.submit(p, max_new_tokens=b) for p, b in work]
-      outs = [fl.result(fr, timeout=120) for fr in frids]
+      outs = fl.generate([p for p, _ in work],
+                         max_new_tokens=max(b for _, b in work),
+                         timeout=120, detailed=True)
       stats = dict(fl.stats)
       # both replicas took traffic (10 requests over 2×2 slots must
       # overflow one replica's backlog score)
       dispatches = [r.dispatches for r in fl._replicas.values()]
-    for (p, b), out in zip(work, outs):
+    budget = max(b for _, b in work)
+    for (p, _), o in zip(work, outs):
       np.testing.assert_array_equal(
-          out, _reference(state.params, cfg, p, b))
+          o["tokens"], _reference(state.params, cfg, p, budget))
+      t = o["timing"]
+      assert t["trace_id"] == o["trace_id"]
+      assert t["failovers"] == 0 and len(t["attempts"]) == 1
+      assert t["first_token"] is not None
+      assert t["ttft"] is not None and t["e2e"] >= t["ttft"]
     assert stats["completed"] == 10 and stats["shed"] == 0
     assert all(d > 0 for d in dispatches)
 
@@ -375,33 +385,53 @@ class TestFleetChaos:
       self, tiny_state, monkeypatch):
     """A stream() consumer sees each position exactly once even when the
     request hops replicas mid-stream: the fleet suppresses (and
-    verifies) the already-delivered prefix of the replayed decode."""
+    verifies) the already-delivered prefix of the replayed decode.
+    Rides the same run (PR 14, one kill cycle is expensive): the hop is
+    ONE trace — every span both replicas emitted (dispatch, queue,
+    prefill, decode, stream relay) carries the fleet-minted trace id."""
+    from tensorflowonspark_tpu.obs import spans as spans_mod
+    rec = spans_mod.activate()
     cfg, state = tiny_state
     # replica 0's 2nd dispatch CONSULT: the streamed request below is
     # its 1st (an empty fleet dispatches in rid order); the consult that
     # trips the kill is forced mid-stream, with tokens already delivered
     monkeypatch.setenv(chaos.ENV_FLEET, "dispatch@0#2:kill")
     fac = _factory(tiny_state, num_slots=1)
-    with ServingFleet(fac, num_replicas=2, poll_interval=0.02) as fl:
-      p = np.asarray([5, 3, 8, 2], np.int32)
-      frid = fl.submit(p, max_new_tokens=24)
-      got, kicked = [], False
-      for tok in fl.stream(frid, timeout=120):
-        got.append(tok)
-        if not kicked and len(got) == 2:
-          kicked = True
-          # occupy replica 1 (the idle one scores first), then force a
-          # round that reaches replica 0 again — both busy, so the tie
-          # breaks to rid 0, whose 2nd consult kills it mid-stream
-          fl.submit(np.asarray([1, 1], np.int32), max_new_tokens=4)
-          fl.submit(np.asarray([2, 2], np.int32), max_new_tokens=4)
-      stats = dict(fl.stats)
-      states = fl.replica_states()
+    try:
+      with ServingFleet(fac, num_replicas=2, poll_interval=0.02) as fl:
+        p = np.asarray([5, 3, 8, 2], np.int32)
+        frid = fl.submit(p, max_new_tokens=24)
+        trace = fl._requests[frid].trace_id
+        got, kicked = [], False
+        for tok in fl.stream(frid, timeout=120):
+          got.append(tok)
+          if not kicked and len(got) == 2:
+            kicked = True
+            # occupy replica 1 (the idle one scores first), then force a
+            # round that reaches replica 0 again — both busy, so the tie
+            # breaks to rid 0, whose 2nd consult kills it mid-stream
+            fl.submit(np.asarray([1, 1], np.int32), max_new_tokens=4)
+            fl.submit(np.asarray([2, 2], np.int32), max_new_tokens=4)
+        stats = dict(fl.stats)
+        states = fl.replica_states()
+    finally:
+      spans_mod.deactivate()
     ref = _reference(state.params, cfg, p, 24)
     assert got == [int(t) for t in ref[len(p):]]
     assert states[0] == fleet_mod.EJECTED
     assert stats["failovers"] >= 1
     assert stats["replay_mismatches"] == 0
+    recs = [r for r in rec.drain() if r.get("trace") == trace]
+    names = {r["name"] for r in recs}
+    dispatches = [r for r in recs if r["name"] == "fleet.dispatch"]
+    assert len(dispatches) == 2                      # the hop
+    assert {d["attrs"]["replica"] for d in dispatches} == {0, 1}
+    assert {"serve.queue", "serve.prefill", "serve.decode.slot",
+            "fleet.stream"} <= names
+    assert sum(1 for r in recs if r["name"] == "serve.prefill") == 2
+    stream_span = next(r for r in recs if r["name"] == "fleet.stream")
+    assert stream_span["attrs"]["failovers"] >= 1
+    assert stream_span["attrs"]["tokens"] == len(got)
 
   def test_stall_spec_delays_dispatch_only(self, tiny_state,
                                            monkeypatch):
@@ -447,3 +477,39 @@ class TestFleetExceptionPickle:
     from tensorflowonspark_tpu.serving import PoisonedRequest
     e = pickle.loads(pickle.dumps(PoisonedRequest("bad req")))
     assert type(e) is PoisonedRequest and str(e) == "bad req"
+
+
+
+class TestAvailabilityAccounting:
+  """The availability SLO's client-boundary counters (PR 14): every
+  submit OUTCOME pairs submitted with its verdict — a dead fleet counts
+  submitted+rejected (a total outage must burn), a malformed prompt
+  counts NEITHER (caller bugs stay out of both sides of the ratio)."""
+
+  def test_dead_fleet_counts_submitted_and_rejected(self, tiny_state):
+    fl = ServingFleet(_factory(tiny_state), num_replicas=1).start()
+    fl.stop()
+    # stopped fleet = the total-outage shape: no live replica will ever
+    # take this request — client-visible unavailability
+    with pytest.raises(RuntimeError):
+      fl.submit(np.asarray([1, 2], np.int32), max_new_tokens=4)
+    assert fl.stats["submitted"] == 1
+    assert fl.stats["rejected"] == 1
+
+  def test_malformed_prompt_counts_neither(self, tiny_state):
+    with ServingFleet(_factory(tiny_state), num_replicas=1) as fl:
+      with pytest.raises(ValueError, match="at least one token"):
+        fl.submit(np.asarray([], np.int32), max_new_tokens=4)
+      assert fl.stats["submitted"] == 0
+      assert fl.stats["rejected"] == 0
+
+  def test_served_request_counts_submitted_only(self, tiny_state):
+    cfg, state = tiny_state
+    with ServingFleet(_factory(tiny_state), num_replicas=1) as fl:
+      p = np.asarray([4, 9], np.int32)
+      frid = fl.submit(p, max_new_tokens=4)
+      np.testing.assert_array_equal(
+          fl.result(frid, timeout=120),
+          _reference(state.params, cfg, p, 4))
+      assert fl.stats["submitted"] == 1
+      assert fl.stats["rejected"] == 0

@@ -521,3 +521,78 @@ class TestShipperSamplersAndTopSummary:
       assert "obs" not in reply and "alerts" not in reply
     finally:
       srv.close()
+
+
+class TestChromeTraceFlows:
+  """Cross-process flow arrows (PR 14): spans sharing a request trace id
+  chain into chrome flow events (ph s/t/f, one shared id)."""
+
+  def _procs(self):
+    # two processes, one request: dispatch on the driver, prefill+decode
+    # on the executor — the cross-process waterfall shape
+    driver = {"meta": {"label": "driver", "executor_id": 0, "pid": 100},
+              "clock": {"offset": 0.0},
+              "spans": [{"name": "fleet.dispatch", "ph": "X", "t0": 1.0,
+                         "dur": 0.1, "tid": "main", "trace": "aaaa"},
+                        {"name": "unrelated", "ph": "X", "t0": 1.0,
+                         "dur": 0.1, "tid": "main"}]}
+    ex = {"meta": {"label": "exec", "executor_id": 1, "pid": 200},
+          "clock": {"offset": 0.0},
+          "spans": [{"name": "serve.prefill", "ph": "X", "t0": 1.2,
+                     "dur": 0.3, "tid": "loop", "trace": "aaaa"},
+                    {"name": "serve.decode.slot", "ph": "X", "t0": 1.6,
+                     "dur": 0.2, "tid": "loop", "trace": "aaaa"},
+                    {"name": "serve.replay", "ph": "i", "t0": 1.7,
+                     "tid": "loop", "trace": "aaaa"}]}
+    return [driver, ex]
+
+  def test_flow_chain_is_well_formed(self):
+    trace = export.chrome_trace(self._procs())
+    flows = [e for e in trace["traceEvents"] if e.get("cat") == "trace"]
+    # 3 X-spans on the trace -> s, t, f (instants join via args only)
+    assert [f["ph"] for f in sorted(flows, key=lambda e: e["ts"])] \
+        == ["s", "t", "f"]
+    assert len({f["id"] for f in flows}) == 1
+    # flow ids must stay float64-exact: trace viewers parse JSON numbers
+    # into doubles, and an id past 2**53 can collide after rounding
+    assert all(0 < f["id"] < (1 << 53) for f in flows)
+    assert export._flow_id("f" * 16) < (1 << 53)
+    assert flows[-1].get("bp") == "e" or \
+        next(f for f in flows if f["ph"] == "f")["bp"] == "e"
+    # every flow point binds INSIDE its enclosing slice, and the chain
+    # crosses the process boundary
+    xs = {(e["pid"], e["tid"], e["ts"]): e for e in trace["traceEvents"]
+          if e["ph"] == "X" and (e.get("args") or {}).get("trace")}
+    assert {f["pid"] for f in flows} == {100, 200}
+    for f in flows:
+      host = [e for (pid, tid, ts), e in xs.items()
+              if pid == f["pid"] and tid == f["tid"]
+              and ts <= f["ts"] <= ts + e["dur"]]
+      assert host, f
+    # the trace id itself is clickable on every span AND the instant
+    tagged = [e for e in trace["traceEvents"]
+              if (e.get("args") or {}).get("trace") == "aaaa"]
+    assert len(tagged) == 4
+    json.dumps(trace)
+
+  def test_single_span_traces_emit_no_flow(self):
+    procs = [{"meta": {"label": "exec", "executor_id": 0, "pid": 1},
+              "clock": {"offset": 0.0},
+              "spans": [{"name": "serve.prefill", "ph": "X", "t0": 0.0,
+                         "dur": 0.1, "tid": "t", "trace": "bbbb"}]}]
+    trace = export.chrome_trace(procs)
+    assert [e for e in trace["traceEvents"]
+            if e.get("cat") == "trace"] == []
+
+  def test_prometheus_sketch_exposition(self):
+    from tensorflowonspark_tpu.obs import quantiles
+    sk = quantiles.QuantileSketch()
+    sk.extend(float(v) for v in range(1, 101))
+    snap = {"serve.ttft_ms": {"type": "sketch", "count": 100,
+                              "data": sk.to_dict()}}
+    text = export.prometheus_text(snap, labels={"proc": "exec0"})
+    lines = text.splitlines()
+    assert lines[0] == "# TYPE tos_serve_ttft_ms summary"
+    assert 'tos_serve_ttft_ms{proc="exec0",quantile="0.5"} 50' in lines
+    assert 'tos_serve_ttft_ms{proc="exec0",quantile="0.99"} 99' in lines
+    assert 'tos_serve_ttft_ms_count{proc="exec0"} 100' in lines

@@ -871,7 +871,10 @@ class TestServingChaos:
     """THE acceptance pin: a decode-dispatch crash mid-run is healed by
     replaying every in-flight request from its prompt — outputs stay
     bit-identical to uninjured single-request decodes, the engine stays
-    alive, and the restart/replay counters fire."""
+    alive, and the restart/replay counters fire. Rides the same run
+    (one crash cycle is expensive): the detailed TIMING LEDGER reports
+    the replay with a first-token stamp from BEFORE the recovery — the
+    integration twin of the replay-never-resets-first_token unit pin."""
     cfg, state = tiny_state
     rng = np.random.RandomState(21)
     prompts = [rng.randint(1, 64, (int(p),)).astype(np.int32)
@@ -879,7 +882,8 @@ class TestServingChaos:
     monkeypatch.setenv(chaos.ENV_SERVE, "decode#2:raise")
     with ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS,
                        poison_crashes=3, restart_backoff=0.01) as eng:
-      outs = eng.generate(prompts, max_new_tokens=8, timeout=120)
+      outs = eng.generate(prompts, max_new_tokens=8, timeout=120,
+                          detailed=True)
       stats = dict(eng.stats)
       assert eng.alive
       log = list(eng.restart_log)
@@ -888,9 +892,16 @@ class TestServingChaos:
     assert stats["replay_mismatches"] == 0
     assert stats["poisoned"] == 0
     assert len(log) == 1 and log[0]["duration_s"] >= 0.01
-    for p, out in zip(prompts, outs):
+    replayed = [o for o in outs if o["timing"]["replays"]]
+    assert replayed                  # the crash hit someone in flight
+    for o in replayed:
+      t = o["timing"]
+      # the first token predates the recovery: replay didn't reset it
+      assert t["first_token"] is not None
+      assert t["first_token"] <= log[0]["t"]
+    for p, o in zip(prompts, outs):
       np.testing.assert_array_equal(
-          out, _reference(state.params, cfg, p, 8))
+          o["tokens"], _reference(state.params, cfg, p, 8))
 
   def test_decode_crash_replays_paged_stack_bit_identical(
       self, tiny_state, monkeypatch):
@@ -1098,3 +1109,139 @@ class TestServingPredictFn:
     col[:] = [np.asarray([1, 2], np.int32), np.asarray([3], np.int32)]
     with pytest.raises(ValueError, match="greedy-only"):
       fn(state.params, {"x": col})
+
+
+# --- request timing ledger + trace linkage (PR 14) ---------------------------
+
+
+class TestTimingLedger:
+  def test_request_stamps_and_derived_fields(self):
+    r = Request(np.asarray([1, 2, 3], np.int32), 4)
+    assert r.trace_id and len(r.trace_id) == 16
+    assert r.ttft is None and r.queue_wait is None and r.tpot is None
+    r.started_at = r.submitted_at + 0.5
+    r.emit(5)
+    assert r.first_token_at is not None
+    assert r.ttft == pytest.approx(
+        r.first_token_at - r.submitted_at)
+    assert r.queue_wait == pytest.approx(0.5)
+    r.emit(6)
+    r.finish(None)
+    assert r.tpot == pytest.approx(r.finished_at - r.first_token_at)
+    t = r.timing()
+    assert t["generated"] == 2 and t["replays"] == 0
+    assert t["trace_id"] == r.trace_id
+    assert t["ttft"] == r.ttft and t["e2e"] == r.latency
+
+  def test_replay_never_resets_first_token(self):
+    """THE satellite pin: a crash replay regenerates positions the
+    client already holds — the client saw its first token ONCE, and
+    that moment is what TTFT measures."""
+    r = Request(np.asarray([1, 2], np.int32), 4)
+    r.emit(9)
+    stamp = r.first_token_at
+    time.sleep(0.01)
+    r.begin_replay()
+    assert r.emit(9) is True          # suppressed, parity holds
+    assert r.first_token_at == stamp
+    assert r.replays == 1
+    assert r.timing()["replays"] == 1
+
+  def test_submit_joins_an_existing_trace(self):
+    r = Request(np.asarray([1], np.int32), 2, trace_id="deadbeefcafe0001")
+    assert r.trace_id == "deadbeefcafe0001"
+
+  def test_generate_detailed_returns_ledger_with_parity(self, tiny_state):
+    cfg, state = tiny_state
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 64, (n,)).astype(np.int32)
+               for n in (4, 6, 5)]
+    with ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS) as eng:
+      outs = eng.generate(prompts, max_new_tokens=6, timeout=120,
+                          detailed=True)
+    assert len(outs) == 3
+    traces = set()
+    for p, o in zip(prompts, outs):
+      np.testing.assert_array_equal(
+          o["tokens"], _reference(state.params, cfg, p, 6))
+      t = o["timing"]
+      traces.add(o["trace_id"])
+      assert t["trace_id"] == o["trace_id"]
+      assert t["submitted"] <= t["admitted"] <= t["prefill_done"] \
+          <= t["first_token"] <= t["finished"]
+      assert t["ttft"] is not None and t["ttft"] >= 0
+      assert t["queue_wait"] is not None and t["e2e"] >= t["ttft"]
+      assert t["replays"] == 0
+    assert len(traces) == 3            # one fresh trace per request
+
+
+class TestTraceLinkage:
+  @pytest.fixture(autouse=True)
+  def _recorder(self):
+    from tensorflowonspark_tpu.obs import spans as spans_mod
+    self.rec = spans_mod.activate()
+    yield
+    spans_mod.deactivate()
+
+  def test_every_request_span_carries_its_trace(self, tiny_state):
+    """The tentpole invariant: every span a request touches — queue
+    wait, prefill (+ per-chunk), slot-attributed decode, stream — is
+    stamped with THAT request's trace id, and ids never cross."""
+    cfg, state = tiny_state
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, 64, (n,)).astype(np.int32)
+               for n in (4, 6)]
+    with ServingEngine(state.params, cfg, num_slots=2, eos_id=EOS) as eng:
+      rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+      traces = [eng._requests[rid].trace_id for rid in rids]
+      for rid in rids:
+        list(eng.stream(rid, timeout=120))
+    recs = self.rec.drain()
+    by_trace = {}
+    for r in recs:
+      if r.get("trace"):
+        by_trace.setdefault(r["trace"], set()).add(r["name"])
+    assert set(traces) == set(by_trace)
+    for t in traces:
+      assert {"serve.queue", "serve.prefill", "serve.prefill.chunk",
+              "serve.decode.slot", "serve.stream"} <= by_trace[t]
+    # and no serve.* request span leaked WITHOUT a trace stamp
+    for r in recs:
+      if r["name"] in ("serve.queue", "serve.prefill",
+                       "serve.prefill.chunk", "serve.decode.slot",
+                       "serve.stream"):
+        assert r.get("trace"), r["name"]
+
+  def test_trace_detail_knob_drops_highvolume_spans(self, tiny_state,
+                                                    monkeypatch):
+    """TOS_OBS_TRACE_DETAIL=0 keeps the request trace (queue/prefill/
+    stream) but drops the per-lane decode + per-chunk prefill records —
+    the span-volume relief valve for large deployments."""
+    cfg, state = tiny_state
+    monkeypatch.setenv("TOS_OBS_TRACE_DETAIL", "0")
+    p = np.asarray([3, 5, 9, 11], np.int32)
+    with ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS) as eng:
+      rid = eng.submit(p, max_new_tokens=4)
+      list(eng.stream(rid, timeout=120))
+    names = {r["name"] for r in self.rec.drain() if r.get("trace")}
+    assert {"serve.queue", "serve.prefill", "serve.stream"} <= names
+    assert "serve.decode.slot" not in names
+    assert "serve.prefill.chunk" not in names
+
+
+class TestRouterScoringReads:
+  def test_mid_admission_request_counts_as_backlog(self, tiny_state):
+    """The fleet router's scoring blind spot, pinned: a request the
+    loop has popped for admission (prefill in progress) must still
+    count in queue_depth/queued_tokens — (queue 0, occupancy 0) on a
+    replica mid-prefill reads as 'completely idle' and double-books it
+    (found as a routing flip in the failover-hop chaos test)."""
+    cfg, state = tiny_state
+    eng = ServingEngine(state.params, cfg, num_slots=1, eos_id=EOS)
+    req = Request(np.asarray([1, 2, 3], np.int32), 5)
+    assert eng.queue_depth == 0 and eng.queued_tokens == 0
+    eng._mark_admitting(req)        # the loop's on_pop hook
+    assert eng.queue_depth == 1
+    assert eng.queued_tokens == len(req.prompt) + req.max_new_tokens
+    eng._admitting = None
+    assert eng.queue_depth == 0 and eng.queued_tokens == 0

@@ -1,0 +1,536 @@
+"""Quantile-sketch + SLO plane tests.
+
+Three layers: the ``obs.quantiles`` sketch's contracts (exact-until-
+compaction, self-reported error bound, deterministic compaction,
+mergeability, serialization), the ``obs.metrics`` "sketch" metric kind
+(delta shipping = full fixed-memory state, last-write at the sink,
+merge at read time), and the ``obs.slo`` burn-rate plane (objective
+reduction to bad-fraction-over-budget, multi-window verdicts, the
+``slo_burn`` alert through the real ``AnomalyDetector`` fan-out —
+including the REAL serving engine under ``TOS_CHAOS_SERVE`` latency
+chaos: stalls burn, clean traffic doesn't, and a zero-shed swap's
+counter signature can't burn by construction).
+"""
+
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu.obs import anomaly, metrics, quantiles, slo, spans
+
+
+@pytest.fixture(autouse=True)
+def clean_active():
+  yield
+  metrics.deactivate()
+  spans.deactivate()
+
+
+# --- the sketch --------------------------------------------------------------
+
+
+class TestQuantileSketch:
+  def test_exact_until_first_compaction(self):
+    sk = quantiles.QuantileSketch(k=64)
+    vals = [float(v) for v in range(50)]
+    rng = random.Random(0)
+    rng.shuffle(vals)
+    sk.extend(vals)
+    assert sk.rank_error == 0 and sk.relative_error == 0.0
+    sv = sorted(vals)
+    for q in (0.0, 0.25, 0.5, 0.9, 0.99, 1.0):
+      # nearest-rank semantics: smallest value whose cumulative count
+      # reaches q*n
+      import math
+      idx = max(0, min(len(sv) - 1, math.ceil(q * len(sv)) - 1))
+      assert sk.quantile(q) == sv[idx]
+
+  def test_error_bound_holds_on_long_stream(self):
+    rng = random.Random(7)
+    vals = [rng.lognormvariate(0, 1.0) for _ in range(20000)]
+    sk = quantiles.QuantileSketch(k=128)
+    sk.extend(vals)
+    assert sk.count == len(vals)
+    # fixed memory: retained values stay O(k log(n/k)), far below n
+    retained = sum(len(b) for b in sk.levels)
+    assert retained < 12 * 128
+    sv = sorted(vals)
+    err = sk.rank_error
+    assert 0 < err < len(vals) // 10
+    for q in (0.5, 0.9, 0.99):
+      v = sk.quantile(q)
+      # the answer's true rank must sit within the self-reported bound
+      import bisect
+      lo = bisect.bisect_left(sv, v)
+      hi = bisect.bisect_right(sv, v)
+      target = q * len(sv)
+      assert lo - err <= target <= hi + err
+
+  def test_min_max_tracked_exactly(self):
+    sk = quantiles.QuantileSketch(k=16)
+    sk.extend([5.0, 1.0, 9.0, 3.0] * 50)
+    assert sk.vmin == 1.0 and sk.vmax == 9.0
+
+  def test_deterministic_compaction(self):
+    rng = random.Random(3)
+    vals = [rng.random() for _ in range(5000)]
+    a, b = quantiles.QuantileSketch(k=32), quantiles.QuantileSketch(k=32)
+    a.extend(vals)
+    b.extend(vals)
+    assert a.to_dict() == b.to_dict()
+
+  def test_merge_bounds_add_and_counts_sum(self):
+    rng = random.Random(11)
+    s1 = [rng.uniform(0, 1) for _ in range(4000)]
+    s2 = [rng.uniform(10, 11) for _ in range(4000)]
+    a = quantiles.QuantileSketch(k=64)
+    a.extend(s1)
+    b = quantiles.QuantileSketch(k=64)
+    b.extend(s2)
+    pre = a.rank_error + b.rank_error
+    a.merge(b)
+    assert a.count == 8000
+    assert a.vmin == min(s1) and a.vmax == max(s2)
+    # merged error: both inputs' bounds plus whatever the fold added
+    assert a.rank_error >= pre
+    sv = sorted(s1 + s2)
+    import bisect
+    for q in (0.25, 0.5, 0.75, 0.99):
+      v = a.quantile(q)
+      lo, hi = bisect.bisect_left(sv, v), bisect.bisect_right(sv, v)
+      target = q * len(sv)
+      assert lo - a.rank_error <= target <= hi + a.rank_error
+
+  def test_rank_is_the_cdf_numerator(self):
+    sk = quantiles.QuantileSketch(k=64)
+    sk.extend(float(v) for v in range(100))
+    assert sk.rank(49.0) == 50       # values 0..49 inclusive
+    assert sk.rank(-1.0) == 0
+    assert sk.rank(1000.0) == 100
+
+  def test_serialization_roundtrip(self):
+    rng = random.Random(5)
+    sk = quantiles.QuantileSketch(k=32)
+    sk.extend(rng.random() for _ in range(3000))
+    d = sk.to_dict()
+    back = quantiles.QuantileSketch.from_dict(d)
+    assert back.count == sk.count
+    assert back.rank_error == sk.rank_error
+    for q in (0.1, 0.5, 0.99):
+      assert back.quantile(q) == sk.quantile(q)
+
+  def test_merge_snapshots_skips_empty(self):
+    sk = quantiles.QuantileSketch()
+    sk.extend([1.0, 2.0, 3.0])
+    merged = quantiles.merge_snapshots(
+        [None, {}, {"count": 0, "data": {}},
+         {"type": "sketch", "count": 3, "data": sk.to_dict()}])
+    assert merged.count == 3
+    assert merged.quantile(0.5) == 2.0
+
+
+# --- the metric kind ---------------------------------------------------------
+
+
+class TestSketchMetricKind:
+  def test_registry_handle_and_snapshot_shape(self):
+    reg = metrics.MetricsRegistry()
+    q = reg.quantiles("serve.ttft_ms")
+    q.observe(5.0)
+    q.observe(7.0)
+    snap = reg.snapshot()["serve.ttft_ms"]
+    assert snap["type"] == "sketch" and snap["count"] == 2
+    assert snap["data"]["count"] == 2
+
+  def test_delta_ships_full_state_only_when_count_moved(self):
+    reg = metrics.MetricsRegistry()
+    q = reg.quantiles("m")
+    q.observe(1.0)
+    s1 = reg.snapshot()
+    d1 = metrics.snapshot_delta(s1, {})
+    assert d1["m"]["count"] == 1
+    # no movement: the idle wire must stay quiet
+    assert metrics.snapshot_delta(reg.snapshot(), s1) == {}
+    q.observe(2.0)
+    d2 = metrics.snapshot_delta(reg.snapshot(), s1)
+    # the FULL sketch ships (not a subtraction): re-ship idempotent
+    assert d2["m"]["count"] == 2
+    assert len(d2["m"]["data"]["levels"][0]) == 2
+
+  def test_apply_delta_is_last_write_and_read_merges(self):
+    total = {}
+    a = quantiles.QuantileSketch()
+    a.extend([1.0, 2.0])
+    metrics.apply_delta(total, {"m": {"type": "sketch", "count": 2,
+                                      "data": a.to_dict()}})
+    a.add(3.0)
+    metrics.apply_delta(total, {"m": {"type": "sketch", "count": 3,
+                                      "data": a.to_dict()}})
+    assert total["m"]["count"] == 3            # last write, not 5
+    b = quantiles.QuantileSketch()
+    b.extend([10.0, 20.0])
+    merged = quantiles.merge_snapshots(
+        [total["m"], {"type": "sketch", "count": 2, "data": b.to_dict()}])
+    assert merged.count == 5                   # cross-executor = merge
+
+
+# --- objectives + burn-rate tracker -----------------------------------------
+
+
+def _lat_obj(threshold_ms=100.0, q=0.9):
+  return slo.Objective("ttft_p%g" % (100 * q), "latency",
+                       metric="serve.ttft_ms", threshold_ms=threshold_ms,
+                       quantile=q)
+
+
+def _sketch_snap(values):
+  sk = quantiles.QuantileSketch()
+  sk.extend(values)
+  return {"type": "sketch", "count": sk.count, "data": sk.to_dict()}
+
+
+class TestObjectives:
+  def test_validation(self):
+    with pytest.raises(ValueError):
+      slo.Objective("x", "nope")
+    with pytest.raises(ValueError):
+      slo.Objective("x", "latency", metric="m")           # no threshold
+    with pytest.raises(ValueError):
+      slo.Objective("x", "latency", metric="m", threshold_ms=10,
+                    quantile=0.3)                         # q < 0.5
+    with pytest.raises(ValueError):
+      slo.Objective("x", "availability", target=1.5)
+
+  def test_latency_totals_merge_across_executors(self):
+    obj = _lat_obj(threshold_ms=100.0, q=0.9)
+    by_eid = {0: {"serve.ttft_ms": _sketch_snap([50.0] * 9 + [500.0])},
+              1: {"serve.ttft_ms": _sketch_snap([50.0] * 10)}}
+    total, bad, observed = obj.totals(by_eid)
+    assert total == 20 and bad == 1
+    assert observed == 50.0          # merged p90 over 20 obs
+
+  def test_availability_totals_sum_engine_counters(self):
+    obj = slo.Objective("availability", "availability", target=0.999)
+    by_eid = {0: {"serve.submitted": {"type": "counter", "value": 900},
+                  "serve.rejected": {"type": "counter", "value": 5}},
+              1: {"serve.submitted": {"type": "counter", "value": 100},
+                  "serve.poisoned": {"type": "counter", "value": 5}}}
+    total, bad, observed = obj.totals(by_eid)
+    assert total == 1000 and bad == 10
+    assert observed == pytest.approx(0.99)
+
+  def test_availability_prefers_the_fleet_client_boundary(self):
+    """With a fleet present, engine-level submit/reject counters are
+    dispatch ATTEMPTS (retries and failovers inflate them both ways) —
+    availability must read the fleet's client-boundary counters."""
+    obj = slo.Objective("availability", "availability", target=0.999)
+    by_eid = {0: {
+        # a retry burst the fleet fully absorbed: attempts look awful
+        "serve.submitted": {"type": "counter", "value": 500},
+        "serve.rejected": {"type": "counter", "value": 400},
+        "fleet.submitted": {"type": "counter", "value": 100},
+        "fleet.rejected": {"type": "counter", "value": 1},
+        "fleet.shed": {"type": "counter", "value": 1}}}
+    total, bad, observed = obj.totals(by_eid)
+    assert total == 100 and bad == 2
+    assert observed == pytest.approx(0.98)
+
+  def test_total_fleet_outage_still_burns(self):
+    """Every replica dead: submits never reach an engine, so only
+    fleet.submitted/rejected move — the availability objective must
+    see the worst outage it exists for (was a blind spot: the engine
+    tier's counters are all static here)."""
+    avail = slo.Objective("availability", "availability", target=0.99)
+    sink = FakeSink()
+    det = _detector(sink, _mk_tracker([avail]))
+    sink.data[0] = {"fleet.submitted": {"type": "counter", "value": 50},
+                    "serve.submitted": {"type": "counter", "value": 120}}
+    det.poll(now=0.0)
+    sink.data[0] = {"fleet.submitted": {"type": "counter", "value": 70},
+                    "fleet.rejected": {"type": "counter", "value": 20},
+                    "serve.submitted": {"type": "counter", "value": 120}}
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["slo_burn"]
+    assert alerts[0]["evidence"]["bad_frac_fast"] == pytest.approx(1.0)
+
+  def test_absorbed_retry_burst_stays_quiet(self):
+    """Engine attempt counters exploding while every client request
+    completes (the fleet's retry loop absorbed a transient overload)
+    must NOT burn — attempts are not client-visible damage."""
+    avail = slo.Objective("availability", "availability", target=0.99)
+    sink = FakeSink()
+    det = _detector(sink, _mk_tracker([avail]))
+    sink.data[0] = {"fleet.submitted": {"type": "counter", "value": 50},
+                    "serve.submitted": {"type": "counter", "value": 60},
+                    "serve.rejected": {"type": "counter", "value": 0}}
+    det.poll(now=0.0)
+    sink.data[0] = {"fleet.submitted": {"type": "counter", "value": 80},
+                    "serve.submitted": {"type": "counter", "value": 400},
+                    "serve.rejected": {"type": "counter", "value": 300}}
+    assert det.poll(now=10.0) == []
+
+  def test_objectives_from_env(self, monkeypatch):
+    for name in (slo.ENV_SLO_AVAILABILITY, slo.ENV_SLO_TTFT_MS,
+                 slo.ENV_SLO_E2E_MS, slo.ENV_SLO_QUANTILE):
+      monkeypatch.delenv(name, raising=False)
+    objs = slo.objectives_from_env()
+    # availability defaults ON; latency objectives need explicit bounds
+    assert [o.name for o in objs] == ["availability"]
+    monkeypatch.setenv(slo.ENV_SLO_TTFT_MS, "250")
+    monkeypatch.setenv(slo.ENV_SLO_QUANTILE, "0.95")
+    monkeypatch.setenv(slo.ENV_SLO_AVAILABILITY, "0")    # opt out
+    objs = slo.objectives_from_env()
+    assert [o.name for o in objs] == ["ttft_p95"]
+    assert objs[0].threshold_ms == 250.0
+    assert objs[0].budget == pytest.approx(0.05)
+
+
+class TestSLOTracker:
+  def _tracker(self, **kw):
+    kw.setdefault("objectives", [_lat_obj(threshold_ms=100.0, q=0.9)])
+    kw.setdefault("window", 10.0)
+    kw.setdefault("slow_mult", 3.0)
+    kw.setdefault("burn_threshold", 5.0)
+    kw.setdefault("min_events", 5)
+    return slo.SLOTracker(**kw)
+
+  def test_burns_when_both_windows_exceed(self):
+    tr = self._tracker()
+    good = [10.0] * 10
+    tr.sample(0.0, {0: {"serve.ttft_ms": _sketch_snap(good)}})
+    # every new request over threshold: bad_frac 1.0 / budget 0.1 = 10x
+    tr.sample(10.0, {0: {"serve.ttft_ms":
+                         _sketch_snap(good + [500.0] * 10)}})
+    v = tr.evaluate(10.0)[0]
+    assert v["burning"] is True
+    assert v["burn_fast"] == pytest.approx(10.0)
+    assert v["burn_slow"] == pytest.approx(10.0)
+
+  def test_recovered_incident_stops_paging(self):
+    """Slow window still poisoned, fast window clean — no page (the
+    incident ended; the budget damage is history, not an emergency)."""
+    tr = self._tracker(window=10.0, slow_mult=6.0)
+    tr.sample(0.0, {0: {"serve.ttft_ms": _sketch_snap([10.0])}})
+    bad = [10.0] + [500.0] * 36
+    tr.sample(30.0, {0: {"serve.ttft_ms": _sketch_snap(bad)}})
+    # fast window (50..60): only clean traffic
+    clean = bad + [10.0] * 30
+    tr.sample(60.0, {0: {"serve.ttft_ms": _sketch_snap(clean)}})
+    v = tr.evaluate(60.0)[0]
+    assert v["burn_slow"] is not None and v["burn_slow"] >= 5.0
+    assert v["burn_fast"] is not None and v["burn_fast"] < 5.0
+    assert v["burning"] is False
+
+  def test_min_events_guards_small_samples(self):
+    tr = self._tracker(min_events=50)
+    tr.sample(0.0, {0: {"serve.ttft_ms": _sketch_snap([10.0])}})
+    tr.sample(10.0, {0: {"serve.ttft_ms":
+                         _sketch_snap([10.0] + [500.0] * 10)}})
+    v = tr.evaluate(10.0)[0]
+    # 10 bad events out of 10 IS a 10x burn — but 10 < min_events
+    assert v["burn_fast"] == pytest.approx(10.0)
+    assert v["burning"] is False
+
+  def test_no_traffic_yields_no_verdict(self):
+    tr = self._tracker()
+    tr.sample(0.0, {0: {"serve.ttft_ms": _sketch_snap([10.0] * 5)}})
+    tr.sample(10.0, {0: {"serve.ttft_ms": _sketch_snap([10.0] * 5)}})
+    v = tr.evaluate(10.0)[0]
+    assert v["burn_fast"] is None and v["burning"] is False
+
+  def test_status_is_wire_shaped(self):
+    tr = self._tracker()
+    st = tr.status(0.0)
+    assert st["window_fast"] == 10.0 and st["window_slow"] == 30.0
+    assert isinstance(st["objectives"], list)
+
+
+# --- detector integration ----------------------------------------------------
+
+
+class FakeSink(object):
+  def __init__(self, eids=(0,)):
+    self.executors = {e: {} for e in eids}
+    self.data = {e: {} for e in eids}
+
+  def metrics(self, eid):
+    return self.data[eid]
+
+
+def _detector(sink, tracker, **kw):
+  kw.setdefault("interval", 0.5)
+  kw.setdefault("window", 10.0)
+  kw.setdefault("registry", metrics.MetricsRegistry())
+  kw.setdefault("recorder", None)
+  return anomaly.AnomalyDetector(sink, slo_tracker=tracker, **kw)
+
+
+def _mk_tracker(objectives, **kw):
+  kw.setdefault("window", 10.0)
+  kw.setdefault("slow_mult", 2.0)
+  kw.setdefault("burn_threshold", 5.0)
+  kw.setdefault("min_events", 5)
+  return slo.SLOTracker(objectives=objectives, **kw)
+
+
+class TestDetectorSLO:
+  def test_slo_burn_fires_through_the_fanout(self):
+    sink = FakeSink()
+    det = _detector(sink, _mk_tracker([_lat_obj(100.0, 0.9)]))
+    sink.data[0] = {"serve.ttft_ms": _sketch_snap([10.0] * 5)}
+    assert det.poll(now=0.0) == []
+    sink.data[0] = {"serve.ttft_ms": _sketch_snap([10.0] * 5
+                                                  + [900.0] * 10)}
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["slo_burn"]
+    a = alerts[0]
+    assert a["executor_id"] == -1                  # cluster scope
+    assert a["evidence"]["objective"] == "ttft_p90"
+    assert a["evidence"]["burn_fast"] >= 5.0
+    # counted into the registry ring like every other alert kind
+    assert det.summary()["by_kind"] == {"slo_burn": 1}
+    assert det._reg.snapshot()["obs.alerts.slo_burn"]["value"] == 1
+
+  def test_per_objective_cooldown_keys(self):
+    """Two objectives burning in the same pass both fire — the cooldown
+    key is (slo_burn, objective), not (slo_burn, -1)."""
+    sink = FakeSink()
+    e2e = slo.Objective("e2e_p90", "latency", metric="serve.e2e_ms",
+                        threshold_ms=100.0, quantile=0.9)
+    det = _detector(sink, _mk_tracker([_lat_obj(100.0, 0.9), e2e]))
+    det.cooldown = 1000.0
+    sink.data[0] = {"serve.ttft_ms": _sketch_snap([10.0] * 5),
+                    "serve.e2e_ms": _sketch_snap([10.0] * 5)}
+    det.poll(now=0.0)
+    sink.data[0] = {"serve.ttft_ms": _sketch_snap([10.0] * 5
+                                                  + [900.0] * 10),
+                    "serve.e2e_ms": _sketch_snap([10.0] * 5
+                                                 + [900.0] * 10)}
+    alerts = det.poll(now=10.0)
+    assert sorted(a["evidence"]["objective"] for a in alerts) \
+        == ["e2e_p90", "ttft_p90"]
+    # cooldown holds per objective on the next pass
+    sink.data[0] = {"serve.ttft_ms": _sketch_snap([10.0] * 5
+                                                  + [900.0] * 20),
+                    "serve.e2e_ms": _sketch_snap([10.0] * 5
+                                                 + [900.0] * 20)}
+    assert det.poll(now=11.0) == []
+
+  def test_availability_burn_from_counters(self):
+    sink = FakeSink()
+    avail = slo.Objective("availability", "availability", target=0.99)
+    det = _detector(sink, _mk_tracker([avail]))
+    sink.data[0] = {"serve.submitted": {"type": "counter", "value": 100},
+                    "serve.rejected": {"type": "counter", "value": 0}}
+    det.poll(now=0.0)
+    sink.data[0] = {"serve.submitted": {"type": "counter", "value": 120},
+                    "serve.rejected": {"type": "counter", "value": 10}}
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["slo_burn"]
+    assert alerts[0]["evidence"]["objective"] == "availability"
+
+  def test_zero_shed_swap_signature_cannot_burn(self):
+    """A routine zero-shed rolling swap moves submitted/swap counters
+    but NO bad counters and no latency mass over the bound — quiet by
+    construction (the fleet_degraded false-positive lesson re-applied:
+    the SLO reads only client-visible damage, never topology churn)."""
+    sink = FakeSink()
+    avail = slo.Objective("availability", "availability", target=0.99)
+    det = _detector(sink, _mk_tracker([avail, _lat_obj(500.0, 0.9)]))
+    sink.data[0] = {"serve.submitted": {"type": "counter", "value": 100},
+                    "serve.ttft_ms": _sketch_snap([20.0] * 100)}
+    det.poll(now=0.0)
+    # mid-swap: traffic keeps completing under the bound, swap/ejection
+    # gauges move, zero shed/rejected/poisoned
+    sink.data[0] = {"serve.submitted": {"type": "counter", "value": 160},
+                    "fleet.swaps": {"type": "counter", "value": 2},
+                    "fleet.replicas_draining": {"type": "gauge",
+                                                "value": 1},
+                    "serve.ttft_ms": _sketch_snap([20.0] * 160)}
+    assert det.poll(now=10.0) == []
+
+  def test_slo_status_serves_the_wire_payload(self):
+    sink = FakeSink()
+    det = _detector(sink, _mk_tracker([_lat_obj(100.0, 0.9)]))
+    st = det.slo_status()
+    assert st is not None and len(st["objectives"]) == 1
+    # no objectives -> None (HEALTH reply omits the key)
+    det2 = _detector(sink, slo.SLOTracker(objectives=[], window=10.0))
+    assert det2.slo_status() is None
+
+
+# --- real-engine latency chaos ----------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestServeLatencyChaos:
+  """Marked slow: one real-engine chaos cycle (~20 s) — the tier-1
+  'not slow' budget has no room, and the burn-rate machinery itself is
+  fully pinned by the unit/detector tests above. Runs via `make chaos`
+  (-m chaos) and standalone."""
+
+  def test_slo_burn_fires_under_stall_quiet_on_clean(self, monkeypatch):
+    """The acceptance drive: a REAL ServingEngine under a
+    ``TOS_CHAOS_SERVE`` stall spec burns a TTFT objective calibrated
+    off its own clean latency; the clean pass before it stays quiet."""
+    import jax
+    import numpy as np
+    from tensorflowonspark_tpu.models import transformer as tfm
+    from tensorflowonspark_tpu.serving.engine import ServingEngine
+    from tensorflowonspark_tpu.utils import chaos
+
+    reg = metrics.activate()
+    # EXACTLY tests/test_serving.py's tiny config (same cfg hash, same
+    # bucket plan, same horizon family as test_fleet's factories): in
+    # the one-process tier-1 run every jit here is a cache HIT from the
+    # earlier serving/fleet suites — this test must not re-compile the
+    # engine stack, the 870s tier-1 budget has no room for it
+    cfg = tfm.TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                                d_model=32, d_ff=64, max_seq_len=48,
+                                remat=False, dtype=jax.numpy.float32)
+    state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+    rng = random.Random(0)
+
+    def prompts(n):
+      return [np.asarray([rng.randrange(10, 60)
+                          for _ in range(rng.randrange(3, 6))], np.int32)
+              for _ in range(n)]
+
+    eng = ServingEngine(state.params, cfg, num_slots=2, eos_id=7,
+                        horizon=2, poll_interval=0.01).start()
+    try:
+      eng.generate(prompts(2), max_new_tokens=4, timeout=120)  # warm
+      sink = FakeSink()
+      sink.metrics = lambda eid: reg.snapshot()    # live registry totals
+
+      # clean-pass TTFT calibrates the bound: 4x p99 + 150ms headroom
+      eng.generate(prompts(6), max_new_tokens=4, timeout=120)
+      clean_p99 = reg.quantiles("serve.ttft_ms").quantile(0.99)
+      bound = 4.0 * clean_p99 + 150.0
+      det = _detector(sink, _mk_tracker(
+          [slo.Objective("ttft_p90", "latency", metric="serve.ttft_ms",
+                         threshold_ms=bound, quantile=0.9)],
+          min_events=4, burn_threshold=3.0))
+      det.poll(now=0.0)                            # baseline
+      # one more clean pass: quiet
+      eng.generate(prompts(6), max_new_tokens=4, timeout=120)
+      assert det.poll(now=10.0) == []
+      # stall every prefill long past the bound: the injected latency
+      # chaos the SLO plane exists to catch
+      stall_s = (bound + 300.0) / 1e3
+      monkeypatch.setenv(chaos.ENV_SERVE, ",".join(
+          "prefill#%d:stall:%.3f" % (n, stall_s) for n in range(1, 7)))
+      chaos.reset()
+      eng.generate(prompts(6), max_new_tokens=4, timeout=300)
+      alerts = det.poll(now=20.0)
+      assert [a["alert"] for a in alerts] == ["slo_burn"]
+      assert alerts[0]["evidence"]["objective"] == "ttft_p90"
+    finally:
+      monkeypatch.delenv(chaos.ENV_SERVE, raising=False)
+      chaos.reset()
+      eng.stop()

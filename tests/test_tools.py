@@ -107,6 +107,12 @@ class TestServeBenchCompareSmoke:
     # budget DRAWN for this workload (a member of the option set — the
     # largest option need not be drawn at every seed)
     assert result["static"]["fixed_steps"] in result["workload"]["budgets"]
+    # bench and production share ONE percentile estimator (PR 14): the
+    # quantile sketch's p50/p99 agree with the exact sorted list within
+    # the sketch's self-reported error bound, gated in the smoke tier
+    assert result["sketch_agreement_ok"] is True
+    for leg in ("static", "continuous"):
+      assert result[leg]["p50_s"] <= result[leg]["p99_s"]
 
 
 class TestServeBenchPrefixSmoke:
@@ -451,3 +457,84 @@ class TestBenchHistory:
     with open(path, "a") as f:
       f.write('{"bench": "feed_bench", "val')   # SIGKILL mid-append
     assert len(bh.load(path)) == 1
+
+
+class TestSLOReportSmoke:
+  def test_smoke_links_traces_and_serves_slo_over_health(self, tmp_path):
+    """`slo_report --smoke` (make slo-smoke) drives a REAL 2-process
+    LocalEngine SERVE run with the obs plane + a declared TTFT objective
+    on, and proves the PR-14 acceptance path end to end: SLO status over
+    the HEALTH wire mid-run, linked request traces
+    (queue→prefill→decode on one trace id) in the merged JSONL, a
+    compliant objective table, zero slo_burn on a clean run — then
+    `obs_report --request <id>` renders the SAME run's single-request
+    waterfall from the kept logs."""
+    import json
+    import os
+    import subprocess
+    import sys
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(tools, "slo_report.py"),
+         "--smoke", "--keep", str(tmp_path)],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-3000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "slo_report_smoke"
+    assert result["ok"] is True
+    assert result["full_waterfalls"] > 0
+    assert result["slo_burn_alerts"] == 0          # clean run: quiet
+    assert "availability" in result["slo_on_wire"]
+    assert any(n.startswith("ttft") for n in result["slo_on_wire"])
+    by_name = {r["objective"]: r for r in result["objectives"]}
+    assert by_name["availability"]["compliant"] is True
+    assert by_name["availability"]["events"] == result["rows_served"]
+    # chain: the request waterfall renders from the SAME kept logs
+    trace_id = result["sample_trace"]
+    assert trace_id
+    wf_out = subprocess.run(
+        [sys.executable, os.path.join(tools, "obs_report.py"),
+         str(tmp_path), "--request", trace_id],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert wf_out.returncode == 0, wf_out.stderr[-2000:]
+    wf = json.loads(wf_out.stdout.strip().splitlines()[-1])
+    assert wf["metric"] == "obs_request_waterfall"
+    assert wf["trace"] == [trace_id]
+    for phase in ("serve.queue", "serve.prefill", "serve.prefill.chunk",
+                  "serve.decode.slot"):
+      assert wf["phases"].get(phase, {}).get("count", 0) > 0, phase
+    assert wf["wall_s"] > 0
+
+
+class TestObsTopSLORow:
+  def test_snapshot_carries_slo_and_renders_row(self):
+    """The HEALTH-wire SLO payload rides the snapshot verbatim (the
+    --once --json contract) and renders as one slo[...] line with the
+    burning marker."""
+    from tools import obs_top
+    slo = {"objectives": [
+        {"name": "ttft_p99", "kind": "latency", "observed": 12.0,
+         "threshold_ms": 50.0, "burn_fast": 0.2, "burn_slow": 0.1,
+         "burning": False},
+        {"name": "availability", "kind": "availability",
+         "observed": 0.992, "target": 0.999, "burn_fast": 16.0,
+         "burn_slow": 15.0, "burning": True}],
+        "window_fast": 20.0, "window_slow": 240.0,
+        "burn_threshold": 14.4}
+    snap = obs_top.build_snapshot({"data": {}, "obs": {}, "alerts": [],
+                                   "slo": slo})
+    assert snap["slo"] == slo                     # --once --json field
+    text = "\n".join(obs_top.render(snap, clear=False))
+    assert "slo[" in text
+    assert "ttft_p99 12ms/50ms burn 0.2/0.1" in text
+    assert "avail 0.9920/0.9990 burn 16.0/15.0 !" in text
+
+  def test_no_slo_on_wire_renders_nothing(self):
+    from tools import obs_top
+    snap = obs_top.build_snapshot({"data": {}, "obs": {}, "alerts": []})
+    assert snap["slo"] is None
+    assert "slo[" not in "\n".join(obs_top.render(snap, clear=False))

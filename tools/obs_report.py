@@ -153,6 +153,83 @@ def build_report(obs_dir, trace_path=None, prom_path=None):
   return result, procs
 
 
+def request_waterfall(procs, trace_id):
+  """Collect one request's spans/events across every merged process log
+  (matched by trace-id prefix, driver-anchored timestamps) into the
+  waterfall model: time-ordered rows + per-phase duration totals."""
+  rows = []
+  matched = set()
+  for proc in procs:
+    meta = proc.get("meta") or {}
+    label = "%s%s" % (meta.get("label", "proc"), meta.get("executor_id", ""))
+    offset = float(proc.get("clock", {}).get("offset") or 0.0)
+    for rec in proc.get("spans") or []:
+      t = rec.get("trace")
+      if not t or not str(t).startswith(trace_id):
+        continue
+      matched.add(str(t))
+      rows.append({"t": rec["t0"] + offset, "dur": rec.get("dur", 0.0),
+                   "name": rec.get("name", "?"), "proc": label,
+                   "ph": rec.get("ph", "X"),
+                   "attrs": rec.get("attrs") or {}})
+  rows.sort(key=lambda r: r["t"])
+  phases = {}
+  for r in rows:
+    if r["ph"] != "i":
+      ent = phases.setdefault(r["name"], {"count": 0, "total_s": 0.0})
+      ent["count"] += 1
+      ent["total_s"] += r["dur"]
+  procs_touched = sorted({r["proc"] for r in rows})
+  out = {"trace": sorted(matched), "spans": len(rows),
+         "procs": procs_touched,
+         "phases": {k: {"count": v["count"],
+                        "total_s": round(v["total_s"], 6)}
+                    for k, v in sorted(phases.items())}}
+  if rows:
+    out["t0"] = rows[0]["t"]
+    out["wall_s"] = round(max(r["t"] + r["dur"] for r in rows)
+                          - rows[0]["t"], 6)
+  return out, rows
+
+
+def print_request_waterfall(result, rows):
+  """Render the waterfall: one line per span, offset-scaled bars."""
+  if not rows:
+    sys.stderr.write("no spans matched that trace id\n")
+    return
+  t0 = rows[0]["t"]
+  span = max(1e-9, max(r["t"] + r["dur"] for r in rows) - t0)
+  width = 32
+  sys.stderr.write("request trace %s — %d span(s) across %s, %.1f ms\n"
+                   % (",".join(result["trace"]), result["spans"],
+                      "/".join(result["procs"]),
+                      1e3 * result.get("wall_s", 0.0)))
+  sys.stderr.write("%-24s %-8s %9s %9s  waterfall\n"
+                   % ("span", "proc", "start_ms", "dur_ms"))
+  for r in rows:
+    rel = r["t"] - t0
+    if r["ph"] == "i":
+      bar = " " * int(width * rel / span) + "*"
+      dur_txt = "-"
+    else:
+      lo = int(width * rel / span)
+      ln = max(1, int(width * r["dur"] / span))
+      bar = " " * lo + "#" * min(ln, width - lo)
+      dur_txt = "%.3f" % (r["dur"] * 1e3)
+    extra = ""
+    if r["attrs"]:
+      keys = ("slot", "replica", "tokens", "chunk", "suppressed")
+      kv = ["%s=%s" % (k, r["attrs"][k]) for k in keys if k in r["attrs"]]
+      if kv:
+        extra = "  [%s]" % " ".join(kv)
+    sys.stderr.write("%-24s %-8s %9.3f %9s  |%-*s|%s\n"
+                     % (r["name"], r["proc"], rel * 1e3, dur_txt,
+                        width, bar, extra))
+  sys.stderr.write("per-phase totals: %s\n" % "  ".join(
+      "%s %.3fms x%d" % (k, 1e3 * v["total_s"], v["count"])
+      for k, v in result["phases"].items()))
+
+
 def print_alerts(procs):
   """Post-mortem alert table from the merged JSONL (the detector appends
   each alert as it fires, so this survives a driver crash)."""
@@ -259,6 +336,11 @@ def main():
   ap.add_argument("--alerts", action="store_true",
                   help="render the recorded detector alerts as a "
                        "post-mortem table")
+  ap.add_argument("--request", default=None, metavar="TRACE_ID",
+                  help="render ONE request's end-to-end waterfall (all "
+                       "spans stamped with this trace id — prefix "
+                       "match — across every merged process log, incl. "
+                       "fleet dispatch/failover hops)")
   ap.add_argument("--smoke", action="store_true",
                   help="drive a 2-process LocalEngine train+inference run "
                        "end-to-end and report on its merged trace")
@@ -271,6 +353,12 @@ def main():
     ap.error("obs_dir is required (or use --smoke)")
   result, procs = build_report(args.obs_dir, trace_path=args.trace,
                                prom_path=args.prom)
+  if args.request:
+    wf, rows = request_waterfall(procs, args.request)
+    print_request_waterfall(wf, rows)
+    wf["metric"] = "obs_request_waterfall"
+    print(json.dumps(wf))
+    sys.exit(0 if rows else 1)
   if args.alerts:
     print_alerts(procs)
   print_summary(result, procs)

@@ -174,7 +174,35 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "alerts": m.get("obs.alerts"),
     }
   return {"t": now, "executors": rows, "alerts": alerts, "series": series,
+          # the SLO plane's live verdicts ride the same HEALTH reply
+          # (obs.slo via the detector): per-objective observed value,
+          # fast/slow burn rates and the burning flag — served computed,
+          # so the monitor renders without re-deriving window math
+          "slo": reply.get("slo"),
           "has_obs": bool(obs), "has_alert_ring": alerts is not None}
+
+
+def _fmt_slo(slo):
+  """One compact ``slo[...]`` line from the HEALTH-wire SLO status:
+  per objective its observed value vs the bound, and the fast/slow
+  burn-rate pair that decides ``slo_burn`` (``!`` = burning)."""
+  parts = []
+  for o in slo.get("objectives") or []:
+    obs_v = o.get("observed")
+    if o.get("kind") == "latency":
+      val = ("%.0fms" % obs_v) if obs_v is not None else "-"
+      label = "%s %s/%.0fms" % (o.get("name"), val,
+                                o.get("threshold_ms") or 0.0)
+    else:
+      val = ("%.4f" % obs_v) if obs_v is not None else "-"
+      label = "avail %s/%.4f" % (val, o.get("target") or 0.0)
+    bf, bs = o.get("burn_fast"), o.get("burn_slow")
+    label += " burn %s/%s" % ("%.1f" % bf if bf is not None else "-",
+                              "%.1f" % bs if bs is not None else "-")
+    if o.get("burning"):
+      label += " !"
+    parts.append(label)
+  return "slo[" + " | ".join(parts) + "]" if parts else ""
 
 
 def render(snap, clear=True):
@@ -263,6 +291,12 @@ def render(snap, clear=True):
             if row["clock_offset_ms"] is not None else "-",
             "%d" % row["alerts"] if row["alerts"] is not None else "-",
             feed))
+  slo = snap.get("slo")
+  if slo:
+    line = _fmt_slo(slo)
+    if line:
+      lines.append("")
+      lines.append(line)
   alerts = snap.get("alerts") or []
   lines.append("")
   if alerts:

@@ -152,6 +152,34 @@ _COMPARE_SMOKE = dict(layers=2, heads=2, d_model=32, d_ff=64, vocab=64,
                       budgets=(4, 8), max_seq=24, horizon=4)
 
 
+def _lat_stats(lats):
+  """p50/p99 request latency through the SHARED production estimator
+  (``obs.quantiles.QuantileSketch`` — the same latency object the
+  engines record TTFT/e2e into for the SLO plane), so a bench number
+  and a production SLO number are the same kind of number. Returns
+  ``(stats dict, agreement bool)``: agreement checks the sketch's
+  answers against the exact sorted list within the sketch's own
+  self-reported rank-error bound (``--smoke`` gates on it)."""
+  import bisect
+  from tensorflowonspark_tpu.obs import quantiles
+  vals = [float(v) for v in lats if v is not None]
+  sk = quantiles.QuantileSketch()
+  sk.extend(vals)
+  stats = {"p50_s": round(sk.quantile(0.5), 3),
+           "p99_s": round(sk.quantile(0.99), 3)}
+  sv = sorted(vals)
+  tol = sk.rank_error + 1   # +1: nearest-rank vs target-rank rounding
+  ok = True
+  for q in (0.5, 0.99):
+    v = sk.quantile(q)
+    lo = bisect.bisect_left(sv, v)
+    hi = bisect.bisect_right(sv, v)
+    target = q * len(sv)
+    if not (lo - tol <= target <= hi + tol):
+      ok = False
+  return stats, ok
+
+
 def _zipf_pick(rng, options, a=1.3):
   """Zipf-ish draw over ``options`` sorted ascending: small values
   common, large values rare — the mixed-length traffic shape that makes
@@ -274,27 +302,26 @@ def measure_compare(params, cfg, workload, slots, eos_id, useful, horizon,
       for (prompt, _), out, ref in zip(workload, outs, useful):
         if not np.array_equal(out, np.concatenate([prompt, ref])):
           mismatches += 1
+      s_pct, s_agree = _lat_stats(s_lat)
+      c_pct, c_agree = _lat_stats(c_lat)
       rows.append({
-          "static": {
+          "static": dict({
               "tok_s": round(total_useful / s_wall, 2),
               "wall_s": round(s_wall, 3),
               "fixed_steps": num_steps,
-              "p50_s": round(float(np.percentile(s_lat, 50)), 3),
-              "p99_s": round(float(np.percentile(s_lat, 99)), 3),
               "batches": len(groups),
-          },
-          "continuous": {
+          }, **s_pct),
+          "continuous": dict({
               "tok_s": round(total_useful / c_wall, 2),
               "wall_s": round(c_wall, 3),
               "occupancy": round(
                   delta["live_slot_steps"]
                   / float(max(1, delta["steps"]) * slots), 3),
-              "p50_s": round(float(np.percentile(c_lat, 50)), 3),
-              "p99_s": round(float(np.percentile(c_lat, 99)), 3),
               "decode_steps": delta["steps"],
               "horizon": horizon,
               "parity_mismatches": mismatches,
-          },
+          }, **c_pct),
+          "sketch_agreement": bool(s_agree and c_agree),
           "speedup": round((total_useful / c_wall)
                            / max(1e-9, total_useful / s_wall), 2),
       })
@@ -304,7 +331,9 @@ def measure_compare(params, cfg, workload, slots, eos_id, useful, horizon,
   median = rows[len(rows) // 2]
   median = dict(median, per_rep_speedups=[r["speedup"] for r in rows],
                 parity_ok=all(r["continuous"]["parity_mismatches"] == 0
-                              for r in rows))
+                              for r in rows),
+                sketch_agreement_ok=all(r["sketch_agreement"]
+                                        for r in rows))
   return median
 
 
@@ -423,14 +452,13 @@ def measure_prefix(params, cfg, workload, shape, eos_id, useful, reps):
         mismatches = sum(
             1 for (prompt, _), out, ref in zip(workload, outs, useful)
             if not np.array_equal(out, np.concatenate([prompt, ref])))
-        leg = {
+        pct, _ = _lat_stats(lats)
+        leg = dict({
             "tok_s": round(total_useful / wall, 2),
             "wall_s": round(wall, 3),
-            "p50_s": round(float(np.percentile(lats, 50)), 3),
-            "p99_s": round(float(np.percentile(lats, 99)), 3),
             "prefills": int(delta["prefills"]),
             "parity_mismatches": mismatches,
-        }
+        }, **pct)
         if eng.page_size:
           leg["prefix_hits"] = int(delta["prefix_hits"])
           leg["prefix_evictions"] = int(delta["prefix_evictions"])
@@ -612,18 +640,17 @@ def measure_fleet(params, cfg, workload, shape, eos_id, useful, reps):
       mismatches = sum(
           1 for (prompt, _), out, ref in zip(workload, f_outs, useful)
           if not np.array_equal(out, np.concatenate([prompt, ref])))
+      s_pct, _ = _lat_stats(s_lat)
+      f_pct, _ = _lat_stats(f_lat)
       rows.append({
-          "single": {
+          "single": dict({
               "tok_s": round(total_useful / s_wall, 2),
               "wall_s": round(s_wall, 3),
-              "p50_s": round(float(np.percentile(s_lat, 50)), 3),
-              "p99_s": round(float(np.percentile(s_lat, 99)), 3),
-          },
-          "fleet": {
+          }, **s_pct),
+          "fleet": dict({
               "tok_s": round(total_useful / f_wall, 2),
               "wall_s": round(f_wall, 3),
-              "p50_s": round(float(np.percentile(f_lat, 50)), 3),
-              "p99_s": round(float(np.percentile(f_lat, 99)), 3),
+              **f_pct,
               "dispatched": int(delta.get("dispatched", 0)),
               "retries": int(delta.get("retries", 0)),
               "failovers": int(delta.get("failovers", 0)),
@@ -636,7 +663,7 @@ def measure_fleet(params, cfg, workload, shape, eos_id, useful, reps):
                                for r in swap["replicas"]
                                if "drained" in r)),
               "parity_mismatches": mismatches,
-          },
+          }),
           "speedup": round((total_useful / f_wall)
                            / max(1e-9, total_useful / s_wall), 2),
       })
@@ -922,6 +949,10 @@ def run_compare(args):
       "speedup": median["speedup"],
       "per_rep_speedups": median["per_rep_speedups"],
       "parity_ok": median["parity_ok"],
+      # bench and production share ONE percentile estimator
+      # (obs.quantiles): the sketch's p50/p99 must agree with the exact
+      # sorted list within the sketch's self-reported error bound
+      "sketch_agreement_ok": median["sketch_agreement_ok"],
       "note": "same slot count, same seeded Zipf-ish mixed-length "
               "workload; tokens/sec counts each request's useful tokens "
               "(truncated at its own EOS/budget). static = the "
@@ -947,7 +978,9 @@ def run_compare(args):
         extra={"speedup": result["speedup"],
                "obs": int(obs_metrics.enabled())})
   print(line)
-  return 0 if result["parity_ok"] else 3
+  ok = result["parity_ok"] and \
+      (result["sketch_agreement_ok"] or not args.smoke)
+  return 0 if ok else 3
 
 
 def main():
