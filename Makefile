@@ -8,7 +8,8 @@ PY ?= python
 	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
 	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
 	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke \
-	feed-bench-graph feed-bench-graph-smoke slo-smoke
+	feed-bench-graph feed-bench-graph-smoke slo-smoke elastic-chaos \
+	train-bench-groups train-bench-groups-smoke
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -87,13 +88,35 @@ train-bench-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  $(PY) tools/train_bench.py --smoke
 
+# elastic-training fault injection only (TOS_CHAOS_GROUP): whole-group
+# kill mid-training with no global stall, eviction + re-admit catch-up,
+# resharded restore — docs/ROBUSTNESS.md §Elastic training; tier-1
+elastic-chaos:
+	$(PY) -m pytest tests/test_groups.py -q -m chaos
+
+# cross-group sync overhead: N groups no-sync vs synced every --unroll
+# steps (parallel.groups), paired reps, interchangeability gated; writes
+# the artifact + a train_bench_groups history line
+train-bench-groups:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/train_bench.py --groups 2 \
+	  --json-out bench_artifacts/train_bench_groups.json
+
+# elastic-groups plumbing check: tiny paired run, interchangeability
+# (bit-identical post-sync params) asserted
+train-bench-groups-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/train_bench.py --groups 2 --smoke
+
 # fast pre-commit gate: static analysis + style + the fast test subset +
 # the obs plumbing smokes + the train-loop fusion smoke + the serving
 # fleet (replica-kill chaos suite + router/zero-shed-swap bench smoke) +
-# the datapipe graph smoke (bit-parity through the autotuned executor)
+# the datapipe graph smoke (bit-parity through the autotuned executor) +
+# the elastic-training plane (group-kill chaos suite + groups bench smoke)
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
 check: analyze obs-smoke obs-top-smoke slo-smoke train-bench-smoke \
-	fleet-chaos serve-bench-fleet-smoke feed-bench-graph-smoke
+	fleet-chaos serve-bench-fleet-smoke feed-bench-graph-smoke \
+	elastic-chaos train-bench-groups-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 

@@ -101,9 +101,24 @@ class ClusterSupervisor(object):
     self._obs_rec = obs_spans.active()
 
   def _event(self, kind: str, **fields) -> None:
+    # structured payloads (attempt / backoff_s / group / ...) mirror the
+    # fleet's eject/failover events: obs_report --alerts post-mortems can
+    # reconstruct a recovery or resize from the driver JSONL alone
     self.events.append(dict(fields, kind=kind, t=time.monotonic()))
     if self._obs_reg is not None:
       self._obs_reg.counter("cluster." + kind.replace("-", "_")).inc()
+    if self._obs_rec is not None:
+      self._obs_rec.event("cluster." + kind,
+                          **{k: v for k, v in fields.items()
+                             if isinstance(v, (int, float, str, bool))})
+
+  def _group_of(self, eid: int):
+    """The mesh group this executor hosts (cluster_meta ``group_map``),
+    or None for ungrouped clusters. Keys tolerate str/int (the map may
+    round-trip through JSON)."""
+    gm = self.cluster_meta.get("group_map") or {}
+    g = gm.get(eid, gm.get(str(eid)))
+    return int(g) if g is not None else None
 
   # -- lifecycle -------------------------------------------------------------
 
@@ -174,7 +189,9 @@ class ClusterSupervisor(object):
 
   def _recover(self, eid: int) -> None:
     attempt = self._attempts.get(eid, 0)
-    self._event("detected-dead", executor_id=eid, attempt=attempt)
+    group = self._group_of(eid)
+    self._event("detected-dead", executor_id=eid, attempt=attempt,
+                group=group)
     try:
       job_name, _ = node_mod._role_of(eid, self.cluster_meta["cluster_template"])
     except ValueError:
@@ -191,17 +208,24 @@ class ClusterSupervisor(object):
              "relaunched; failure will surface at shutdown)"
              % (job_name, eid))
       logger.error(msg)
-      self._event("skipped-background", executor_id=eid)
+      self._event("skipped-background", executor_id=eid, group=group)
       if self.tf_status.get("error") is None:
         self.tf_status["error"] = msg
       return
     if attempt >= self.max_restarts:
       self._given_up.add(eid)
+      if group is not None and self.cluster_meta.get("elastic"):
+        # elastic mode: a grouped executor past its restart budget is a
+        # RESIZE, not a job failure — commit the shrink on the sync plane
+        # so surviving groups stop waiting for it (parallel.groups)
+        self._commit_shrink(eid, group, attempt)
+        return
       msg = ("executor %d declared dead after %d restart attempt(s); "
              "restart budget (max_restarts=%d) exhausted"
              % (eid, attempt, self.max_restarts))
       logger.error(msg)
-      self._event("gave-up", executor_id=eid)
+      self._event("gave-up", executor_id=eid, attempts=attempt,
+                  group=group)
       # the node task may have completed OK long ago (ENGINE mode: the
       # bring-up task returns before the background fn dies) — make sure
       # shutdown still raises
@@ -238,12 +262,19 @@ class ClusterSupervisor(object):
     self.engine.relaunch_task(self.node_job, task_id,
                               payload={"executor_id": eid,
                                        "restart": attempt + 1})
-    self._event("relaunched", executor_id=eid, attempt=attempt + 1)
+    # re-arm the startup grace from the relaunch instant: a stale beat
+    # from the OLD incarnation clears the restarting flag, and without a
+    # fresh grace the next sweep would re-declare death mid-bring-up and
+    # burn a second restart attempt on the same failure
+    self.server.liveness.rearm(eid)
+    self._event("relaunched", executor_id=eid, attempt=attempt + 1,
+                backoff_s=round(delay, 3), group=group)
 
     reregistered = self._await_reregistration(eid, attempt + 1)
     if reregistered:
       self.restarts[eid] = attempt + 1
-      self._event("recovered", executor_id=eid)
+      self._event("recovered", executor_id=eid, attempt=attempt + 1,
+                  group=group)
     else:
       # liveness/ExecutorLost will re-fire and consume another attempt,
       # or the task error (a non-restartable bring-up failure) propagates
@@ -253,6 +284,41 @@ class ClusterSupervisor(object):
       # whichever LIVE worker picks up the feed task, so a slow relaunch
       # must not drop them
       self._refeed(pending)
+
+  def _commit_shrink(self, eid: int, group: int, attempts: int) -> None:
+    """Elastic resize, shrink direction: evict the dead executor's group
+    from the sync plane so rounds never wait for it and its stale
+    contributions are rejected; training continues on the survivors with
+    the sync denominator reduced. Only an empty group set is fatal."""
+    plane = getattr(self.server, "sync_plane", None)
+    active = None
+    if plane is not None:
+      plane.mark_lost(group, "executor %d dead past restart budget "
+                      "(%d attempt(s))" % (eid, attempts))
+      active = plane.status()["groups_active"]
+    logger.error("executor %d (group %d) declared dead after %d restart "
+                 "attempt(s); committing the shrink — %s group(s) remain",
+                 eid, group, attempts, active)
+    self._event("resize-shrink", executor_id=eid, group=group,
+                attempts=attempts, groups_active=active)
+    if active == 0 and self.tf_status.get("error") is None:
+      self.tf_status["error"] = (
+          "all training groups lost (last: group %d on executor %d)"
+          % (group, eid))
+
+  def readmit(self, eid: int) -> None:
+    """Elastic resize, grow/re-admit direction: the engine brought the
+    executor's capacity back (or an operator re-added it) after the
+    supervisor gave up on it. The restart budget resets and liveness
+    re-arms its startup grace so the rebooting node isn't re-declared
+    dead mid-bring-up; the node's group rejoins the sync plane itself
+    (``GroupSyncClient.join`` pulls the catch-up weights) at its next
+    sync boundary."""
+    self._given_up.discard(eid)
+    self._attempts.pop(eid, None)
+    self.server.liveness.rearm(eid)
+    self._event("resize-readmit", executor_id=eid,
+                group=self._group_of(eid))
 
   def _quarantine_dead_hub(self, old_meta: Optional[dict]) -> Dict[str, List]:
     """Mark the dead node's hub unusable and rescue undelivered feed rows.
@@ -799,7 +865,9 @@ def run(engine: Engine, main_fn, tf_args=None,
         supervise: bool = True, max_restarts: int = 2,
         restart_backoff: float = 0.5,
         restart_backoff_cap: float = 5.0,
-        train_unroll: Optional[int] = None) -> TPUCluster:
+        train_unroll: Optional[int] = None,
+        group_map: Optional[Dict[int, int]] = None,
+        elastic: bool = False) -> TPUCluster:
   """Start a cluster and run ``main_fn(tf_args, ctx)`` on every node.
 
   Signature parity with the reference's ``TFCluster.run``
@@ -823,6 +891,16 @@ def run(engine: Engine, main_fn, tf_args=None,
   ``parallel.sharding.make_train_loop`` / ``data.readers.slab_batches``
   default to fusing K optimizer steps per dispatch (1/None = the
   per-step status quo; see docs/PERFORMANCE.md §Train-loop fusion).
+
+  ``group_map={executor_id: group_id}`` declares elastic multi-group
+  training topology (``parallel.groups``): the rendezvous server grows a
+  :class:`~parallel.groups.SyncPlane` (SYNC/SYNCQ/GROUP verbs + HEALTH
+  ``groups`` telemetry) and supervisor events carry the group. With
+  ``elastic=True`` a grouped executor that exhausts its restart budget
+  COMMITS A SHRINK — surviving groups keep stepping with the sync
+  denominator reduced — instead of failing the job; only losing every
+  group is fatal. ``ClusterSupervisor.readmit`` re-opens the budget when
+  capacity returns (docs/ROBUSTNESS.md §Elastic training).
   """
   num_executors = num_executors or engine.num_executors
   if train_unroll is not None and int(train_unroll) < 1:
@@ -890,6 +968,11 @@ def run(engine: Engine, main_fn, tf_args=None,
     # compile/device tier, driver side: the driver jits too (sharded
     # init, serving warm-up) and its compiles belong on the timeline
     obs_device.install(None)
+  if group_map or elastic:
+    # the driver end of the elastic-training plane: groups exchange
+    # weights through the SYNC verbs, HEALTH replies carry the topology
+    from tensorflowonspark_tpu.parallel import groups as groups_mod
+    groups_mod.attach_sync_plane(server)
   server_addr = server.start()
 
   cluster_meta = {
@@ -920,6 +1003,12 @@ def run(engine: Engine, main_fn, tf_args=None,
       # TOS_TRAIN_UNROLL (node._apply_node_env) so make_train_loop /
       # slab_batches resolve the cluster's K without per-fn plumbing
       "train_unroll": int(train_unroll) if train_unroll else None,
+      # elastic multi-group training (parallel.groups): executor -> mesh
+      # group id, and whether a group past its restart budget shrinks the
+      # group set (resize) instead of failing the job
+      "group_map": ({int(k): int(v) for k, v in group_map.items()}
+                    if group_map else None),
+      "elastic": bool(elastic),
   }
 
   # launch node bring-up asynchronously so that (a) feeding can start and

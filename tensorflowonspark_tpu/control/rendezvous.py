@@ -212,6 +212,24 @@ class Liveness(object):
     with self._lock:
       self._restarting.add(executor_id)
 
+  def rearm(self, executor_id: int) -> None:
+    """Re-arm the startup grace for a fresh incarnation (relaunch/resize).
+
+    ``mark_restarting`` suppresses dead-detection only until the next
+    non-registration beat clears the flag — and a STALE beat from the old
+    incarnation (a stalled-not-dead process flushing its send queue) does
+    exactly that, re-confirming the executor so the strict 2-interval
+    deadline applies while the NEW incarnation is still booting. The
+    supervisor calls this at relaunch/readmit time: the beat clock resets
+    and confirmation is dropped, so the next sweep sees at worst
+    ``startup_grace`` headroom instead of instantly re-declaring death
+    (which would burn a second restart attempt on one failure).
+    """
+    with self._lock:
+      self._last[executor_id] = time.monotonic()
+      self._confirmed.discard(executor_id)
+      self._departed.discard(executor_id)
+
   def state(self, executor_id: int) -> str:
     with self._lock:
       return self._state_locked(executor_id, time.monotonic())
@@ -411,6 +429,11 @@ class Server(MessageSocket):
     #: alert ring so out-of-process monitors (tools/obs_top.py) see what
     #: the driver's detector loop sees. None = no ``alerts`` field.
     self.alert_source = None
+    #: driver-attached ``parallel.groups.SyncPlane`` serving the
+    #: SYNC/SYNCQ/GROUP verbs (elastic multi-group training). None (the
+    #: default) answers those verbs with an ERROR reply — the control
+    #: plane never requires the training plane to exist.
+    self.sync_plane = None
     #: HEALTH obs/alert enrichment failures (counted, never raised)
     self.health_obs_failures = 0
     self._listener: Optional[socket.socket] = None
@@ -592,6 +615,15 @@ class Server(MessageSocket):
           except Exception as e:  # noqa: BLE001 - reply stays slo-free
             self.health_obs_failures += 1
             logger.warning("slo status for HEALTH failed: %s", e)
+      plane = self.sync_plane
+      if plane is not None:
+        # elastic-training topology (groups active/lost, sync latency) —
+        # best-effort like every other HEALTH enrichment
+        try:
+          reply["groups"] = plane.status()
+        except Exception as e:  # noqa: BLE001 - reply stays groups-free
+          self.health_obs_failures += 1
+          logger.warning("sync-plane status for HEALTH failed: %s", e)
       self.send(sock, reply)
     elif mtype == "QINFO":
       self.send(sock, {"type": "COUNT",
@@ -623,6 +655,22 @@ class Server(MessageSocket):
         arrived = len(self._barrier_arrivals.get(rnd, ()))
       self.send(sock, {"type": "BDONE",
                        "done": arrived >= int(msg["required"])})
+    elif mtype in ("SYNC", "SYNCQ", "GROUP"):
+      # elastic multi-group training: cross-group weight exchange rides the
+      # rendezvous plane (ISSUE 16). The verbs delegate to the attached
+      # SyncPlane (like obs_sink for OBS) so this module stays free of any
+      # jax/training dependency; a plane bug degrades to an ERROR reply the
+      # group client surfaces, never a dead serve loop.
+      plane = self.sync_plane
+      if plane is None:
+        self.send(sock, {"type": "ERROR",
+                         "error": "no sync plane attached for %s" % mtype})
+      else:
+        try:
+          self.send(sock, plane.handle(msg))
+        except Exception as e:  # noqa: BLE001 - reported to the caller
+          logger.warning("sync plane failed on %s: %s", mtype, e)
+          self.send(sock, {"type": "ERROR", "error": str(e)})
     elif mtype == "STOP":
       logger.info("rendezvous server received STOP")
       self.stop_requested.set()

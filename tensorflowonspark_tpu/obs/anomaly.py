@@ -51,6 +51,17 @@ catalogue every pass:
                     replica is goodput-bound — the scale-up signal (the
                     ``serving_saturated`` thresholds applied fleet-wide):
                     add a replica
+``group_lost``      ``training.groups_active`` below ``training.groups_total``:
+                    one or more elastic training groups were lost or evicted
+                    (``parallel.groups``) — surviving groups keep stepping
+                    with the sync denominator shrunk, but capacity is gone:
+                    re-admit the group or commit the shrink
+                    (docs/ROBUSTNESS.md §Elastic training)
+``sync_lag``        ``training.sync_ms`` at/over ``TOS_OBS_SYNC_LAG_MS``: the
+                    last cross-group sync round ran close to (or into) its
+                    deadline — a slow, stalled or partitioned group is
+                    dragging every boundary; find it before the miss limit
+                    evicts it
 ``mem_slope``       ``device.bytes_in_use`` grew monotonically by more than
                     ``TOS_OBS_MEM_SLOPE_PCT`` percent across the window (a
                     leak-shaped creep toward OOM)
@@ -115,6 +126,9 @@ ENV_OBS_QUEUE_SAT = "TOS_OBS_QUEUE_SAT"
 ENV_OBS_CRASH_LOOP = "TOS_OBS_CRASH_LOOP"
 #: memory slope: percent in-use growth across the window that fires (TOS008)
 ENV_OBS_MEM_SLOPE_PCT = "TOS_OBS_MEM_SLOPE_PCT"
+#: cross-group sync round latency (ms) at/over which ``sync_lag`` fires
+#: (TOS008)
+ENV_OBS_SYNC_LAG_MS = "TOS_OBS_SYNC_LAG_MS"
 #: per-(kind, executor) refire suppression in seconds (TOS008)
 ENV_OBS_ALERT_COOLDOWN = "TOS_OBS_ALERT_COOLDOWN"
 
@@ -128,6 +142,7 @@ _DEFAULT_QUEUE_SAT = 8
 _DEFAULT_CRASH_LOOP = 2
 _DEFAULT_MEM_SLOPE_PCT = 10.0
 _DEFAULT_COOLDOWN = 30.0
+_DEFAULT_SYNC_LAG_MS = 2000.0
 
 #: bounded alert ring (driver memory; the JSONL keeps the full history)
 MAX_ALERTS = 256
@@ -151,6 +166,8 @@ _SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
             "fleet.replicas_total", "fleet.replicas_active",
             "fleet.replicas_draining", "fleet.queue_depth",
             "fleet.occupancy",
+            "training.groups_total", "training.groups_active",
+            "training.sync_ms",
             "device.bytes_in_use")
 
 
@@ -217,6 +234,8 @@ class AnomalyDetector(object):
                                        _DEFAULT_CRASH_LOOP)
     self.mem_slope_pct = _env_float(ENV_OBS_MEM_SLOPE_PCT,
                                     _DEFAULT_MEM_SLOPE_PCT)
+    self.sync_lag_ms = _env_float(ENV_OBS_SYNC_LAG_MS,
+                                  _DEFAULT_SYNC_LAG_MS)
     self.cooldown = _env_float(ENV_OBS_ALERT_COOLDOWN, _DEFAULT_COOLDOWN)
     #: detectors only evaluate once a window's sample span reaches this —
     #: sub-second startup windows turn executor launch skew into phantom
@@ -327,6 +346,7 @@ class AnomalyDetector(object):
         new.extend(self._check_serve_crash_loop(eid, dq, span, now))
         new.extend(self._check_kv_pages(eid, dq, span, now))
         new.extend(self._check_fleet(eid, dq, span, now))
+        new.extend(self._check_groups(eid, dq, span, now))
         new.extend(self._check_mem_slope(eid, dq, span, now))
       new.extend(self._check_slo(now))
     except Exception:  # noqa: BLE001 - the detector must outlive any
@@ -515,6 +535,39 @@ class AnomalyDetector(object):
         "serving fleet on executor %d saturated at full strength: %d "
         "queued request(s) across %d replicas at occupancy %.2f — "
         "scale up: add a replica" % (eid, int(depth), int(active), occ))
+
+  def _check_groups(self, eid, dq, span, now) -> List[dict]:
+    """The elastic-training pair (``parallel.groups``): ``group_lost``
+    when the group set runs below its total — a group died or was
+    evicted, surviving groups keep stepping with the sync denominator
+    shrunk, but the lost throughput stays lost until someone re-admits
+    the group or commits the shrink — and ``sync_lag`` when the last
+    cross-group sync round took at/over ``TOS_OBS_SYNC_LAG_MS``: a
+    slow or stalled group is dragging every boundary toward the round
+    deadline, and past the miss limit the plane will evict it."""
+    latest = dq[-1][1]
+    out: List[dict] = []
+    total = latest.get("training.groups_total")
+    active = latest.get("training.groups_active")
+    if total is not None and active is not None and total > 0 \
+        and active < total:
+      out.extend(self._fire(
+          "group_lost", eid, span, now,
+          {"groups_active": active, "groups_total": total},
+          "elastic training on executor %d running %d/%d groups — "
+          "lost group(s) shrank the sync denominator; training "
+          "continues degraded: re-admit the group or commit the "
+          "shrink" % (eid, int(active), int(total))))
+    sync_ms = latest.get("training.sync_ms")
+    if sync_ms is not None and sync_ms >= self.sync_lag_ms:
+      out.extend(self._fire(
+          "sync_lag", eid, span, now,
+          {"sync_ms": sync_ms, "threshold_ms": self.sync_lag_ms},
+          "cross-group weight sync on executor %d took %.0fms "
+          "(threshold %.0fms) — a slow or stalled group is dragging "
+          "rounds toward the deadline"
+          % (eid, sync_ms, self.sync_lag_ms)))
+    return out
 
   def _check_slo(self, now) -> List[dict]:
     """Sample + burn-rate-evaluate the declared SLO objectives

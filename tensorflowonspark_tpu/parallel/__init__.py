@@ -19,6 +19,10 @@ XLA/GSPMD to collectives over ICI/DCN.
 - ``pipeline_parallel`` — GPipe-style microbatched stage parallelism
 - ``runner``      — independent-parallel barrier runner (parity:
                     TFParallel.py)
+- ``groups``      — elastic multi-group training: hierarchical data
+                    parallelism (periodic cross-group weight sync over the
+                    rendezvous plane) that survives group loss, resizes,
+                    and reshards checkpoints across group counts
 """
 
 from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401

@@ -1,0 +1,783 @@
+"""Elastic multi-group training: hierarchical data parallelism that
+survives group loss, resizes, and reshards its checkpoints.
+
+"Exploring the limits of Concurrency in ML Training on Google TPUs"
+(PAPERS.md) scales past one synchronous mesh with HIERARCHICAL data
+parallelism: fast in-group collectives every step, periodic cross-group
+weight synchronization on a slower plane. TF-Replicator's replica
+abstraction explains why that shape is also the fault story: groups are
+INTERCHANGEABLE — any group's post-sync state is the model — so losing
+one shrinks the denominator instead of killing the job, and a rebooted
+(or brand-new) group catches up by pulling the current weights and
+rejoining at the next sync boundary. That is the elasticity the serving
+plane already has (``serving.fleet`` ejects/readmits replicas) ported to
+the training plane.
+
+Three layers:
+
+- :class:`SyncPlane` — driver-side round state, attached to the
+  rendezvous :class:`~control.rendezvous.Server` as ``server.sync_plane``
+  (the ``obs_sink`` pattern): serves the ``SYNC`` (contribute weights to
+  a round), ``SYNCQ`` (poll for the merged result) and ``GROUP``
+  (join/leave/lost/state) verbs. A round completes when every
+  non-lost member contributed OR its deadline passes — the sync
+  denominator shrinks to whoever showed up, so a dead group can delay a
+  round by at most ``sync_timeout`` and can never stall training
+  globally. Groups that miss ``miss_limit`` consecutive rounds are
+  marked lost (the committed shrink); a lost group's next contribution
+  is REJECTED so stale weights never poison the average — it must
+  re-join (pulling current weights) instead.
+- :class:`GroupSyncClient` — per-group client over
+  :class:`~control.rendezvous.Client`; every wait is deadline-bounded
+  (TOS001).
+- :class:`GroupSet` — the in-process group runtime: N independent mesh
+  groups (device subsets of this host, the same same-process topology
+  the fleet's replicas use), each stepping the existing fused
+  ``make_train_loop`` privately and syncing every ``sync_every`` steps.
+  Chaos (``TOS_CHAOS_GROUP``) is consulted at each boundary;
+  :meth:`GroupSet.save`/:meth:`GroupSet.restore_or` record/reshard the
+  group topology through the checkpoint manifest.
+
+Wire budget: a sync payload (one serialized weight pytree) must fit the
+rendezvous frame cap (``rendezvous.MAX_MESSAGE_BYTES``, 4 MiB). That
+bounds this plane to small/medium models or to syncing a parameter
+subset; a chunked exchange can lift it later without changing the verbs.
+
+Merge semantics: floating-point leaves are the weighted mean of the
+round's contributions (weights = optimizer steps contributed, so uneven
+rounds stay unbiased); non-float leaves (step counters, rng keys) take
+the first contribution verbatim — averaging them is meaningless.
+"""
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tensorflowonspark_tpu.control import rendezvous
+from tensorflowonspark_tpu.obs import metrics as obs_metrics
+from tensorflowonspark_tpu.obs import spans as obs_spans
+from tensorflowonspark_tpu.utils import chaos
+
+logger = logging.getLogger(__name__)
+
+#: steps each group runs between cross-group syncs (GroupSet default)
+ENV_GROUP_SYNC_EVERY = "TOS_GROUP_SYNC_EVERY"
+#: seconds a round waits for stragglers after its first contribution
+#: before merging with whoever showed up
+ENV_GROUP_SYNC_TIMEOUT = "TOS_GROUP_SYNC_TIMEOUT"
+#: consecutive missed rounds before a group is marked lost
+ENV_GROUP_MISS_LIMIT = "TOS_GROUP_MISS_LIMIT"
+
+_DEFAULT_SYNC_EVERY = 8
+_DEFAULT_SYNC_TIMEOUT = 30.0
+_DEFAULT_MISS_LIMIT = 2
+
+
+def _env_int(name: str, default: int) -> int:
+  try:
+    return int(os.environ.get(name, default))
+  except ValueError:
+    return default
+
+
+def _env_float(name: str, default: float) -> float:
+  try:
+    return float(os.environ.get(name, default))
+  except ValueError:
+    return default
+
+
+class GroupEvicted(RuntimeError):
+  """The plane rejected this group's contribution because it was marked
+  lost (missed too many rounds / supervisor committed the shrink). The
+  group must re-join — pulling current weights — before syncing again."""
+
+
+# -- payload codec ------------------------------------------------------------
+#
+# The wire carries a FLAT LEAF LIST (msgpack-safe: dtype string, shape
+# list, raw bytes); the tree structure stays client-side — the server
+# merges positionally and never needs jax. unpack_tree restores into the
+# caller's template, which every member shares by construction.
+
+
+def pack_tree(tree: Any) -> List[dict]:
+  """Flatten a pytree of arrays into the wire leaf-list."""
+  import jax
+  import numpy as np
+  out = []
+  for leaf in jax.tree.leaves(tree):
+    a = np.asarray(leaf)
+    out.append({"dtype": str(a.dtype), "shape": list(a.shape),
+                "data": a.tobytes()})
+  return out
+
+
+def unpack_tree(leaves: List[dict], template: Any) -> Any:
+  """Rebuild a pytree with ``template``'s structure from the wire list."""
+  import jax
+  import numpy as np
+  tmpl_leaves, treedef = jax.tree.flatten(template)
+  if len(leaves) != len(tmpl_leaves):
+    raise ValueError("payload has %d leaves, template has %d"
+                     % (len(leaves), len(tmpl_leaves)))
+  arrays = [np.frombuffer(rec["data"], dtype=rec["dtype"])
+            .reshape(rec["shape"]).copy() for rec in leaves]
+  return jax.tree.unflatten(treedef, arrays)
+
+
+def merge_payloads(contribs: List[Tuple[float, List[dict]]]) -> List[dict]:
+  """Weighted-mean merge of wire leaf-lists (float leaves; first-wins for
+  the rest). Pure numpy — runs on the driver without jax."""
+  import numpy as np
+  if not contribs:
+    raise ValueError("nothing to merge")
+  weights = [max(0.0, float(w)) for w, _ in contribs]
+  total = sum(weights) or float(len(contribs))
+  first = contribs[0][1]
+  merged = []
+  for i, rec in enumerate(first):
+    dtype = np.dtype(rec["dtype"])
+    if dtype.kind != "f":
+      merged.append(dict(rec))
+      continue
+    acc = np.zeros(rec["shape"], dtype=np.float64)
+    for (w, leaves), wt in zip(contribs, weights):
+      arr = np.frombuffer(leaves[i]["data"], dtype=leaves[i]["dtype"])
+      acc += arr.reshape(rec["shape"]).astype(np.float64) * (wt or 1.0)
+    acc /= (total or 1.0)
+    merged.append({"dtype": rec["dtype"], "shape": list(rec["shape"]),
+                   "data": acc.astype(dtype).tobytes()})
+  return merged
+
+
+# -- driver-side round state --------------------------------------------------
+
+
+class SyncPlane(object):
+  """Cross-group sync rounds + group membership, served over rendezvous.
+
+  Attach to a :class:`control.rendezvous.Server` (``server.sync_plane =
+  plane`` or :func:`attach_sync_plane`); the server delegates the
+  SYNC/SYNCQ/GROUP verbs to :meth:`handle` and enriches HEALTH replies
+  with :meth:`status` (→ ``obs_top``'s ``groups[...]`` line).
+
+  All state transitions are driven by member requests and by round
+  deadlines — there is no background thread, so the plane is exactly as
+  alive as the server serving it.
+  """
+
+  def __init__(self, sync_timeout: Optional[float] = None,
+               miss_limit: Optional[int] = None, keep_rounds: int = 4,
+               time_fn=time.monotonic):
+    self.sync_timeout = (sync_timeout if sync_timeout is not None
+                         else _env_float(ENV_GROUP_SYNC_TIMEOUT,
+                                         _DEFAULT_SYNC_TIMEOUT))
+    self.miss_limit = (miss_limit if miss_limit is not None
+                       else _env_int(ENV_GROUP_MISS_LIMIT,
+                                     _DEFAULT_MISS_LIMIT))
+    self.keep_rounds = keep_rounds
+    self._now = time_fn
+    self._lock = threading.Lock()
+    self.active: set = set()
+    self.lost: Dict[int, str] = {}          # gid -> reason
+    self._ever: set = set()
+    self._miss: Dict[int, int] = {}         # gid -> consecutive misses
+    # round -> {"contrib": {gid: (weight, leaves)}, "need": set,
+    #           "deadline": float, "t0": float, "merged": leaves|None,
+    #           "members": [gid], "step": int}
+    self._rounds: Dict[int, dict] = {}
+    #: latest merged weights — the catch-up payload a (re)joining group
+    #: pulls: {"round": int, "step": int, "payload": leaves}
+    self.latest: Optional[dict] = None
+    self.rounds_completed = 0
+    self.last_sync_ms: Optional[float] = None
+    self.step = 0                           # highest synced member step
+    self.events: deque = deque(maxlen=256)
+
+  # -- membership -------------------------------------------------------------
+
+  def _event_locked(self, kind: str, **fields) -> None:
+    self.events.append(dict(fields, event=kind, t=self._now()))
+    logger.info("sync plane: %s %s", kind, fields)
+
+  def join(self, gid: int) -> dict:
+    with self._lock:
+      fresh = gid not in self.active
+      self.active.add(gid)
+      self._ever.add(gid)
+      self.lost.pop(gid, None)
+      self._miss.pop(gid, None)
+      if fresh:
+        self._event_locked("join", group=gid, active=len(self.active))
+      latest = self.latest
+      return {"type": "GROUP", "ok": True, "active": sorted(self.active),
+              "step": self.step,
+              "round": latest["round"] if latest else -1,
+              "payload": latest["payload"] if latest else None}
+
+  def leave(self, gid: int) -> dict:
+    with self._lock:
+      self.active.discard(gid)
+      self._miss.pop(gid, None)
+      self._event_locked("leave", group=gid, active=len(self.active))
+      return {"type": "GROUP", "ok": True, "active": sorted(self.active)}
+
+  def mark_lost(self, gid: int, reason: str = "reported") -> None:
+    """Commit the shrink: the group stops counting toward round
+    completion and its future contributions are rejected until a
+    re-join. Idempotent."""
+    with self._lock:
+      self._mark_lost_locked(gid, reason)
+
+  def _mark_lost_locked(self, gid: int, reason: str) -> None:
+    if gid in self.lost:
+      return
+    self.active.discard(gid)
+    self._ever.add(gid)
+    self.lost[gid] = reason
+    self._miss.pop(gid, None)
+    self._event_locked("lost", group=gid, reason=reason,
+                       active=len(self.active))
+
+  def seed(self, step: int, payload: Optional[List[dict]] = None) -> None:
+    """Prime the plane after a checkpoint restore: the step counter (and
+    optionally the restored weights as the catch-up payload for late
+    joiners) continue from the checkpoint instead of zero."""
+    with self._lock:
+      self.step = max(self.step, int(step))
+      if payload is not None:
+        self.latest = {"round": -1, "step": int(step), "payload": payload}
+
+  # -- rounds -----------------------------------------------------------------
+
+  def contribute(self, gid: int, rnd: int, payload: List[dict],
+                 weight: float = 1.0, step: int = 0) -> dict:
+    with self._lock:
+      if gid in self.lost:
+        return {"type": "OK", "accepted": False, "lost": True,
+                "reason": self.lost[gid]}
+      if gid not in self.active:
+        # an unknown contributor self-admits (first-round bootstrap);
+        # members join explicitly so this is the exception path
+        self.active.add(gid)
+        self._ever.add(gid)
+        self._event_locked("join", group=gid, active=len(self.active),
+                           implicit=True)
+      r = self._rounds.get(rnd)
+      if r is None:
+        now = self._now()
+        r = self._rounds[rnd] = {
+            "contrib": {}, "need": set(self.active),
+            "deadline": now + self.sync_timeout, "t0": now,
+            "merged": None, "members": [], "step": 0}
+      r["contrib"][gid] = (float(weight), payload)
+      r["step"] = max(r["step"], int(step))
+      self._miss[gid] = 0
+      return {"type": "OK", "accepted": True,
+              "contributed": len(r["contrib"]),
+              "need": sorted(r["need"] - self.lost.keys())}
+
+  def poll(self, rnd: int) -> dict:
+    with self._lock:
+      r = self._rounds.get(rnd)
+      if r is None:
+        return {"type": "SYNC", "done": False, "round": rnd,
+                "waiting_on": []}
+      if r["merged"] is None:
+        # membership is frozen at round creation (groups joining mid-round
+        # participate from the NEXT boundary — they must not stall this
+        # one), but losses committed mid-round shrink the wait immediately
+        need = r["need"] - set(self.lost)
+        have = set(r["contrib"])
+        if (have and have >= need) or self._now() >= r["deadline"]:
+          self._merge_locked(rnd, r, need)
+      if r["merged"] is None:
+        need = r["need"] - set(self.lost)
+        return {"type": "SYNC", "done": False, "round": rnd,
+                "waiting_on": sorted(need - set(r["contrib"]))}
+      return {"type": "SYNC", "done": True, "round": rnd,
+              "payload": r["merged"], "members": r["members"],
+              "denominator": len(r["members"]), "step": r["step"]}
+
+  def _merge_locked(self, rnd: int, r: dict, need: set) -> None:
+    missing = sorted(need - set(r["contrib"]))
+    for gid in missing:
+      misses = self._miss[gid] = self._miss.get(gid, 0) + 1
+      if misses >= self.miss_limit:
+        self._mark_lost_locked(
+            gid, "missed %d consecutive sync round(s)" % misses)
+    members = sorted(r["contrib"])
+    r["merged"] = merge_payloads([r["contrib"][g] for g in members])
+    r["members"] = members
+    now = self._now()
+    self.last_sync_ms = (now - r["t0"]) * 1000.0
+    self.rounds_completed += 1
+    self.step = max(self.step, r["step"])
+    self.latest = {"round": rnd, "step": r["step"], "payload": r["merged"]}
+    self._event_locked("round", round=rnd, members=members,
+                       missing=missing, step=r["step"],
+                       sync_ms=round(self.last_sync_ms, 3))
+    # contributions served their purpose; keep only the merged result,
+    # and only for the last few rounds (stragglers polling an old round)
+    r["contrib"] = {}
+    for old in sorted(self._rounds):
+      if old < rnd - self.keep_rounds:
+        del self._rounds[old]
+
+  # -- wire entry points ------------------------------------------------------
+
+  def handle(self, msg: dict) -> dict:
+    """Serve one SYNC/SYNCQ/GROUP message (the Server delegate)."""
+    mtype = msg.get("type")
+    if mtype == "SYNC":
+      return self.contribute(int(msg["group_id"]), int(msg["round"]),
+                             msg["payload"],
+                             weight=msg.get("weight", 1.0),
+                             step=msg.get("step", 0))
+    if mtype == "SYNCQ":
+      return self.poll(int(msg["round"]))
+    if mtype == "GROUP":
+      action = msg.get("action")
+      gid = int(msg["group_id"]) if "group_id" in msg else None
+      if action == "join":
+        return self.join(gid)
+      if action == "leave":
+        return self.leave(gid)
+      if action == "lost":
+        self.mark_lost(gid, msg.get("reason", "reported"))
+        return {"type": "GROUP", "ok": True, "active": sorted(self.active)}
+      if action == "state":
+        return dict(self.status(), type="GROUP", ok=True)
+      return {"type": "ERROR", "error": "unknown GROUP action %r" % action}
+    return {"type": "ERROR", "error": "sync plane cannot serve %r" % mtype}
+
+  def status(self) -> dict:
+    """Bounded topology summary for HEALTH replies / obs_top."""
+    with self._lock:
+      return {"active": sorted(self.active),
+              "lost": sorted(self.lost),
+              "groups_active": len(self.active),
+              "groups_total": len(self._ever),
+              "round": self.latest["round"] if self.latest else -1,
+              "step": self.step,
+              "rounds_completed": self.rounds_completed,
+              "sync_ms": (round(self.last_sync_ms, 3)
+                          if self.last_sync_ms is not None else None)}
+
+
+def attach_sync_plane(server, **kwargs) -> SyncPlane:
+  """Create a :class:`SyncPlane` and attach it to a rendezvous server
+  (idempotent: returns the already-attached plane if present)."""
+  plane = getattr(server, "sync_plane", None)
+  if plane is None:
+    plane = SyncPlane(**kwargs)
+    server.sync_plane = plane
+  return plane
+
+
+# -- group-side client --------------------------------------------------------
+
+
+class GroupSyncClient(object):
+  """One group's handle on the sync plane. Every wait is bounded by an
+  explicit deadline (TOS001): a plane that never completes a round
+  surfaces as :class:`TimeoutError` here, never as a wedged group."""
+
+  def __init__(self, server_addr: Tuple[str, int], group_id: int,
+               request_timeout: float = 30.0):
+    self.group_id = int(group_id)
+    self._client = rendezvous.Client(tuple(server_addr),
+                                     timeout=request_timeout)
+
+  def join(self) -> dict:
+    return self._client._request({"type": "GROUP", "action": "join",
+                                  "group_id": self.group_id})
+
+  def leave(self) -> dict:
+    return self._client._request({"type": "GROUP", "action": "leave",
+                                  "group_id": self.group_id})
+
+  def report_lost(self, group_id: int, reason: str = "reported") -> dict:
+    return self._client._request({"type": "GROUP", "action": "lost",
+                                  "group_id": int(group_id),
+                                  "reason": reason})
+
+  def state(self) -> dict:
+    return self._client._request({"type": "GROUP", "action": "state",
+                                  "group_id": self.group_id})
+
+  def sync(self, round_num: int, tree: Any, weight: float = 1.0,
+           step: int = 0, timeout: float = 60.0,
+           poll_interval: float = 0.02) -> Tuple[Any, List[int]]:
+    """Contribute ``tree`` to ``round_num`` and block (bounded) for the
+    merged result: ``(merged_tree, member_gids)``.
+
+    Raises :class:`GroupEvicted` when the plane marked this group lost —
+    the caller must re-:meth:`join` (pulling current weights) before its
+    next sync. Raises :class:`TimeoutError` past ``timeout``.
+    """
+    payload = pack_tree(tree)
+    resp = self._client._request(
+        {"type": "SYNC", "group_id": self.group_id, "round": int(round_num),
+         "payload": payload, "weight": float(weight), "step": int(step)})
+    if resp.get("lost"):
+      raise GroupEvicted("group %d evicted from the sync plane (%s)"
+                         % (self.group_id, resp.get("reason")))
+    deadline = time.monotonic() + max(0.0, timeout)
+    while True:
+      resp = self._client._request({"type": "SYNCQ",
+                                    "round": int(round_num)})
+      if resp.get("done"):
+        return (unpack_tree(resp["payload"], tree),
+                [int(g) for g in resp.get("members", [])])
+      if time.monotonic() >= deadline:
+        raise TimeoutError(
+            "sync round %d did not complete within %.1fs (waiting on %s)"
+            % (round_num, timeout, resp.get("waiting_on")))
+      time.sleep(poll_interval)
+
+  def close(self) -> None:
+    try:
+      self._client.close()
+    except Exception:  # noqa: BLE001 - best-effort socket teardown
+      pass
+
+
+# -- the in-process group runtime --------------------------------------------
+
+
+class TrainGroup(object):
+  """One mesh group: a private fused TrainLoop over a device subset,
+  stepping independently between sync boundaries."""
+
+  def __init__(self, group_id: int, state: Any, loop, sync: GroupSyncClient,
+               steps: int = 0):
+    self.group_id = int(group_id)
+    self.state = state
+    self.loop = loop
+    self.sync = sync
+    self.steps = int(steps)
+    self.losses: List[float] = []
+    self.alive = True
+    self.exit_reason: Optional[str] = None
+    self.sync_ms: Optional[float] = None
+    self.thread: Optional[threading.Thread] = None
+
+
+class GroupSet(object):
+  """N interchangeable mesh groups training one model (see module doc).
+
+  ``build_fn(mesh) -> (state, loss_fn)`` constructs each group's initial
+  train state and loss on its mesh (every group must build the SAME
+  structure — interchangeability is the contract). ``batch_fn(group_id,
+  step) -> batch`` supplies deterministic per-group data; because it is
+  keyed by ``(group_id, step)``, the data-feed position IS the step
+  counter, so a resharded restore resumes the feed for free.
+
+  Same-process topology (threads over device subsets) matches the
+  serving fleet's replicas: the elasticity mechanics — membership,
+  rounds, eviction, catch-up — are identical for cross-process groups,
+  which only swap the transport endpoint (the rendezvous address).
+  """
+
+  def __init__(self, build_fn: Callable, batch_fn: Callable,
+               num_groups: int, sync_every: Optional[int] = None,
+               sync_timeout: Optional[float] = None,
+               miss_limit: Optional[int] = None,
+               unroll: Optional[int] = None,
+               devices_per_group: int = 1,
+               server: Optional[rendezvous.Server] = None):
+    if num_groups < 1:
+      raise ValueError("need at least one group")
+    self.build_fn = build_fn
+    self.batch_fn = batch_fn
+    self.sync_every = (sync_every if sync_every is not None
+                       else _env_int(ENV_GROUP_SYNC_EVERY,
+                                     _DEFAULT_SYNC_EVERY))
+    self.sync_timeout = (sync_timeout if sync_timeout is not None
+                         else _env_float(ENV_GROUP_SYNC_TIMEOUT,
+                                         _DEFAULT_SYNC_TIMEOUT))
+    self.unroll = unroll
+    self.devices_per_group = max(1, int(devices_per_group))
+    self._own_server = server is None
+    if server is None:
+      server = rendezvous.Server(1)
+      server.start()
+    self.server = server
+    self.plane = attach_sync_plane(server, sync_timeout=self.sync_timeout,
+                                   miss_limit=miss_limit)
+    self.groups: Dict[int, TrainGroup] = {}
+    self.events: deque = deque(maxlen=256)
+    self._plane_events_seen = 0
+    self._stop = threading.Event()
+    self._total: Optional[int] = None
+    self._lock = threading.Lock()
+    for gid in range(num_groups):
+      self.groups[gid] = self._make_group(gid)
+    self._publish_telemetry()
+
+  # -- construction -----------------------------------------------------------
+
+  def _mesh_for(self, gid: int):
+    import jax
+    from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+    devs = jax.devices()
+    k = min(self.devices_per_group, len(devs))
+    start = (gid * k) % len(devs)
+    picked = [devs[(start + i) % len(devs)] for i in range(k)]
+    return mesh_lib.build_mesh(mesh_lib.MeshSpec(data=-1), devices=picked)
+
+  def _make_group(self, gid: int) -> TrainGroup:
+    from tensorflowonspark_tpu.parallel import sharding as SH
+    mesh = self._mesh_for(gid)
+    state, loss_fn = self.build_fn(mesh)
+    # donation off: the group re-reads its state at sync boundaries (to
+    # pack the params) after the loop call that produced it
+    loop = SH.make_train_loop(loss_fn, mesh, unroll=self.unroll,
+                              donate_state=False)
+    sync = GroupSyncClient(self.server.addr, gid,
+                           request_timeout=max(5.0, self.sync_timeout))
+    group = TrainGroup(gid, state, loop, sync)
+    # GROUP-verb join: a rendezvous request bounded by the client's
+    # request timeout, not a thread join
+    resp = sync.join()  # tosa: ignore[TOS001] - request-timeout bounded
+    payload = resp.get("payload")
+    if payload is not None:
+      # catch-up: a (re)admitted group adopts the collective's current
+      # weights and step so it rejoins at the next boundary as a peer
+      group.state = group.state.replace(
+          params=unpack_tree(payload, group.state.params))
+      group.steps = int(resp.get("step", 0))
+      self._event("catch-up", group=gid, step=group.steps)
+    return group
+
+  # -- events + telemetry -----------------------------------------------------
+
+  def _event(self, kind: str, **fields) -> None:
+    rec = dict(fields, event=kind, t=time.monotonic())
+    self.events.append(rec)
+    logger.info("groupset: %s %s", kind, fields)
+    rec_obs = obs_spans.active()
+    if rec_obs is not None:
+      rec_obs.event("groups." + kind,
+                    **{k: v for k, v in fields.items()
+                       if isinstance(v, (int, float, str, bool))})
+
+  def _drain_plane_events(self) -> None:
+    events = list(self.plane.events)
+    for rec in events[self._plane_events_seen:]:
+      kind = rec.get("event")
+      if kind in ("lost", "round"):
+        self._event("plane-" + kind,
+                    **{k: v for k, v in rec.items()
+                       if k not in ("event", "t")})
+    self._plane_events_seen = len(events)
+
+  def _publish_telemetry(self) -> None:
+    reg = obs_metrics.active()
+    if reg is None:
+      return
+    status = self.plane.status()
+    reg.gauge("training.groups_total").set(
+        max(status["groups_total"], len(self.groups)))
+    reg.gauge("training.groups_active").set(status["groups_active"])
+    if status["sync_ms"] is not None:
+      reg.gauge("training.sync_ms").set(status["sync_ms"])
+
+  # -- the per-group loop -----------------------------------------------------
+
+  def _group_main(self, g: TrainGroup, total_steps: int) -> None:
+    try:
+      while (g.alive and g.steps < total_steps
+             and not self._stop.is_set()):
+        verdict = chaos.group_fault(g.group_id)
+        if verdict == "kill":
+          # the whole group dies mid-training: no contribution, no
+          # goodbye — the plane discovers it via the round deadline
+          g.alive = False
+          g.exit_reason = "chaos-kill"
+          self._event("group-killed", group=g.group_id, step=g.steps)
+          return
+        import numpy as np
+        n = min(self.sync_every or total_steps, total_steps - g.steps)
+        for _ in range(n):
+          batch = self.batch_fn(g.group_id, g.steps)
+          g.state, losses = g.loop(g.state, batch)
+          g.steps += 1
+          g.losses.extend(float(v) for v in np.asarray(losses).reshape(-1))
+        if not self.sync_every:
+          continue          # sync disabled (single-group baseline)
+        rnd = g.steps // self.sync_every
+        t0 = time.monotonic()
+        try:
+          merged, members = g.sync.sync(
+              rnd, g.state.params, weight=n, step=g.steps,
+              timeout=self.sync_timeout + 10.0)
+        except GroupEvicted:
+          # marked lost while stalled/partitioned: stale weights were
+          # rejected — re-admit via join (adopting current weights+step)
+          resp = g.sync.join()  # tosa: ignore[TOS001] - request-timeout bounded
+          payload = resp.get("payload")
+          if payload is not None:
+            g.state = g.state.replace(
+                params=unpack_tree(payload, g.state.params))
+            g.steps = int(resp.get("step", g.steps))
+          self._event("group-readmitted", group=g.group_id, step=g.steps)
+          continue
+        except (TimeoutError, ConnectionError) as e:
+          g.alive = False
+          g.exit_reason = "sync-failed: %s" % e
+          self._event("group-sync-failed", group=g.group_id,
+                      step=g.steps, error=str(e))
+          return
+        g.sync_ms = (time.monotonic() - t0) * 1000.0
+        g.state = g.state.replace(params=merged)
+        self._event("sync", group=g.group_id, round=rnd, step=g.steps,
+                    denominator=len(members),
+                    sync_ms=round(g.sync_ms, 3))
+        self._drain_plane_events()
+        self._publish_telemetry()
+      if g.alive:
+        g.exit_reason = "completed"
+    except Exception as e:  # noqa: BLE001 - a group failure must surface
+      # as a lost group, never as a silent thread death
+      g.alive = False
+      g.exit_reason = "error: %s" % e
+      logger.exception("group %d failed", g.group_id)
+      self._event("group-error", group=g.group_id, error=str(e))
+
+  def run(self, total_steps: int) -> None:
+    """Start every group stepping toward ``total_steps`` (returns
+    immediately; :meth:`wait` joins)."""
+    self._total = int(total_steps)
+    for g in self.groups.values():
+      self._spawn(g)
+
+  def _spawn(self, g: TrainGroup) -> None:
+    g.thread = threading.Thread(
+        target=self._group_main, args=(g, self._total),
+        name="train-group-%d" % g.group_id, daemon=True)
+    g.thread.start()
+
+  def wait(self, timeout: float = 300.0) -> bool:
+    """Join all group threads (bounded). True when every thread ended."""
+    deadline = time.monotonic() + timeout
+    done = True
+    for g in list(self.groups.values()):
+      if g.thread is None:
+        continue
+      g.thread.join(max(0.0, deadline - time.monotonic()))
+      done = done and not g.thread.is_alive()
+    self._drain_plane_events()
+    self._publish_telemetry()
+    return done
+
+  def stop(self) -> None:
+    self._stop.set()
+
+  def close(self) -> None:
+    self.stop()
+    for g in self.groups.values():
+      g.sync.close()
+    if self._own_server:
+      self.server.stop()
+
+  # -- elasticity -------------------------------------------------------------
+
+  def readmit(self, gid: int) -> TrainGroup:
+    """Bring a lost (or brand-new) group back: build it fresh, pull the
+    current weights/step from the plane (the join catch-up), and start it
+    stepping toward the same target — it participates from the next sync
+    boundary. Scale-up (``grow``) is the same operation with a new id."""
+    with self._lock:
+      old = self.groups.get(gid)
+      if old is not None and old.thread is not None \
+          and old.thread.is_alive():
+        raise RuntimeError("group %d is still running" % gid)
+      g = self._make_group(gid)
+      self.groups[gid] = g
+    self._event("group-readmitted", group=gid, step=g.steps)
+    self._publish_telemetry()
+    if self._total is not None:
+      self._spawn(g)
+    return g
+
+  grow = readmit
+
+  def commit_shrink(self, gid: int, reason: str = "shrink committed") -> None:
+    """Give up on a group: evict it from the plane so rounds never wait
+    for it and its stale contributions are rejected."""
+    self.plane.mark_lost(gid, reason)
+    self._event("resize-shrink", group=gid, reason=reason)
+    self._drain_plane_events()
+    self._publish_telemetry()
+
+  def active_groups(self) -> List[int]:
+    return sorted(g.group_id for g in self.groups.values() if g.alive)
+
+  # -- checkpoint plane (topology-manifested save / resharding restore) -------
+
+  def _chief(self) -> TrainGroup:
+    alive = [g for g in self.groups.values() if g.alive]
+    if not alive:
+      raise RuntimeError("no live group to checkpoint")
+    return min(alive, key=lambda g: g.group_id)
+
+  def manifest(self) -> dict:
+    chief = self._chief()
+    return {"schema": 1, "kind": "groupset",
+            "num_groups": len(self.active_groups()),
+            "groups": self.active_groups(),
+            "step": chief.steps,
+            "sync_every": self.sync_every,
+            "sync_round": (chief.steps // self.sync_every
+                           if self.sync_every else 0)}
+
+  def save(self, mgr, force: bool = False) -> bool:
+    """Chief-group save with the group topology in the commit manifest.
+
+    Call at a sync boundary: post-sync, every group's params are the
+    merged weights, so the chief's state IS the collective state and any
+    future group count can restore from it (interchangeability again).
+    """
+    chief = self._chief()
+    saved = mgr.save(chief.steps, chief.state, force=force,
+                     manifest=self.manifest())
+    if saved:
+      self._event("checkpoint", step=chief.steps,
+                  groups=len(self.active_groups()))
+    return saved
+
+  def restore_or(self, mgr) -> int:
+    """Restore the latest committed checkpoint INTO THIS topology —
+    resharding across a different group count — and return the next
+    step (0 when starting fresh).
+
+    Every group adopts the restored state and step counter (data-parallel
+    groups hold replicated weights at boundaries, so a topology change is
+    a broadcast, not a re-partition); the plane is seeded so later
+    joiners catch up to the restored step, and ``batch_fn(group_id,
+    step)`` keying makes the feed position follow the step for free.
+    """
+    chief = self._chief()
+    state, next_step, manifest = mgr.restore_or(chief.state,
+                                                with_manifest=True)
+    if next_step == 0:
+      return 0
+    saved_step = next_step - 1
+    if manifest and manifest.get("num_groups") not in (
+        None, len(self.groups)):
+      logger.info(
+          "resharding checkpoint step %d across %d group(s) (saved with "
+          "%d)", saved_step, len(self.groups), manifest["num_groups"])
+    for g in self.groups.values():
+      g.state = state
+      g.steps = saved_step
+    self.plane.seed(saved_step, pack_tree(state.params))
+    self._event("restore", step=saved_step, groups=len(self.groups),
+                saved_groups=(manifest or {}).get("num_groups"))
+    return next_step

@@ -59,6 +59,18 @@ Env vars (all optional; absent ⇒ every hook is a no-op):
     (exercising ejection + cross-replica failover replay); ``stall``
     sleeps at the dispatch (a slow router hop). Without ``@replica``
     the nth count is global across all dispatches.
+
+``TOS_CHAOS_GROUP`` = ``"kill[@group][#nth]"`` or
+    ``"stall[@group][#nth]:seconds"`` (comma-separated)
+    Group-granularity fault for elastic multi-group training
+    (``parallel.groups`` consults :func:`group_fault` at each sync-round
+    boundary with the group id as index): ``kill`` stops that whole MESH
+    GROUP mid-training — it never contributes to the round, so the
+    surviving groups complete the sync with the denominator shrunk and
+    the plane marks it lost (exercising graceful degradation + resize /
+    re-admission); ``stall`` sleeps the group at the boundary (a slow or
+    partitioned group; exercising the sync deadline). Without ``@group``
+    the nth count is global across all boundary consults.
 """
 
 import logging
@@ -77,6 +89,7 @@ ENV_RV_DROP = "TOS_CHAOS_RV_DROP"
 ENV_RV_DELAY = "TOS_CHAOS_RV_DELAY"
 ENV_SERVE = "TOS_CHAOS_SERVE"
 ENV_FLEET = "TOS_CHAOS_FLEET"
+ENV_GROUP = "TOS_CHAOS_GROUP"
 
 
 class InjectedFault(RuntimeError):
@@ -90,7 +103,7 @@ _rv_counts = {}
 _lock = threading.Lock()
 
 _KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY, ENV_SERVE,
-              ENV_FLEET)
+              ENV_FLEET, ENV_GROUP)
 _ENV_PREFIX = "TOS_CHAOS_"
 #: cache of the last validated env signature (validation is consulted from
 #: hot paths like the rendezvous client's per-request chaos check)
@@ -171,6 +184,14 @@ def check_config() -> None:
                        "'point[@replica][#nth]:kill' or "
                        "'point[@replica][#nth]:stall:seconds')"
                        % (ENV_FLEET, spec))
+  for spec in _split_specs(os.environ.get(ENV_GROUP)):
+    try:
+      _parse_group_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed group spec %r (want "
+                       "'kill[@group][#nth]' or "
+                       "'stall[@group][#nth]:seconds')"
+                       % (ENV_GROUP, spec))
   _validated = sig
 
 
@@ -270,6 +291,25 @@ def _parse_serve_spec(spec: str):
 def _parse_fleet_spec(spec: str):
   """``"point[@replica][#nth]:kill"`` / ``"...:stall:seconds"``."""
   return _parse_action_spec(spec, "kill")
+
+
+def _parse_group_spec(spec: str):
+  """``"kill[@group][#nth]"`` / ``"stall[@group][#nth]:seconds"`` →
+  ((action, group_or_None, nth), seconds_or_None). The action leads (there
+  is only one injection point — the sync-round boundary — so no point name
+  to parse), reusing the ``@index``/``#nth`` suffix grammar."""
+  parts = spec.split(":")
+  target = _parse_point_spec(parts[0])
+  action = target[0]
+  if action == "kill":
+    if len(parts) != 1:
+      raise ValueError(spec)
+    return target, None
+  if action == "stall":
+    if len(parts) != 2:
+      raise ValueError(spec)
+    return target, float(parts[1])
+  raise ValueError(spec)
 
 
 def _sentinel_path(name: str, index) -> str:
@@ -422,6 +462,51 @@ def fleet_fault(name: str, index: Optional[int] = None) -> Optional[str]:
       continue
     logger.warning("chaos: kill verdict at fleet point %r replica %r "
                    "(occurrence %d)", name, index, nth)
+    return "kill"
+  return None
+
+
+def group_fault(index: Optional[int] = None) -> Optional[str]:
+  """Deterministic training-group fault site (``parallel.groups`` consults
+  at each sync-round boundary with the group id as ``index``): returns
+  ``"kill"`` when a ``TOS_CHAOS_GROUP`` kill spec matches this invocation
+  — the CALLER then stops that whole mesh group without contributing to
+  the round (the fault target is a group of devices, not the calling
+  thread, so this hook signals instead of raising — the fleet_fault
+  convention). Stall specs sleep inline at the boundary (a slow or
+  partitioned group, exercising the sync deadline) and return None, as
+  does a disarmed/unmatched consult.
+
+  Counters mirror :func:`fleet_fault`: a GLOBAL count over all boundary
+  consults (specs without ``@group``) and a per-group one (specs with it:
+  "this group's nth boundary").
+  """
+  _first_consult()
+  spec_env = os.environ.get(ENV_GROUP)
+  if not spec_env:
+    return None
+  check_config()
+  point = "group.sync"
+  with _lock:
+    gcount = _counts[(point, None)] = _counts.get((point, None), 0) + 1
+    icount = gcount
+    if index is not None:
+      icount = _counts[(point, index)] = \
+          _counts.get((point, index), 0) + 1
+  for spec in _split_specs(spec_env):
+    (action, sindex, nth), secs = _parse_group_spec(spec)
+    if sindex is None:
+      if gcount != nth:
+        continue
+    elif sindex != index or icount != nth:
+      continue
+    if action == "stall":
+      logger.warning("chaos: stalling %.2fs at sync boundary, group %r "
+                     "(occurrence %d)", secs, index, nth)
+      time.sleep(secs)
+      continue
+    logger.warning("chaos: kill verdict for training group %r "
+                   "(occurrence %d)", index, nth)
     return "kill"
   return None
 

@@ -7,11 +7,21 @@ JAX path a ready-made manager: periodic saves keyed by step, latest-step
 restore for resume-after-preemption, retention, and chief-only writes.
 """
 
+import json
 import logging
 import os
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
+
+#: commit-marker file written next to each step after its save is durable.
+#: Its presence IS the commit record: ``restore_or`` rejects a step with no
+#: marker deterministically (torn save) instead of discovering the tear via
+#: a deserialize failure, and its JSON body carries the save's manifest
+#: (e.g. the elastic-training group topology — ``parallel.groups``).
+_MARKER_FMT = ".commit-%d.json"
+_MARKER_PREFIX = ".commit-"
+_MARKER_SUFFIX = ".json"
 
 
 class CheckpointManager(object):
@@ -41,7 +51,10 @@ class CheckpointManager(object):
     from tensorflowonspark_tpu.utils import paths
 
     self.directory = paths.for_io(directory)
-    if not paths.is_remote_uri(self.directory):
+    # commit markers are plain files: local directories only (remote URIs
+    # keep the legacy deserialize-failure fallback in restore_or)
+    self._local = not paths.is_remote_uri(self.directory)
+    if self._local:
       os.makedirs(self.directory, exist_ok=True)
     self.save_interval_steps = save_interval_steps
     self._mgr = ocp.CheckpointManager(
@@ -51,7 +64,8 @@ class CheckpointManager(object):
             save_interval_steps=save_interval_steps))
 
   def save(self, step: int, state: Any, is_chief: bool = True,
-           force: bool = False, data_state: Optional[dict] = None) -> bool:
+           force: bool = False, data_state: Optional[dict] = None,
+           manifest: Optional[dict] = None) -> bool:
     """Save if the step hits the interval.
 
     ``data_state`` (a small JSON-safe dict, e.g.
@@ -103,8 +117,88 @@ class CheckpointManager(object):
       saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
                              force=True)
     if saved:
+      self._write_marker(step, manifest)
       logger.info("checkpoint saved at step %d", step)
     return saved
+
+  # -- commit markers (deterministic torn-save detection) ---------------------
+
+  def _marker_path(self, step: int) -> str:
+    return os.path.join(self.directory, _MARKER_FMT % step)
+
+  def _write_marker(self, step: int, manifest: Optional[dict]) -> None:
+    """Commit the save: wait for the (possibly async) write to be durable,
+    then publish the marker via write-to-temp + atomic rename. A kill at
+    any point leaves either no marker (torn save, rejected at restore) or
+    a complete one — never a half-written marker next to half-written
+    data. ``manifest`` (small, JSON-safe — e.g. the group topology from
+    ``parallel.groups.GroupSet.save``) rides in the marker body."""
+    if not self._local:
+      return
+    self._mgr.wait_until_finished()
+    path = self._marker_path(step)
+    tmp = path + ".tmp"
+    try:
+      with open(tmp, "w") as f:
+        json.dump({"step": int(step), "manifest": manifest or {}}, f)
+        f.flush()
+        os.fsync(f.fileno())
+      os.replace(tmp, path)
+    except OSError as e:
+      # the data is durable; a marker-write failure must not fail the save
+      # (the step merely restores via nothing — same as a torn save)
+      logger.warning("commit marker for step %d failed: %s", step, e)
+      return
+    # retention pruning: drop markers whose step orbax already deleted
+    live = set(self._mgr.all_steps())
+    try:
+      names = os.listdir(self.directory)
+    except OSError:
+      return
+    for name in names:
+      if not (name.startswith(_MARKER_PREFIX)
+              and name.endswith(_MARKER_SUFFIX)):
+        continue
+      try:
+        s = int(name[len(_MARKER_PREFIX):-len(_MARKER_SUFFIX)])
+      except ValueError:
+        continue
+      if s not in live:
+        try:
+          os.remove(os.path.join(self.directory, name))
+        except OSError:  # tosa: ignore[TOS004] - retention pruning is
+          pass           # best-effort; a leftover marker is harmless
+
+  def _has_markers(self) -> bool:
+    """True when this directory uses commit markers at all (any step has
+    one). Marker-free directories predate the marker scheme and keep the
+    legacy deserialize-failure fallback."""
+    if not self._local:
+      return False
+    try:
+      return any(n.startswith(_MARKER_PREFIX) and n.endswith(_MARKER_SUFFIX)
+                 for n in os.listdir(self.directory))
+    except OSError:
+      return False
+
+  def _read_marker(self, step: int) -> Optional[dict]:
+    """The step's commit record, or None (missing or unparseable — both
+    mean the save never committed)."""
+    if not self._local:
+      return None
+    try:
+      with open(self._marker_path(step)) as f:
+        return json.load(f)
+    except (OSError, ValueError):
+      return None
+
+  def manifest(self, step: Optional[int] = None) -> Optional[dict]:
+    """The manifest committed with ``step`` (default: latest), or None."""
+    step = step if step is not None else self._mgr.latest_step()
+    if step is None:
+      return None
+    rec = self._read_marker(step)
+    return rec.get("manifest") if rec else None
 
   def _due(self, step: int) -> bool:
     """True when ``step`` reached/crossed an interval boundary since the
@@ -171,34 +265,54 @@ class CheckpointManager(object):
       data = None
     return state, data
 
-  def restore_or(self, state: Any, data_iterator: Any = None):
+  def restore_or(self, state: Any, data_iterator: Any = None,
+                 with_manifest: bool = False):
     """(state, next_step): restored latest if present, else the input.
 
     With ``data_iterator`` (anything exposing ``set_state``, e.g.
     ``CheckpointableInput``), a checkpointed input-pipeline state is
-    pushed into it so the stream resumes mid-epoch.
+    pushed into it so the stream resumes mid-epoch. With
+    ``with_manifest=True`` the return is ``(state, next_step, manifest)``
+    — the commit marker's manifest dict (None when absent or fresh).
 
     Preemption-safe: this is the resume entry point for a node relaunched
     after a SIGKILL/preemption (the supervisor hands the restart count to
-    the user fn via ``ctx.restart_count``). A checkpoint left unreadable
-    by a kill mid-save — orbax commits atomically, but storage layers lie
-    — is skipped with a warning, falling back to the newest step that
-    restores cleanly rather than wedging the relaunched node forever.
+    the user fn via ``ctx.restart_count``). In a directory that carries
+    commit markers, a step with NO marker never committed — it is
+    rejected deterministically, without a restore attempt whose failure
+    mode depends on how the storage layer surfaces the tear. Marker-free
+    (legacy) directories keep the old behavior: a checkpoint left
+    unreadable by a kill mid-save is skipped with a warning after its
+    deserialize fails, falling back to the newest step that restores
+    cleanly rather than wedging the relaunched node forever.
     """
     step = self._mgr.latest_step()
     last_error = None
+    markers = self._has_markers()
     while step is not None:
+      if markers and self._read_marker(step) is None:
+        logger.warning("checkpoint step %d has no commit marker (torn "
+                       "save); rejecting it without a restore attempt", step)
+        last_error = RuntimeError(
+            "checkpoint step %d in %s has no commit marker"
+            % (step, self.directory))
+        older = [s for s in self._mgr.all_steps() if s < step]
+        step = max(older) if older else None
+        continue
       logger.info("resuming from checkpoint step %d", step)
       try:
         if data_iterator is None:
-          return self.restore(state, step=step), step + 1
-        restored, data = self.restore(state, step=step, with_data=True)
-        if data is not None:
-          data_iterator.set_state(data)
+          restored = self.restore(state, step=step)
         else:
-          logger.warning("checkpoint step %d has no input-pipeline state; "
-                         "the data iterator starts from its current position",
-                         step)
+          restored, data = self.restore(state, step=step, with_data=True)
+          if data is not None:
+            data_iterator.set_state(data)
+          else:
+            logger.warning("checkpoint step %d has no input-pipeline state; "
+                           "the data iterator starts from its current "
+                           "position", step)
+        if with_manifest:
+          return restored, step + 1, self.manifest(step)
         return restored, step + 1
       except Exception as e:  # noqa: BLE001 - torn/corrupt checkpoint
         logger.warning("checkpoint step %d unreadable (%s: %s); trying the "
@@ -211,7 +325,7 @@ class CheckpointManager(object):
       # mismatch, storage outage, bad credentials), not a torn checkpoint
       # — silently retraining from step 0 would discard real progress
       raise last_error
-    return state, 0
+    return (state, 0, None) if with_manifest else (state, 0)
 
   def all_steps(self):
     """Every step with a checkpoint in this directory (ascending)."""

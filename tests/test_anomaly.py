@@ -488,6 +488,69 @@ class TestFleetDetectors:
     assert [a["alert"] for a in alerts] == ["fleet_degraded"]
 
 
+class TestGroupDetectors:
+  def test_group_lost_fires_below_full_strength(self):
+    """An elastic GroupSet running fewer active groups than it has ever
+    had = a group died or was evicted (parallel.groups) — surviving
+    groups keep stepping degraded, and that must be visible online."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, training__groups_total=3, training__groups_active=3)
+    det.poll(now=0.0)
+    sink.set(0, training__groups_total=3, training__groups_active=2)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["group_lost"]
+    assert alerts[0]["evidence"]["groups_active"] == 2
+    assert alerts[0]["evidence"]["groups_total"] == 3
+    assert "re-admit" in alerts[0]["message"]
+
+  def test_full_group_strength_stays_quiet(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, training__groups_total=3, training__groups_active=3,
+             training__sync_ms=40.0)
+    det.poll(now=0.0)
+    sink.set(0, training__groups_total=3, training__groups_active=3,
+             training__sync_ms=40.0)
+    assert det.poll(now=10.0) == []
+
+  def test_sync_lag_fires_at_threshold(self):
+    """A sync round that ran at/over TOS_OBS_SYNC_LAG_MS means a slow or
+    stalled group is dragging every boundary toward the round deadline
+    (and past the miss limit the plane will evict it)."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    det.sync_lag_ms = 100.0
+    sink.set(0, training__groups_total=2, training__groups_active=2,
+             training__sync_ms=10.0)
+    det.poll(now=0.0)
+    sink.set(0, training__groups_total=2, training__groups_active=2,
+             training__sync_ms=150.0)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["sync_lag"]
+    assert alerts[0]["evidence"]["sync_ms"] == 150.0
+    assert alerts[0]["evidence"]["threshold_ms"] == 100.0
+
+  def test_sync_lag_below_threshold_stays_quiet(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    det.sync_lag_ms = 100.0
+    sink.set(0, training__groups_total=2, training__groups_active=2,
+             training__sync_ms=10.0)
+    det.poll(now=0.0)
+    sink.set(0, training__groups_total=2, training__groups_active=2,
+             training__sync_ms=99.0)
+    assert det.poll(now=10.0) == []
+
+  def test_ungrouped_executor_is_exempt(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, train__steps=1)
+    det.poll(now=0.0)
+    sink.set(0, train__steps=2)
+    assert det.poll(now=10.0) == []
+
+
 class TestMemorySlopeDetector:
   def test_fires_on_monotonic_creep(self):
     sink = FakeSink(eids=(0,))

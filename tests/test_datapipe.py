@@ -409,8 +409,15 @@ class TestTrainLoopIntegration:
 
 
 class TestExecutor:
+  @pytest.mark.slow
   def test_autotuner_adds_worker_to_hot_stage_order_pinned(self,
                                                            monkeypatch):
+    # Marked slow (tier-1 budget audit): the assertion that the tuner
+    # OBSERVES a hot stage within the run is wall-clock-sampled and
+    # flakes when the shared CI box is saturated; the autotuned graph's
+    # determinism + parity stay tier-1-pinned via the feed_bench --graph
+    # smoke and test_autotune_off_keeps_declared_plan. Runs in
+    # `make test`.
     monkeypatch.setenv(datapipe.ENV_DATA_AUTOTUNE_INTERVAL, "0.05")
     chunks = [[(np.full(8, 16 * c + i, np.float32), 16 * c + i)
                for i in range(16)] for c in range(60)]

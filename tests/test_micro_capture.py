@@ -185,7 +185,13 @@ def test_bank_fallback_emits_banked_value(tmp_path, fresh_ts):
   assert "REAL-CHIP" in out["note"]
 
 
+@pytest.mark.slow
 def test_stale_bank_is_refused(tmp_path):
+  # Marked slow (tier-1 budget audit): every _run_bench pays the fixed
+  # ~10 s capture-window subprocess; tier-1 keeps the fallback happy
+  # path (test_bank_fallback_emits_banked_value) and the no-bank
+  # failure exit (test_no_bank_plain_failure) — staleness refusal and
+  # the extras-only branch run via `make test`.
   rc, out = _run_bench(tmp_path, bank={
       "value": 321.5, "value_captured": "2026-07-01T00:00:00"})
   assert rc == 3
@@ -193,7 +199,9 @@ def test_stale_bank_is_refused(tmp_path):
   assert "preflight failed" in out["note"]
 
 
+@pytest.mark.slow
 def test_extras_only_bank_keeps_failure_exit(tmp_path, fresh_ts):
+  # Marked slow: see test_stale_bank_is_refused.
   rc, out = _run_bench(tmp_path, bank={
       "extra": {"transformer_tokens_per_sec": 9},
       "extra_captured": fresh_ts})

@@ -126,6 +126,71 @@ class TestCheckpointManager:
     assert step == 0
     np.testing.assert_allclose(np.asarray(state["w"]), [1, 1])
 
+  def test_torn_save_without_marker_restores_previous_step(self, tmp_path):
+    """The commit-marker contract: a step directory with no
+    ``.commit-<step>.json`` never committed (the process died between the
+    data write and the marker rename) — restore_or rejects it
+    DETERMINISTICALLY (no restore attempt, no dependence on how the
+    storage layer surfaces the tear) and falls back to the newest step
+    whose marker exists, even when the torn data itself is unreadable."""
+    import jax.numpy as jnp
+    from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "torn"), save_interval_steps=1,
+                            max_to_keep=3)
+    for step in (1, 2):
+      assert mgr.save(step, {"w": jnp.full(4, float(step))}, is_chief=True)
+    mgr.wait()
+    # simulate the kill between data write and marker publish: drop the
+    # marker AND truncate a data file so step 2 is genuinely torn
+    os.remove(str(tmp_path / "torn" / ".commit-2.json"))
+    for root, _, names in os.walk(str(tmp_path / "torn" / "2")):
+      for name in names:
+        p = os.path.join(root, name)
+        if os.path.getsize(p):
+          with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+
+    restored, next_step = CheckpointManager(
+        str(tmp_path / "torn"), save_interval_steps=1).restore_or(
+            {"w": jnp.zeros(4)})
+    assert next_step == 2, "the unmarked (torn) step must be rejected"
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.ones(4))
+
+  def test_marker_free_legacy_directory_keeps_fallback(self, tmp_path):
+    """Directories written before the marker scheme have no markers at
+    all — they must keep the legacy behavior (restore the newest step;
+    deserialize-failure fallback) instead of rejecting every step."""
+    import jax.numpy as jnp
+    from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "old"), save_interval_steps=1)
+    assert mgr.save(3, {"w": jnp.arange(4.0)}, is_chief=True)
+    mgr.wait()
+    for name in os.listdir(str(tmp_path / "old")):
+      if name.startswith(".commit-"):
+        os.remove(str(tmp_path / "old" / name))
+
+    restored, next_step = CheckpointManager(
+        str(tmp_path / "old"), save_interval_steps=1).restore_or(
+            {"w": jnp.zeros(4)})
+    assert next_step == 4
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(4.0))
+
+  def test_manifest_rides_the_commit_marker(self, tmp_path):
+    import jax.numpy as jnp
+    from tensorflowonspark_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "mf"), save_interval_steps=1)
+    assert mgr.save(5, {"w": jnp.zeros(2)}, is_chief=True,
+                    manifest={"num_groups": 2, "groups": [0, 1]})
+    mgr.wait()
+    reader = CheckpointManager(str(tmp_path / "mf"), save_interval_steps=1)
+    assert reader.manifest() == {"num_groups": 2, "groups": [0, 1]}
+    _, next_step, manifest = reader.restore_or({"w": jnp.zeros(2)},
+                                               with_manifest=True)
+    assert next_step == 6 and manifest["num_groups"] == 2
+
   def test_sharded_state_roundtrip_preserves_layout(self, tmp_path):
     """Checkpoint/resume for the multi-chip path: a mesh-sharded TrainState
     saves and restores with values AND shardings intact (preemption

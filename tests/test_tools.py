@@ -3,6 +3,8 @@
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -76,13 +78,19 @@ class TestBenchWatchParse:
 
 
 class TestServeBenchCompareSmoke:
+  @pytest.mark.slow
   def test_compare_smoke_runs_and_holds_parity(self):
     """`serve_bench --compare --smoke` drives the REAL continuous-batching
     engine vs the static fixed-batch loop on CPU: the bench path is
     tier-1-covered (like feed_bench), and the engine's bit-parity with
     single-request decodes is re-verified on every CI run. The speedup
     itself is a chip/shape question the full run answers — the smoke
-    shape is dispatch-dominated, so only parity and shape are asserted."""
+    shape is dispatch-dominated, so only parity and shape are asserted.
+
+    Marked slow (tier-1 budget audit): ~20 s subprocess, and the prefix
+    smoke below gates the same bench path's parity PER STAGE including
+    the baseline and full-stack legs — this compare leg is a subset;
+    still runs via `make test` / `make serve-bench`."""
     import json
     import os
     import subprocess
@@ -335,6 +343,35 @@ class TestTrainBenchSmoke:
     assert result["speedup_median"] > 0
     assert len(result["speedup_reps"]) == result["reps"]
     assert result["unroll"] == 8
+
+  def test_groups_smoke_holds_interchangeability(self):
+    """`train_bench --groups --smoke` drives the REAL elastic-groups
+    runtime (parallel.groups.GroupSet over a live rendezvous sync plane)
+    on CPU: paired no-sync vs synced reps, with the interchangeability
+    contract (bit-identical post-sync params across groups) re-verified
+    on every CI run. The overhead number is a shape question the full
+    `make train-bench-groups` run answers."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "train_bench.py"),
+         "--groups", "2", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "train_groups_sync_overhead"
+    assert result["params_identical_after_sync"] is True
+    assert result["groups"] == 2
+    assert result["sync_rounds"] > 0
+    assert result["nosync_steps_per_sec"] > 0
+    assert result["synced_steps_per_sec"] > 0
 
 
 class TestFeedBenchGraphSmoke:

@@ -166,6 +166,11 @@ def build_snapshot(reply, prev=None, dt=0.0):
         "fleet_failovers": m.get("fleet.failovers"),
         "fleet_ejections": m.get("fleet.ejections"),
         "fleet_swaps": m.get("fleet.swaps"),
+        # elastic multi-group training telemetry (parallel.groups):
+        # group strength + last cross-group sync round latency
+        "groups_active": m.get("training.groups_active"),
+        "groups_total": m.get("training.groups_total"),
+        "sync_ms": m.get("training.sync_ms"),
         "mem_in_use": m.get("device.bytes_in_use"),
         "mem_peak": m.get("device.peak_bytes"),
         "compiles": m.get("xla.compiles"),
@@ -179,7 +184,28 @@ def build_snapshot(reply, prev=None, dt=0.0):
           # fast/slow burn rates and the burning flag — served computed,
           # so the monitor renders without re-deriving window math
           "slo": reply.get("slo"),
+          # the sync plane's own status rides the HEALTH reply too
+          # (control.rendezvous attaches SyncPlane.status() when a plane
+          # is attached): group membership, round/step, lost set
+          "groups": reply.get("groups"),
           "has_obs": bool(obs), "has_alert_ring": alerts is not None}
+
+
+def _fmt_groups(grp):
+  """One compact ``groups[...]`` line from the HEALTH-wire sync-plane
+  status (``parallel.groups.SyncPlane.status``): group strength, the
+  current round/step, last round's merge latency — and the lost set by
+  id, so the operator knows exactly which group to re-admit."""
+  parts = ["%d/%d act" % (grp.get("groups_active") or 0,
+                          grp.get("groups_total") or 0),
+           "round %d" % (grp.get("round") or 0),
+           "step %d" % (grp.get("step") or 0)]
+  if grp.get("sync_ms") is not None:
+    parts.append("sync %.0fms" % grp["sync_ms"])
+  lost = grp.get("lost") or {}
+  if lost:
+    parts.append("lost " + ",".join(str(g) for g in sorted(lost)))
+  return "groups[" + " | ".join(parts) + "]"
 
 
 def _fmt_slo(slo):
@@ -274,6 +300,14 @@ def render(snap, clear=True):
                 (("ej", "fleet_ejections"), ("fo", "fleet_failovers"),
                  ("swap", "fleet_swaps")) if row.get(key))
       feed += "  fleet[" + " ".join(fl) + "]"
+    if row.get("groups_total"):
+      # elastic training group strength (N/M < full = a group is lost
+      # and the sync denominator shrank) + last round's merge latency
+      gl = ["%d/%d act" % (row.get("groups_active") or 0,
+                           row["groups_total"])]
+      if row.get("sync_ms") is not None:
+        gl.append("sync %.0fms" % row["sync_ms"])
+      feed += "  groups[" + " ".join(gl) + "]"
     lines.append(
         "%-4s %-9s %8s %8s %6s %6s %9s %8s %7s %7s%s" % (
             eid, row["state"] or "?",
@@ -297,6 +331,10 @@ def render(snap, clear=True):
     if line:
       lines.append("")
       lines.append(line)
+  grp = snap.get("groups")
+  if grp:
+    lines.append("")
+    lines.append(_fmt_groups(grp))
   alerts = snap.get("alerts") or []
   lines.append("")
   if alerts:

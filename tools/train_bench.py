@@ -29,9 +29,19 @@ speedup; core pinning keeps XLA on one core. Prints ONE JSON line;
 ``--json-out`` additionally writes it to a file and appends a
 ``train_bench`` series line to ``bench_artifacts/history.jsonl``.
 
+``--groups N`` switches to the elastic-groups bench
+(`parallel.groups.GroupSet`): each paired rep runs N groups WITHOUT
+cross-group sync (``sync_every=0``) then N groups syncing every
+``--unroll`` steps — same thread count and same compute on both sides,
+so the ratio isolates what the sync plane (pack + wire + weighted merge
++ poll) costs per step. Both sides pay per-group compile inside the
+timed window — paired, so it dilutes (never inflates) the measured
+overhead. The synced side also re-verifies interchangeability: after
+the final boundary every group's params must be bit-identical.
+
 Usage:  python tools/train_bench.py [--steps 320] [--batch 16]
                                     [--unroll 8] [--reps 3] [--smoke]
-                                    [--json-out PATH]
+                                    [--groups N] [--json-out PATH]
 """
 
 import argparse
@@ -134,6 +144,137 @@ def run_pair(hidden, batch, unroll, steps):
   return rate1, ratek, traj1 == trajk
 
 
+def _groups_harness(hidden: int, batch: int, seed: int = 0):
+  """``build_fn``/``batch_fn`` pair for the GroupSet bench: the same MLP
+  as the fusion bench, per-group deterministic data keyed by
+  ``(group_id, step)`` (the GroupSet data-position contract)."""
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from flax import linen as nn
+  from flax.training import train_state
+
+  class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+      x = nn.Dense(hidden)(x)
+      x = nn.relu(x)
+      return nn.Dense(10)(x)
+
+  model = MLP()
+  params0 = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 784)))["params"]
+
+  def build_fn(mesh):
+    del mesh  # single-device groups: the loop handles placement
+    params = jax.tree.map(jnp.array, params0)
+    state = train_state.TrainState.create(apply_fn=model.apply,
+                                          params=params, tx=optax.sgd(0.01))
+
+    def loss_fn(p, b):
+      logits = model.apply({"params": p}, b["x"])
+      return optax.softmax_cross_entropy_with_integer_labels(
+          logits, b["y"]).mean()
+
+    return state, loss_fn
+
+  def batch_fn(group_id, step):
+    rng = np.random.RandomState(seed + 7919 * group_id + step)
+    return {"x": rng.rand(batch, 784).astype("float32"),
+            "y": rng.randint(0, 10, batch).astype("int32")}
+
+  return build_fn, batch_fn
+
+
+def run_groups_pair(hidden, batch, num_groups, sync_every, steps):
+  """One paired rep: N groups no-sync, then N groups syncing every
+  ``sync_every`` steps. Returns (nosync steps/s, synced steps/s,
+  plane status, params-identical-after-final-sync)."""
+  from tensorflowonspark_tpu.parallel import groups as G
+
+  def timed(se):
+    build_fn, batch_fn = _groups_harness(hidden, batch)
+    gs = G.GroupSet(build_fn, batch_fn, num_groups=num_groups,
+                    sync_every=se, sync_timeout=30.0)
+    try:
+      t0 = time.perf_counter()
+      gs.run(steps)
+      if not gs.wait(timeout=600.0):
+        raise RuntimeError("group threads did not finish within 600s")
+      dt = time.perf_counter() - t0
+      stuck = [g.group_id for g in gs.groups.values()
+               if g.exit_reason != "completed"]
+      if stuck:
+        raise RuntimeError("group(s) %s did not complete cleanly" % stuck)
+      status = gs.plane.status()
+      packed = [G.pack_tree(g.state.params) for g in gs.groups.values()]
+      identical = all(
+          all(a["data"] == b["data"] for a, b in zip(packed[0], p))
+          for p in packed[1:])
+      return num_groups * steps / dt, status, identical
+    finally:
+      gs.close()
+
+  rate0, _, _ = timed(0)
+  rate1, status, identical = timed(sync_every)
+  return rate0, rate1, status, identical
+
+
+def run_groups_main(args):
+  """The ``--groups`` entry point: paired no-sync vs synced reps."""
+  nosync, synced, overheads = [], [], []
+  identical = True
+  status = {}
+  for _ in range(max(1, args.reps)):
+    r0, r1, status, ident = run_groups_pair(
+        args.hidden, args.batch, args.groups, args.unroll, args.steps)
+    nosync.append(r0)
+    synced.append(r1)
+    overheads.append((r0 / r1 - 1.0) * 100.0)
+    identical = identical and ident
+
+  result = {
+      "metric": "train_groups_sync_overhead",
+      "groups": args.groups,
+      "sync_every": args.unroll,
+      "overhead_pct_median": round(_median(overheads), 2),
+      "overhead_pct_reps": [round(o, 2) for o in overheads],
+      "nosync_steps_per_sec": round(_median(nosync), 2),
+      "synced_steps_per_sec": round(_median(synced), 2),
+      "sync_rounds": status.get("rounds_completed"),
+      "last_sync_ms": status.get("sync_ms"),
+      "params_identical_after_sync": identical,
+      "batch": args.batch,
+      "hidden": args.hidden,
+      "steps": args.steps,
+      "reps": args.reps,
+      "obs": int(obs_metrics.enabled()),
+      "note": "overhead = extra wall per optimizer step the cross-group "
+              "sync plane costs vs the same N groups with sync disabled, "
+              "per PAIRED rep, median rep reported; compile time rides "
+              "both sides (dilutes, never inflates); "
+              "params_identical_after_sync re-verifies group "
+              "interchangeability at the final boundary.",
+  }
+  line = json.dumps(result)
+  print(line)
+  if not identical:
+    sys.stderr.write("GROUP PARAMS DIVERGED AFTER FINAL SYNC\n")
+    return 1
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    bench_history.append_record(
+        "train_bench_groups", result["overhead_pct_median"],
+        "g%d-e%d-b%d-h%d-s%d" % (args.groups, args.unroll, args.batch,
+                                 args.hidden, args.steps),
+        extra={"synced_steps_per_sec": result["synced_steps_per_sec"],
+               "nosync_steps_per_sec": result["nosync_steps_per_sec"],
+               "obs": result["obs"]})
+  return 0
+
+
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument("--steps", type=int, default=320,
@@ -144,6 +285,10 @@ def main():
                   help="fused steps per dispatch (the K under test)")
   ap.add_argument("--reps", type=int, default=3,
                   help="paired repetitions (median rep reported)")
+  ap.add_argument("--groups", type=int, default=0, metavar="N",
+                  help="elastic-groups mode: cross-group sync overhead "
+                       "with N groups syncing every --unroll steps "
+                       "(0 = fusion bench)")
   ap.add_argument("--smoke", action="store_true",
                   help="tiny run (CPU CI / plumbing check)")
   ap.add_argument("--json-out", default=None,
@@ -158,6 +303,8 @@ def main():
     # price the device tier exactly like an obs-enabled cluster process
     from tensorflowonspark_tpu.obs import device as obs_device
     obs_device.install_compile_listener()
+  if args.groups:
+    return run_groups_main(args)
 
   per_step, fused, speedups = [], [], []
   parity = True
