@@ -9,7 +9,8 @@ PY ?= python
 	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
 	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke \
 	feed-bench-graph feed-bench-graph-smoke slo-smoke elastic-chaos \
-	train-bench-groups train-bench-groups-smoke
+	train-bench-groups train-bench-groups-smoke deploy-chaos \
+	serve-bench-deploy serve-bench-deploy-smoke
 
 # the end-of-round ritual: lint gate + full suite + multichip dryrun +
 # deviceless Mosaic-lowering gate (real TPU kernel compile, no chip)
@@ -116,7 +117,8 @@ train-bench-groups-smoke:
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
 check: analyze obs-smoke obs-top-smoke slo-smoke train-bench-smoke \
 	fleet-chaos serve-bench-fleet-smoke feed-bench-graph-smoke \
-	elastic-chaos train-bench-groups-smoke
+	elastic-chaos train-bench-groups-smoke deploy-chaos \
+	serve-bench-deploy-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \
 	  tests/test_misc.py -q
 
@@ -153,6 +155,28 @@ serve-bench-fleet:
 serve-bench-fleet-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  $(PY) tools/serve_bench.py --fleet --smoke
+
+# continuous-deployment fault injection only (TOS_CHAOS_DEPLOY):
+# controller killed at canary/promote/rollback boundaries + poisoned
+# candidates, registry torn publish — docs/ROBUSTNESS.md §Continuous
+# deployment; tier-1 (not slow)
+deploy-chaos:
+	$(PY) -m pytest tests/test_deploy.py -q -m chaos
+
+# the full train→serve rollout drive: registry publish → canary →
+# verify → promote with a chaos kill mid-promote (resume converges,
+# zero-shed + version consistency + parity gated) plus a poisoned
+# candidate quarantined by VERIFY; writes the artifact + a
+# serve_bench_deploy history line
+serve-bench-deploy:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --deploy \
+	  --json-out bench_artifacts/serve_bench_deploy.json
+
+# deploy plumbing check: tiny registry + fleet + controller, all gates
+serve-bench-deploy-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --deploy --smoke
 
 # degraded goodput + recovery latency under injected serving faults,
 # paired against a clean pass (parity re-verified); writes the artifact

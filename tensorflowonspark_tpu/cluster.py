@@ -484,6 +484,9 @@ class TPUCluster(object):
       slo = self.detector.slo_status()
       if slo is not None:
         out["slo"] = slo
+      dep = self.detector.deploy_status()
+      if dep is not None:
+        out["deploy"] = dep
     return out
 
   def slo_status(self) -> Optional[dict]:
@@ -493,6 +496,15 @@ class TPUCluster(object):
     if self.detector is None:
       return None
     return self.detector.slo_status()
+
+  def deploy_status(self) -> Optional[dict]:
+    """Live continuous-deployment state (``serving.deploy`` gauges as
+    sampled by the detector; None when the obs plane/detector is off or
+    no controller has shipped ``deploy.*`` yet) — which version serves,
+    which candidate is canarying, how many rollbacks."""
+    if self.detector is None:
+      return None
+    return self.detector.deploy_status()
 
   @staticmethod
   def _span(name: str, **attrs):

@@ -615,6 +615,18 @@ class Server(MessageSocket):
           except Exception as e:  # noqa: BLE001 - reply stays slo-free
             self.health_obs_failures += 1
             logger.warning("slo status for HEALTH failed: %s", e)
+        # the deploy plane's live state (serving.deploy gauges via the
+        # detector's samples): which version serves, which canaries —
+        # same best-effort contract
+        deploy_fn = getattr(alerts, "deploy_status", None)
+        if deploy_fn is not None:
+          try:
+            dep = deploy_fn()
+            if dep is not None:
+              reply["deploy"] = dep
+          except Exception as e:  # noqa: BLE001 - reply stays deploy-free
+            self.health_obs_failures += 1
+            logger.warning("deploy status for HEALTH failed: %s", e)
       plane = self.sync_plane
       if plane is not None:
         # elastic-training topology (groups active/lost, sync latency) —

@@ -1435,25 +1435,41 @@ def make_serving_predict_fn(cfg: TransformerConfig, num_steps: int,
   def _engine(params):
     # cache OUTSIDE the closure, reached via an IMPORT at call time (the
     # _SERVING_MESH_CACHE pickling rationale). One engine per serving
-    # config; rebuilt if the caller serves a different param tree.
+    # config AND param CONTENT; rebuilt if the caller serves a different
+    # param tree.
     import tensorflowonspark_tpu.models.transformer as _self
     from tensorflowonspark_tpu.serving import ServingEngine
-    # identity check on params is stable within a serving process:
-    # pipeline.load_bundle memoizes (params, predict_fn) per export_dir,
-    # so every transform partition hands back the SAME pytree object
-    key = (cfg, num_steps, eos_id, pad_id, num_slots, repr(mesh_spec),
-           None if mesh is None else id(mesh))
+    from tensorflowonspark_tpu.utils.checkpoint import params_fingerprint
+    # the key carries a CONTENT fingerprint of the params, not an object
+    # identity: a republished model of the same shape (the registry
+    # continuous-deployment loop re-serving a bundle after a new version
+    # lands) previously hit the config-only key and served STALE weights
+    # from the cached engine whenever the new tree aliased the old one.
+    # Fingerprinting is one pass over the leaves — amortized across the
+    # whole ragged partition a cache hit serves. The identity fast path
+    # stays: pipeline.load_bundle memoizes (params, predict_fn) per
+    # export_dir, so steady-state serves hand back the SAME pytree
+    # object and skip the hash entirely.
+    cfg_key = (cfg, num_steps, eos_id, pad_id, num_slots, repr(mesh_spec),
+               None if mesh is None else id(mesh))
+    for k, (p, eng) in list(_self._SERVING_ENGINE_CACHE.items()):
+      if k[:len(cfg_key)] == cfg_key and p is params and eng.alive:
+        return eng
+    key = cfg_key + (params_fingerprint(params),)
     cached = _self._SERVING_ENGINE_CACHE.get(key)
     # a dead engine (loop thread died on an error) must be rebuilt, not
     # returned — otherwise one bad batch poisons ragged serving forever
-    if cached is not None and cached[0] is params and cached[1].alive:
+    if cached is not None and cached[1].alive:
       return cached[1]
-    if cached is not None:
-      # rolling rebuild: drain finishes every request the old engine
-      # already accepted (bounded), THEN stops it — in-flight work from
-      # concurrent transform partitions is never shed. A dead engine
-      # drains instantly (its loop cannot make progress).
-      cached[1].drain(timeout=_self._SERVING_ENGINE_DRAIN_TIMEOUT)
+    # retire every engine under this serving config (the stale version
+    # AND any dead same-version entry): drain finishes every request the
+    # old engine already accepted (bounded), THEN stops it — in-flight
+    # work from concurrent transform partitions is never shed. A dead
+    # engine drains instantly (its loop cannot make progress).
+    for k in [k for k in _self._SERVING_ENGINE_CACHE
+              if k == key or k[:len(cfg_key)] == cfg_key]:
+      _self._SERVING_ENGINE_CACHE.pop(k)[1].drain(
+          timeout=_self._SERVING_ENGINE_DRAIN_TIMEOUT)
     # admission bounds OFF for this internal path: the transform feed is
     # already bounded (yield_batch caps rows per predict call) and has
     # no retry story — the client-facing TOS_SERVE_MAX_QUEUE* defaults

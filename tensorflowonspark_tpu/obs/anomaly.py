@@ -71,6 +71,17 @@ catalogue every pass:
                     and slow (``TOS_SLO_SLOW_MULT`` ×) windows — the
                     service-level verdict the canary phase reads; cluster
                     scope, so ``executor_id`` is −1
+``canary_degraded`` a deploy canary is live (``deploy.state`` at
+                    CANARY/VERIFY) and either ``deploy.parity_failures``
+                    advanced inside the window (the candidate's greedy
+                    output diverged from the reference decode — the
+                    sharpest possible wrongness signal) or the
+                    canary-vs-baseline median-TTFT ratio
+                    (``deploy.canary_ttft_ratio``) is at/over
+                    ``TOS_OBS_CANARY_RATIO``: the rollout in flight is
+                    hurting; the controller's VERIFY gate will roll it
+                    back, this alert is the online operator signal
+                    (docs/ROBUSTNESS.md §Continuous deployment)
 ==================  =========================================================
 
 Every alert is a plain msgpack/json-safe dict (see :func:`make_alert`)
@@ -131,6 +142,9 @@ ENV_OBS_MEM_SLOPE_PCT = "TOS_OBS_MEM_SLOPE_PCT"
 ENV_OBS_SYNC_LAG_MS = "TOS_OBS_SYNC_LAG_MS"
 #: per-(kind, executor) refire suppression in seconds (TOS008)
 ENV_OBS_ALERT_COOLDOWN = "TOS_OBS_ALERT_COOLDOWN"
+#: canary degradation: canary/baseline median-TTFT ratio at/over which
+#: ``canary_degraded`` fires while a deploy canary is live (TOS008)
+ENV_OBS_CANARY_RATIO = "TOS_OBS_CANARY_RATIO"
 
 _DEFAULT_INTERVAL = 2.0
 _DEFAULT_WINDOW = 20.0
@@ -143,6 +157,7 @@ _DEFAULT_CRASH_LOOP = 2
 _DEFAULT_MEM_SLOPE_PCT = 10.0
 _DEFAULT_COOLDOWN = 30.0
 _DEFAULT_SYNC_LAG_MS = 2000.0
+_DEFAULT_CANARY_RATIO = 10.0
 
 #: bounded alert ring (driver memory; the JSONL keeps the full history)
 MAX_ALERTS = 256
@@ -168,6 +183,9 @@ _SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
             "fleet.occupancy",
             "training.groups_total", "training.groups_active",
             "training.sync_ms",
+            "deploy.state", "deploy.version", "deploy.candidate",
+            "deploy.canary_ttft_ratio", "deploy.parity_failures",
+            "deploy.canaries", "deploy.promotions", "deploy.rollbacks",
             "device.bytes_in_use")
 
 
@@ -236,6 +254,8 @@ class AnomalyDetector(object):
                                     _DEFAULT_MEM_SLOPE_PCT)
     self.sync_lag_ms = _env_float(ENV_OBS_SYNC_LAG_MS,
                                   _DEFAULT_SYNC_LAG_MS)
+    self.canary_ratio = _env_float(ENV_OBS_CANARY_RATIO,
+                                   _DEFAULT_CANARY_RATIO)
     self.cooldown = _env_float(ENV_OBS_ALERT_COOLDOWN, _DEFAULT_COOLDOWN)
     #: detectors only evaluate once a window's sample span reaches this —
     #: sub-second startup windows turn executor launch skew into phantom
@@ -347,6 +367,7 @@ class AnomalyDetector(object):
         new.extend(self._check_kv_pages(eid, dq, span, now))
         new.extend(self._check_fleet(eid, dq, span, now))
         new.extend(self._check_groups(eid, dq, span, now))
+        new.extend(self._check_deploy(eid, dq, span, now))
         new.extend(self._check_mem_slope(eid, dq, span, now))
       new.extend(self._check_slo(now))
     except Exception:  # noqa: BLE001 - the detector must outlive any
@@ -568,6 +589,74 @@ class AnomalyDetector(object):
           "rounds toward the deadline"
           % (eid, sync_ms, self.sync_lag_ms)))
     return out
+
+  def _check_deploy(self, eid, dq, span, now) -> List[dict]:
+    """``canary_degraded``: a rollout canary is live (``deploy.state``
+    at CANARY/VERIFY) and hurting — parity spot-checks diverged inside
+    the window, or the canary-vs-baseline median-TTFT ratio is at/over
+    ``TOS_OBS_CANARY_RATIO``. The controller's own VERIFY gate decides
+    the rollback; this is the ONLINE operator signal (and the one the
+    bake-window check reads back through ``slo_status``-style plumbing),
+    so it keys on the candidate version: a second candidate gets its own
+    cooldown."""
+    latest = dq[-1][1]
+    state = latest.get("deploy.state")
+    if state is None or int(state) not in (1, 2):   # CANARY, VERIFY
+      return []
+    candidate = int(latest.get("deploy.candidate") or 0)
+    out: List[dict] = []
+    parity = self._delta(dq, "deploy.parity_failures")
+    ratio = latest.get("deploy.canary_ttft_ratio")
+    if parity is not None and parity > 0:
+      out.extend(self._fire(
+          "canary_degraded", eid, span, now,
+          {"candidate": candidate, "parity_failures": parity},
+          "deploy canary for version %d diverged from the reference "
+          "decode %d time(s) in the window — the candidate is serving "
+          "wrong outputs; VERIFY will quarantine it"
+          % (candidate, int(parity)),
+          key=("canary_degraded", "parity", candidate)))
+    if ratio is not None and ratio >= self.canary_ratio:
+      out.extend(self._fire(
+          "canary_degraded", eid, span, now,
+          {"candidate": candidate, "ttft_ratio": ratio,
+           "threshold": self.canary_ratio},
+          "deploy canary for version %d running %.1fx baseline median "
+          "TTFT (threshold %.1fx) — the candidate is slow; expect a "
+          "rollback" % (candidate, ratio, self.canary_ratio),
+          key=("canary_degraded", "ttft", candidate)))
+    return out
+
+  def deploy_status(self) -> Optional[dict]:
+    """The HEALTH-wire deploy payload (None until some process ships
+    ``deploy.*`` gauges): the newest sampled controller state, so
+    ``obs_top`` can render the ``deploy[...]`` row without reaching the
+    controller process. Read-side only — the authoritative state machine
+    lives in ``serving.deploy``."""
+    best = None
+    best_t = None
+    for dq in self._samples.values():
+      if not dq:
+        continue
+      t, vals = dq[-1]
+      if "deploy.state" not in vals:
+        continue
+      if best_t is None or t > best_t:
+        best_t, best = t, vals
+    if best is None:
+      return None
+    names = ("idle", "canary", "verify", "promote", "rollback")
+    code = int(best.get("deploy.state") or 0)
+    return {"state": names[code] if 0 <= code < len(names) else str(code),
+            "state_code": code,
+            "version": int(best.get("deploy.version") or 0) or None,
+            "candidate": int(best.get("deploy.candidate") or 0) or None,
+            "ttft_ratio": best.get("deploy.canary_ttft_ratio"),
+            "canaries": int(best.get("deploy.canaries") or 0),
+            "promotions": int(best.get("deploy.promotions") or 0),
+            "rollbacks": int(best.get("deploy.rollbacks") or 0),
+            "parity_failures": int(best.get("deploy.parity_failures")
+                                   or 0)}
 
   def _check_slo(self, now) -> List[dict]:
     """Sample + burn-rate-evaluate the declared SLO objectives

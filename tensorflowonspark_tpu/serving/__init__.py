@@ -24,6 +24,14 @@ Public surface:
   retry-with-backoff on overload, health ejection + cross-replica
   failover replay (stream positions exactly-once), and zero-shed
   :meth:`rolling_swap` (docs/ROBUSTNESS.md §Fleet).
+* :class:`~tensorflowonspark_tpu.serving.registry.ModelRegistry` — the
+  durable train→serve seam: atomic versioned publish (the checkpoint
+  commit-marker protocol), ``watch()``, quarantine, ref-counted GC.
+* :class:`~tensorflowonspark_tpu.serving.deploy.DeploymentController`
+  — SLO-gated canary rollout: CANARY → VERIFY (greedy parity +
+  obs/SLO deltas) → PROMOTE or ROLLBACK+quarantine, zero-shed end to
+  end, with :meth:`resume` converging the fleet after a controller
+  death (docs/ROBUSTNESS.md §Continuous deployment).
 
 Decode-speed stack (docs/PERFORMANCE.md §"Paged KV, prefix cache &
 speculative decode"): ``TOS_SERVE_PAGE_SIZE`` pages the KV slab,
@@ -41,10 +49,16 @@ from tensorflowonspark_tpu.serving.engine import (            # noqa: F401
     ENV_SERVE_PAGE_SIZE, ENV_SERVE_POLL, ENV_SERVE_PREFIX_PAGES,
     ENV_SERVE_SLOTS, ENV_SERVE_SPEC_DEPTH, ENV_SERVE_SPEC_LAYERS,
     ENV_SERVE_TTL, ServingEngine)
+from tensorflowonspark_tpu.serving.deploy import (            # noqa: F401
+    ENV_DEPLOY_BAKE, ENV_DEPLOY_POLL, ENV_DEPLOY_SLICE,
+    ENV_DEPLOY_SPOT_CHECKS, ENV_DEPLOY_SWAP_TIMEOUT,
+    ENV_DEPLOY_TTFT_RATIO, ControllerKilled, DeploymentController)
 from tensorflowonspark_tpu.serving.fleet import (             # noqa: F401
-    ENV_FLEET_ADMIT_TIMEOUT, ENV_FLEET_MAX_FAILOVERS, ENV_FLEET_POLL,
-    ENV_FLEET_PROBE_FAILS, ENV_FLEET_REPLICAS, FleetRequest, Replica,
-    ServingFleet)
+    ENV_FLEET_ADMIT_TIMEOUT, ENV_FLEET_MAX_FAILOVERS,
+    ENV_FLEET_MAX_REPLICAS, ENV_FLEET_POLL, ENV_FLEET_PROBE_FAILS,
+    ENV_FLEET_REPLICAS, FleetRequest, Replica, ServingFleet)
+from tensorflowonspark_tpu.serving.registry import (          # noqa: F401
+    ENV_REGISTRY_KEEP, ENV_REGISTRY_POLL, ModelRegistry)
 from tensorflowonspark_tpu.serving.scheduler import (         # noqa: F401
     ENV_SERVE_BUCKETS, DeadlineExceeded, PagePool, PoisonedRequest,
     PrefixCache, Request, RequestCancelled, RequestQueue,

@@ -93,6 +93,13 @@ ENV_FLEET_PROBE_FAILS = "TOS_FLEET_PROBE_FAILS"
 #: submit retry bound in seconds for requests with NO deadline of their
 #: own — with one, the request's deadline bounds the retries instead
 ENV_FLEET_ADMIT_TIMEOUT = "TOS_FLEET_ADMIT_TIMEOUT"
+#: replica-count ceiling for the ``on_saturated`` scale-up hook. UNSET
+#: (and no ``max_replicas`` arg) means the hook is OFF — saturation
+#: stays signal-only (the ``fleet_saturated`` detector), exactly as
+#: before. Set it and a saturated submit may add replicas (from the
+#: fleet's current factory — the deploy controller keeps that pointed at
+#: the promoted version) up to this bound.
+ENV_FLEET_MAX_REPLICAS = "TOS_FLEET_MAX_REPLICAS"
 
 _DEFAULT_REPLICAS = 2
 _DEFAULT_POLL = 0.05
@@ -163,7 +170,7 @@ class FleetRequest(object):
                "finished_at", "first_token_at", "trace_id",
                "attempts", "cur_replica", "cur_rid",
                "cur_req", "attempt_seq", "prev_tokens", "failovers",
-               "next_try")
+               "next_try", "model_version")
 
   def __init__(self, prompt, max_new_tokens: int, deadline=None):
     self.frid = next(_fleet_request_ids)
@@ -186,6 +193,11 @@ class FleetRequest(object):
     self.prev_tokens: List[int] = []
     self.failovers = 0
     self.next_try = 0.0                    # earliest failover re-place
+    #: registry version of the replica that SERVED this request (the
+    #: deploy plane's stamp; None when no version map is set) — rides
+    #: the timing ledger and the fleet.dispatch span so every trace
+    #: names the model that answered it
+    self.model_version = None
 
   def expired(self, now: Optional[float] = None) -> bool:
     if self.deadline is None:
@@ -214,7 +226,9 @@ class FleetRequest(object):
             "finished": self.finished_at,
             "ttft": self.ttft, "e2e": self.latency,
             "failovers": self.failovers,
-            "attempts": list(self.attempts)}
+            "attempts": list(self.attempts),
+            "model_version": self.model_version,
+            "replica": self.cur_replica}
 
   def finish(self, error: Optional[BaseException],
              output: Optional[np.ndarray] = None) -> bool:
@@ -247,12 +261,21 @@ class ServingFleet(object):
                max_failovers: Optional[int] = None,
                probe_fails: Optional[int] = None,
                admit_timeout: Optional[float] = None,
-               health_probe: Optional[Callable[[Replica], bool]] = None):
+               health_probe: Optional[Callable[[Replica], bool]] = None,
+               max_replicas: Optional[int] = None):
     # explicit arguments beat the env knobs (the num_slots rule)
     n = int(num_replicas if num_replicas is not None
             else _env_int(ENV_FLEET_REPLICAS, _DEFAULT_REPLICAS))
     if n < 1:
       raise ValueError("num_replicas must be >= 1, got %d" % n)
+    #: scale-up ceiling for :meth:`on_saturated`; None (knob unset, no
+    #: arg) keeps the hook OFF — saturation stays signal-only
+    cap = int(max_replicas if max_replicas is not None
+              else _env_int(ENV_FLEET_MAX_REPLICAS, 0))
+    self.max_replicas = cap if cap > 0 else None
+    if self.max_replicas is not None and self.max_replicas < n:
+      raise ValueError("max_replicas %d < num_replicas %d"
+                       % (self.max_replicas, n))
     self._factory = engine_factory
     self._poll = float(poll_interval if poll_interval is not None
                        else _env_float(ENV_FLEET_POLL, _DEFAULT_POLL))
@@ -294,7 +317,14 @@ class ServingFleet(object):
                   "rejected": 0,
                   "retries": 0, "failovers": 0, "replays": 0,
                   "replay_mismatches": 0, "ejections": 0, "swaps": 0,
-                  "shed": 0, "monitor_failures": 0}
+                  "shed": 0, "monitor_failures": 0, "scale_ups": 0,
+                  "canary_dispatches": 0}
+    #: canary routing state: {"rid", "every", "counter"} while a deploy
+    #: canary holds one replica (serving.deploy), else None
+    self._canary: Optional[dict] = None
+    #: replica id -> registry model version (the deploy plane's map;
+    #: stamps requests/spans, read back by version-consistency checks)
+    self._versions: Dict[int, object] = {}
     self._rec = obs_spans.active()
     reg = obs_metrics.active()
     self._obs_m = None if reg is None else {
@@ -426,12 +456,34 @@ class ServingFleet(object):
               if rep.state == ACTIVE and rep.engine.alive]
     return sorted(live, key=self._score)
 
+  def _canary_order(self) -> List[Replica]:
+    """Dispatch order under an active canary: every ``every``-th
+    placement round tries the canary replica FIRST (the configured
+    traffic slice); all other rounds try it LAST — baseline traffic
+    stays off the candidate, but a fully-overloaded baseline can still
+    fall back to the canary rather than shed (zero-shed beats slice
+    purity)."""
+    order = self._dispatch_order()
+    with self._lock:
+      can = self._canary
+      if can is None:
+        return order
+      rid = can["rid"]
+      can["counter"] += 1
+      take = can["every"] > 0 and can["counter"] % can["every"] == 0
+    canary = [r for r in order if r.rid == rid]
+    others = [r for r in order if r.rid != rid]
+    if not canary:
+      return order
+    return canary + others if take else others + canary
+
   def _try_place(self, freq: FleetRequest) -> Optional[float]:
-    """One dispatch round over every live replica, best-scored first.
-    Returns None when placed; the smallest ``retry_after`` hint when
-    every replica rejected (inf when none was even reachable)."""
+    """One dispatch round over every live replica, best-scored first
+    (canary-slice-aware while a canary is set). Returns None when
+    placed; the smallest ``retry_after`` hint when every replica
+    rejected (inf when none was even reachable)."""
     hint = None
-    for rep in self._dispatch_order():
+    for rep in self._canary_order():
       if chaos.fleet_fault("dispatch", rep.rid) == "kill":
         # replica-granularity chaos: this replica dies AT this dispatch
         # (mid-decode for everything it already accepted) — eject now so
@@ -461,11 +513,15 @@ class ServingFleet(object):
         continue
       if self._rec is not None:
         # the routing phase of the waterfall: which replica took it,
-        # and whether this was a fresh dispatch or a failover re-place
+        # whether this was a fresh dispatch or a failover re-place, and
+        # (deploy plane) which model version answers it
+        attrs = {"trace": freq.trace_id, "replica": rep.rid,
+                 "attempt": freq.attempt_seq + 1}
+        ver = self._versions.get(rep.rid)
+        if ver is not None:
+          attrs["model_version"] = ver
         self._rec.record_span("fleet.dispatch", t0,
-                              time.monotonic() - t0,
-                              trace=freq.trace_id, replica=rep.rid,
-                              attempt=freq.attempt_seq + 1)
+                              time.monotonic() - t0, **attrs)
       self._assign(freq, rep, erid)
       return None
     return hint if hint is not None else float("inf")
@@ -478,9 +534,13 @@ class ServingFleet(object):
       freq.cur_rid = erid
       freq.cur_req = handle
       freq.attempt_seq += 1
+      freq.model_version = self._versions.get(rep.rid)
+      can = self._canary
       if freq.cancelled.is_set():
         handle.cancelled.set()             # cancel raced the placement
     self._count("dispatched")
+    if can is not None and can["rid"] == rep.rid:
+      self._count("canary_dispatches")
 
   def submit(self, prompt, max_new_tokens: Optional[int] = None,
              deadline: Optional[float] = None,
@@ -566,6 +626,8 @@ class ServingFleet(object):
       if not first:
         self._count("retries")
       first = False
+      if self.on_saturated():
+        continue             # a fresh replica may take it — retry now
       sleep = hint if hint not in (None, float("inf")) \
           else _DEFAULT_RETRY_SLEEP
       remaining = admit_deadline - time.monotonic()
@@ -756,46 +818,135 @@ class ServingFleet(object):
     freq.done.wait(timeout=timeout)
     return freq.done.is_set()
 
-  # -- rolling swap ----------------------------------------------------------
+  # -- rolling swap & the deploy-plane surface -------------------------------
 
-  def rolling_swap(self, timeout: float,
-                   engine_factory: Optional[Callable] = None) -> dict:
-    """Fleet-wide zero-shed param swap: one replica at a time is marked
-    DRAINING (dispatch shifts to the others), drained through the
-    engine's zero-shed ``drain()`` contract, and replaced with a fresh
-    engine from ``engine_factory`` (default: the fleet's own factory —
-    pass one closing over new params to re-param). A replica whose drain
-    times out still sheds nothing: its leftovers fail over to live
-    replicas and replay (counted, evented). ``timeout`` bounds EACH
-    replica's drain and is required (TOS001, the drain rule)."""
+  def swap_replica(self, rid: int, timeout: float,
+                   engine_factory: Optional[Callable] = None,
+                   version=None) -> dict:
+    """Zero-shed swap of ONE replica: mark it DRAINING (dispatch shifts
+    to the others), drain it through the engine's zero-shed ``drain()``
+    contract, then swap in a fresh engine from ``engine_factory``
+    (default: the fleet's own factory). The canary move in the deploy
+    state machine — and the unit :meth:`rolling_swap` iterates.
+    ``version`` (when given) updates the replica's entry in the served-
+    version map. A drain that times out still sheds nothing: leftovers
+    fail over to live replicas and replay. ``timeout`` required
+    (TOS001, the drain rule)."""
+    rep = self._replicas[rid]
+    if rep.state == EJECTED:
+      return {"replica": rid, "skipped": "ejected"}
     factory = engine_factory if engine_factory is not None \
         else self._factory
+    with self._lock:
+      rep.state = DRAINING                 # dispatch skips it from here
+    self._event("swap_start", replica=rid)
+    drained = rep.engine.drain(timeout=timeout)
+    new_eng = factory()
+    new_eng.start()
+    with self._lock:
+      rep.engine = new_eng
+      rep.state = ACTIVE
+      rep.probe_fails = 0
+      rep.generation += 1
+      if version is not None:
+        self._versions[rid] = version
+    self._count("swaps")
+    self._event("swap_done", replica=rid, drained=bool(drained),
+                generation=rep.generation,
+                **({} if version is None else {"model_version": version}))
+    return {"replica": rid, "drained": bool(drained),
+            "generation": rep.generation}
+
+  def rolling_swap(self, timeout: float,
+                   engine_factory: Optional[Callable] = None,
+                   version=None) -> dict:
+    """Fleet-wide zero-shed param swap: one replica at a time through
+    :meth:`swap_replica` — pass an ``engine_factory`` closing over new
+    params to re-param the whole fleet with zero accepted requests shed.
+    ``timeout`` bounds EACH replica's drain and is required (TOS001)."""
     if engine_factory is not None:
       self._factory = engine_factory       # future ejection rebuilds too
-    report = []
-    for rid in sorted(self._replicas):
-      rep = self._replicas[rid]
-      if rep.state == EJECTED:
-        report.append({"replica": rid, "skipped": "ejected"})
-        continue
-      with self._lock:
-        rep.state = DRAINING               # dispatch skips it from here
-      self._event("swap_start", replica=rid)
-      drained = rep.engine.drain(timeout=timeout)
-      new_eng = factory()
-      new_eng.start()
-      with self._lock:
-        rep.engine = new_eng
-        rep.state = ACTIVE
-        rep.probe_fails = 0
-        rep.generation += 1
-      self._count("swaps")
-      self._event("swap_done", replica=rid, drained=bool(drained),
-                  generation=rep.generation)
-      report.append({"replica": rid, "drained": bool(drained),
-                     "generation": rep.generation})
+    report = [self.swap_replica(rid, timeout,
+                                engine_factory=engine_factory,
+                                version=version)
+              for rid in sorted(self._replicas)]
     return {"swapped": sum(1 for r in report if "drained" in r),
             "replicas": report}
+
+  def set_canary(self, rid: int, every: int) -> None:
+    """Route every ``every``-th placement round to replica ``rid`` first
+    (the canary traffic slice, deterministic by construction); all other
+    rounds keep baseline traffic off it. ``every=4`` ≈ a 25% slice."""
+    if rid not in self._replicas:
+      raise KeyError("unknown replica id %r" % (rid,))
+    if every < 1:
+      raise ValueError("canary slice divisor must be >= 1, got %d" % every)
+    with self._lock:
+      self._canary = {"rid": int(rid), "every": int(every), "counter": 0}
+
+  def clear_canary(self) -> None:
+    with self._lock:
+      self._canary = None
+
+  @property
+  def canary_rid(self) -> Optional[int]:
+    with self._lock:
+      return None if self._canary is None else self._canary["rid"]
+
+  def set_replica_version(self, rid: int, version) -> None:
+    """Record which registry version replica ``rid`` serves — stamped
+    onto every request it answers (timing ledger + dispatch span)."""
+    with self._lock:
+      self._versions[int(rid)] = version
+
+  def served_versions(self) -> Dict[int, object]:
+    """{replica id: model version} over non-ejected replicas (None for
+    replicas never stamped) — the deploy controller's consistency read."""
+    with self._lock:
+      return {rid: self._versions.get(rid)
+              for rid, rep in self._replicas.items()
+              if rep.state != EJECTED}
+
+  def add_replica(self, engine_factory: Optional[Callable] = None,
+                  version=None) -> int:
+    """Grow the fleet by one replica (from ``engine_factory`` or the
+    fleet's current factory); returns the new replica id. Started
+    immediately when the fleet runs. Unbounded on purpose — the CAPPED
+    entry point is :meth:`on_saturated`."""
+    factory = engine_factory if engine_factory is not None \
+        else self._factory
+    eng = factory()
+    t = self._thread
+    if t is not None and t.is_alive():
+      eng.start()
+    with self._lock:
+      rid = (max(self._replicas) + 1) if self._replicas else 0
+      self._replicas[rid] = Replica(rid, eng)
+      self.num_replicas += 1
+      if version is not None:
+        self._versions[rid] = version
+    self._count("scale_ups")
+    self._event("scale_up", replica=rid, total=self.num_replicas)
+    return rid
+
+  def on_saturated(self, engine_factory: Optional[Callable] = None) -> bool:
+    """Capped scale-up hook: when the fleet is saturated (every live
+    replica rejecting — the condition the ``fleet_saturated`` detector
+    alerts on), add ONE replica, bounded by ``max_replicas`` /
+    ``TOS_FLEET_MAX_REPLICAS``. OFF unless that bound is configured
+    (saturation stays signal-only, the pre-existing behavior). Called
+    automatically from the submit retry path; also callable by an
+    external actuator reacting to the detector's alert. Returns True
+    when a replica was added."""
+    if self.max_replicas is None:
+      return False
+    with self._lock:
+      live = sum(1 for rep in self._replicas.values()
+                 if rep.state != EJECTED)
+    if live >= self.max_replicas:
+      return False
+    self.add_replica(engine_factory)
+    return True
 
   # -- ejection & failover ---------------------------------------------------
 

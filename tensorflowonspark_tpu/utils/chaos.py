@@ -60,6 +60,20 @@ Env vars (all optional; absent ⇒ every hook is a no-op):
     sleeps at the dispatch (a slow router hop). Without ``@replica``
     the nth count is global across all dispatches.
 
+``TOS_CHAOS_DEPLOY`` = ``"point[@index][#nth]:kill"``,
+    ``"...:poison"`` or ``"...:stall:seconds"`` (comma-separated)
+    Deployment-plane fault at a named :func:`deploy_fault` point
+    (``serving.deploy`` arms ``canary``, ``verify``, ``promote`` and
+    ``rollback`` — promote passes the replica id being swapped as index;
+    the others pass the candidate version): ``kill`` tells the caller
+    the driver-side controller dies AT that boundary (exercising
+    recovery/resume convergence: zero shed, one consistent served
+    version — e.g. ``"promote#1:kill"`` kills the controller mid-promote
+    after the first remaining replica swaps); ``poison`` corrupts the
+    CANDIDATE's params at the canary build (a bad publish VERIFY must
+    catch: parity fails, the version is quarantined, never promoted);
+    ``stall`` sleeps at the boundary (a slow controller hop).
+
 ``TOS_CHAOS_GROUP`` = ``"kill[@group][#nth]"`` or
     ``"stall[@group][#nth]:seconds"`` (comma-separated)
     Group-granularity fault for elastic multi-group training
@@ -90,6 +104,7 @@ ENV_RV_DELAY = "TOS_CHAOS_RV_DELAY"
 ENV_SERVE = "TOS_CHAOS_SERVE"
 ENV_FLEET = "TOS_CHAOS_FLEET"
 ENV_GROUP = "TOS_CHAOS_GROUP"
+ENV_DEPLOY = "TOS_CHAOS_DEPLOY"
 
 
 class InjectedFault(RuntimeError):
@@ -103,7 +118,7 @@ _rv_counts = {}
 _lock = threading.Lock()
 
 _KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY, ENV_SERVE,
-              ENV_FLEET, ENV_GROUP)
+              ENV_FLEET, ENV_GROUP, ENV_DEPLOY)
 _ENV_PREFIX = "TOS_CHAOS_"
 #: cache of the last validated env signature (validation is consulted from
 #: hot paths like the rendezvous client's per-request chaos check)
@@ -192,6 +207,13 @@ def check_config() -> None:
                        "'kill[@group][#nth]' or "
                        "'stall[@group][#nth]:seconds')"
                        % (ENV_GROUP, spec))
+  for spec in _split_specs(os.environ.get(ENV_DEPLOY)):
+    try:
+      _parse_deploy_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed deploy spec %r (want "
+                       "'point[@index][#nth]:kill', '...:poison' or "
+                       "'...:stall:seconds')" % (ENV_DEPLOY, spec))
   _validated = sig
 
 
@@ -291,6 +313,28 @@ def _parse_serve_spec(spec: str):
 def _parse_fleet_spec(spec: str):
   """``"point[@replica][#nth]:kill"`` / ``"...:stall:seconds"``."""
   return _parse_action_spec(spec, "kill")
+
+
+def _parse_deploy_spec(spec: str):
+  """``"point[@index][#nth]:kill"``, ``"...:poison"`` or
+  ``"...:stall:seconds"`` → ((name, index, nth), action, secs_or_None).
+  The fleet grammar with TWO hard actions: ``kill`` (the controller dies
+  at the boundary) and ``poison`` (the candidate's params are corrupted
+  at the canary build)."""
+  parts = spec.split(":")
+  if len(parts) < 2 or not parts[0]:
+    raise ValueError(spec)
+  target = _parse_point_spec(parts[0])
+  action = parts[1]
+  if action in ("kill", "poison"):
+    if len(parts) != 2:
+      raise ValueError(spec)
+    return target, action, None
+  if action == "stall":
+    if len(parts) != 3:
+      raise ValueError(spec)
+    return target, action, float(parts[2])
+  raise ValueError(spec)
 
 
 def _parse_group_spec(spec: str):
@@ -463,6 +507,54 @@ def fleet_fault(name: str, index: Optional[int] = None) -> Optional[str]:
     logger.warning("chaos: kill verdict at fleet point %r replica %r "
                    "(occurrence %d)", name, index, nth)
     return "kill"
+  return None
+
+
+def deploy_fault(name: str, index: Optional[int] = None) -> Optional[str]:
+  """Deterministic deployment-plane fault site (``serving.deploy`` arms
+  ``canary``/``verify``/``promote``/``rollback``): returns ``"kill"``
+  when a ``TOS_CHAOS_DEPLOY`` kill spec matches this invocation — the
+  CALLER then dies as the driver-side controller at that state-machine
+  boundary (mid-promote is the headline: recovery must converge every
+  replica to ONE version with zero shed) — or ``"poison"`` (the caller
+  corrupts the candidate's params, the bad publish VERIFY must catch).
+  Stall specs sleep inline and return None, as does a disarmed or
+  unmatched consult.
+
+  Counters mirror :func:`fleet_fault`: a GLOBAL per-point count (specs
+  without ``@index``) and a per-index one (``promote`` passes the
+  replica id being swapped; ``canary``/``verify``/``rollback`` pass the
+  candidate version).
+  """
+  _first_consult()
+  spec_env = os.environ.get(ENV_DEPLOY)
+  if not spec_env:
+    return None
+  check_config()
+  point = "deploy." + name
+  with _lock:
+    gcount = _counts[(point, None)] = _counts.get((point, None), 0) + 1
+    icount = gcount
+    if index is not None:
+      icount = _counts[(point, index)] = \
+          _counts.get((point, index), 0) + 1
+  for spec in _split_specs(spec_env):
+    (sname, sindex, nth), action, secs = _parse_deploy_spec(spec)
+    if sname != name:
+      continue
+    if sindex is None:
+      if gcount != nth:
+        continue
+    elif sindex != index or icount != nth:
+      continue
+    if action == "stall":
+      logger.warning("chaos: stalling %.2fs at deploy point %r index %r "
+                     "(occurrence %d)", secs, name, index, nth)
+      time.sleep(secs)
+      continue
+    logger.warning("chaos: %s verdict at deploy point %r index %r "
+                   "(occurrence %d)", action, name, index, nth)
+    return action
   return None
 
 

@@ -24,6 +24,48 @@ _MARKER_PREFIX = ".commit-"
 _MARKER_SUFFIX = ".json"
 
 
+def atomic_write_json(path: str, payload: dict) -> None:
+  """Commit ``payload`` to ``path`` via write-to-temp + fsync + atomic
+  rename — THE torn-write-proof marker protocol. A kill at any point
+  leaves either no file or a complete one, never a half-written record.
+
+  This is the single implementation behind the checkpoint commit markers
+  and the model-registry publish markers (``serving.registry``): two
+  independent torn-write protocols must not drift, so both call here.
+  Raises ``OSError`` on failure — callers decide whether a marker-write
+  failure fails the operation.
+  """
+  tmp = path + ".tmp"
+  with open(tmp, "w") as f:
+    json.dump(payload, f)
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, path)
+
+
+def params_fingerprint(tree: Any) -> str:
+  """Cheap content fingerprint of a params pytree: crc32 over every
+  leaf's bytes folded with its flattened path, shape, and dtype.
+
+  Shared by the model registry (publish manifest / poisoned-candidate
+  detection) and ``make_serving_predict_fn``'s engine-cache key, so "same
+  weights" means the same thing on both sides of the train→serve loop.
+  Not cryptographic — this guards against torn publishes and stale cache
+  hits, not adversaries.
+  """
+  import zlib
+  import jax
+  import numpy as np
+  acc = 0
+  leaves, treedef = jax.tree_util.tree_flatten(tree)
+  acc = zlib.crc32(repr(treedef).encode(), acc)
+  for leaf in leaves:
+    arr = np.asarray(leaf)
+    acc = zlib.crc32(str((arr.shape, str(arr.dtype))).encode(), acc)
+    acc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), acc)
+  return "%08x" % (acc & 0xFFFFFFFF)
+
+
 class CheckpointManager(object):
   """Periodic save / latest restore of a train-state pytree.
 
@@ -46,7 +88,7 @@ class CheckpointManager(object):
   """
 
   def __init__(self, directory: str, save_interval_steps: int = 100,
-               max_to_keep: int = 3):
+               max_to_keep: int = 3, publish_hook: Optional[Any] = None):
     import orbax.checkpoint as ocp
     from tensorflowonspark_tpu.utils import paths
 
@@ -57,6 +99,13 @@ class CheckpointManager(object):
     if self._local:
       os.makedirs(self.directory, exist_ok=True)
     self.save_interval_steps = save_interval_steps
+    #: ``publish_hook(step, state, manifest)`` fires after a save COMMITS
+    #: (marker durable) — the train→serve seam. A registry attaches one
+    #: via ``serving.registry.ModelRegistry.publish_on_checkpoint`` so
+    #: every committed checkpoint becomes a candidate serving version on
+    #: the existing cadence. Best-effort: a publish failure is logged,
+    #: never fails the save (the checkpoint itself is already durable).
+    self.publish_hook = publish_hook
     self._mgr = ocp.CheckpointManager(
         self.directory,
         options=ocp.CheckpointManagerOptions(
@@ -119,6 +168,14 @@ class CheckpointManager(object):
     if saved:
       self._write_marker(step, manifest)
       logger.info("checkpoint saved at step %d", step)
+      if self.publish_hook is not None:
+        try:
+          self.publish_hook(step, state, manifest)
+        except Exception as e:  # noqa: BLE001 # tosa: ignore[TOS004] - best-effort
+          # publish is best-effort: the checkpoint committed; a
+          # registry outage must not fail it (serving has watch/resume)
+          logger.warning("publish hook at step %d failed: %s: %s",
+                         step, type(e).__name__, e)
     return saved
 
   # -- commit markers (deterministic torn-save detection) ---------------------
@@ -137,13 +194,8 @@ class CheckpointManager(object):
       return
     self._mgr.wait_until_finished()
     path = self._marker_path(step)
-    tmp = path + ".tmp"
     try:
-      with open(tmp, "w") as f:
-        json.dump({"step": int(step), "manifest": manifest or {}}, f)
-        f.flush()
-        os.fsync(f.fileno())
-      os.replace(tmp, path)
+      atomic_write_json(path, {"step": int(step), "manifest": manifest or {}})
     except OSError as e:
       # the data is durable; a marker-write failure must not fail the save
       # (the step merely restores via nothing — same as a torn save)

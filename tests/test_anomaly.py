@@ -991,3 +991,71 @@ def test_chaos_stalled_executor_trips_straggler_alert(tmp_path, monkeypatch):
   finally:
     engine.stop()
     chaos.reset()
+
+
+class TestCanaryDegradedDetector:
+  """``canary_degraded``: the online rollout signal — fires only while a
+  canary is actually live (deploy.state at CANARY/VERIFY), on parity
+  divergence or a TTFT ratio blowout, keyed per candidate version."""
+
+  def test_parity_divergence_fires(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, deploy__state=2, deploy__candidate=5,
+             deploy__parity_failures=0)
+    assert det.poll(now=0.0) == []
+    sink.set(0, deploy__state=2, deploy__candidate=5,
+             deploy__parity_failures=2)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["canary_degraded"]
+    assert alerts[0]["evidence"]["candidate"] == 5
+    assert alerts[0]["evidence"]["parity_failures"] == 2
+
+  def test_ttft_ratio_fires_with_own_cooldown_key(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, deploy__state=1, deploy__candidate=7,
+             deploy__canary_ttft_ratio=1.0)
+    assert det.poll(now=0.0) == []
+    sink.set(0, deploy__state=1, deploy__candidate=7,
+             deploy__canary_ttft_ratio=12.5)   # >= the 10x default
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["canary_degraded"]
+    assert alerts[0]["evidence"]["ttft_ratio"] == 12.5
+
+  def test_idle_controller_stays_quiet(self):
+    # a moving parity counter with NO live canary (state idle) is
+    # post-rollback residue, not a new incident
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, deploy__state=0, deploy__candidate=5,
+             deploy__parity_failures=0)
+    det.poll(now=0.0)
+    sink.set(0, deploy__state=0, deploy__candidate=5,
+             deploy__parity_failures=3)
+    assert det.poll(now=10.0) == []
+
+  def test_below_ratio_stays_quiet(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, deploy__state=1, deploy__candidate=7,
+             deploy__canary_ttft_ratio=1.0)
+    det.poll(now=0.0)
+    sink.set(0, deploy__state=1, deploy__candidate=7,
+             deploy__canary_ttft_ratio=9.9)
+    assert det.poll(now=10.0) == []
+
+  def test_deploy_status_surfaces_newest_sample(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    assert det.deploy_status() is None       # no deploy.* shipped yet
+    sink.set(0, deploy__state=1, deploy__version=4, deploy__candidate=5,
+             deploy__canary_ttft_ratio=1.2, deploy__canaries=1,
+             deploy__promotions=3, deploy__rollbacks=1,
+             deploy__parity_failures=0)
+    det.poll(now=0.0)
+    st = det.deploy_status()
+    assert st["state"] == "canary" and st["state_code"] == 1
+    assert st["version"] == 4 and st["candidate"] == 5
+    assert st["ttft_ratio"] == pytest.approx(1.2)
+    assert st["promotions"] == 3 and st["rollbacks"] == 1

@@ -124,6 +124,7 @@ class TestServeBenchCompareSmoke:
 
 
 class TestServeBenchPrefixSmoke:
+  @pytest.mark.slow  # covered by the serve-bench-prefix target; tier-1 budget
   def test_prefix_workload_smoke_holds_parity_per_stage(self):
     """`serve_bench --prefix-workload --smoke` drives the REAL staged
     decode-speed stack (paged KV at equal HBM, shared-prefix cache,
@@ -163,6 +164,7 @@ class TestServeBenchPrefixSmoke:
 
 
 class TestServeBenchChaosSmoke:
+  @pytest.mark.slow  # recovery logic unit-tested in test_serving; serve-bench-chaos target
   def test_chaos_smoke_recovers_with_bit_parity(self):
     """`serve_bench --chaos --smoke` injects a REAL deterministic decode
     crash (TOS_CHAOS_SERVE) into the engine mid-workload and measures
@@ -196,6 +198,7 @@ class TestServeBenchChaosSmoke:
 
 
 class TestServeBenchFleetSmoke:
+  @pytest.mark.slow  # make check runs serve-bench-fleet-smoke directly; tier-1 budget
   def test_fleet_smoke_zero_shed_swap_with_bit_parity(self):
     """`serve_bench --fleet --smoke` drives the REAL ServingFleet: N
     replicas behind the router serving the seeded workload with a FULL
@@ -228,7 +231,49 @@ class TestServeBenchFleetSmoke:
     assert result["fleet"]["p99_s"] >= result["fleet"]["p50_s"]
 
 
+class TestServeBenchDeploySmoke:
+  def test_deploy_smoke_chaos_kill_and_poison_gates(self):
+    """`serve_bench --deploy --smoke` drives the REAL continuous-deploy
+    loop: registry publish → canary → verify → promote with the
+    controller chaos-KILLED at the first promote boundary, then a
+    POISONED candidate. Tier-1 re-proves on every CI run the headline
+    contract: the kill sheds zero requests, resume() converges every
+    replica to ONE consistent version with v2-parity outputs, and the
+    poisoned candidate is caught by VERIFY, rolled back bit-identically
+    and quarantined — never promoted."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "serve_bench.py"),
+         "--deploy", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "serving_deploy_canary_rollout"
+    assert result["killed_mid_promote"] is True
+    assert result["zero_shed"] is True
+    assert result["version_consistent"] is True
+    assert result["promote_parity"] is True
+    assert result["poison_caught_by_verify"] is True
+    assert result["rollback_bit_identical"] is True
+    assert result["quarantined"] is True
+    assert result["never_promoted"] is True
+    # the kill landed mid-promote: the fleet really was mixed-version
+    assert len(set(result["served_mid_kill"].values())) > 1
+    assert result["completed_during_partial_rollout"] \
+        == result["workload"]["requests"]
+    assert result["fleet_counters"]["shed"] == 0
+    assert result["fleet_counters"]["canary_dispatches"] > 0
+
+
 class TestObsReportSmoke:
+  @pytest.mark.slow  # make check runs obs-smoke directly; tier-1 budget
   def test_smoke_merges_aligned_trace_from_cluster_run(self, tmp_path):
     """`obs_report --smoke` drives a REAL 2-process LocalEngine
     train+inference run with TOS_OBS=1 and merges the per-node JSONL
@@ -375,6 +420,7 @@ class TestTrainBenchSmoke:
 
 
 class TestFeedBenchGraphSmoke:
+  @pytest.mark.slow  # make check runs feed-bench-graph-smoke directly; tier-1 budget
   def test_smoke_holds_parity_through_the_autotuned_graph(self):
     """`feed_bench --graph --smoke` drives the REAL datapipe plane on
     CPU: a hub-fed `Dataset.from_feed(...).map(a).map(b).slab(B, K)`
@@ -414,6 +460,7 @@ class TestFeedBenchGraphSmoke:
 
 
 class TestObsTopSmoke:
+  @pytest.mark.slow  # make check runs obs-top-smoke directly; tier-1 budget
   def test_smoke_monitors_live_cluster_through_health_wire(self, tmp_path):
     """`obs_top --smoke` drives a REAL 2-process LocalEngine train run
     and polls it the way an out-of-process monitor would — through the
@@ -497,6 +544,7 @@ class TestBenchHistory:
 
 
 class TestSLOReportSmoke:
+  @pytest.mark.slow  # make check runs slo-smoke directly; tier-1 budget
   def test_smoke_links_traces_and_serves_slo_over_health(self, tmp_path):
     """`slo_report --smoke` (make slo-smoke) drives a REAL 2-process
     LocalEngine SERVE run with the obs plane + a declared TTFT objective

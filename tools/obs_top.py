@@ -188,6 +188,10 @@ def build_snapshot(reply, prev=None, dt=0.0):
           # (control.rendezvous attaches SyncPlane.status() when a plane
           # is attached): group membership, round/step, lost set
           "groups": reply.get("groups"),
+          # the continuous-deployment plane (serving.deploy gauges via
+          # the detector): served version, candidate in flight, rollback
+          # and parity counters
+          "deploy": reply.get("deploy"),
           "has_obs": bool(obs), "has_alert_ring": alerts is not None}
 
 
@@ -206,6 +210,26 @@ def _fmt_groups(grp):
   if lost:
     parts.append("lost " + ",".join(str(g) for g in sorted(lost)))
   return "groups[" + " | ".join(parts) + "]"
+
+
+def _fmt_deploy(dep):
+  """One compact ``deploy[...]`` line from the HEALTH-wire deploy
+  status (``serving.deploy`` via the detector's samples): the state
+  machine's phase, the promoted version, the candidate mid-rollout, and
+  whichever failure counters have moved — a rollback or parity count
+  here is the at-a-glance sign a candidate was caught."""
+  parts = [str(dep.get("state") or "?")]
+  if dep.get("version"):
+    parts.append("v%d" % dep["version"])
+  if dep.get("candidate"):
+    parts.append("cand v%d" % dep["candidate"])
+  if dep.get("ttft_ratio") is not None:
+    parts.append("ttft x%.2f" % dep["ttft_ratio"])
+  for lbl, key in (("canaries", "canaries"), ("promo", "promotions"),
+                   ("rb", "rollbacks"), ("parity!", "parity_failures")):
+    if dep.get(key):
+      parts.append("%s %d" % (lbl, dep[key]))
+  return "deploy[" + " | ".join(parts) + "]"
 
 
 def _fmt_slo(slo):
@@ -335,6 +359,10 @@ def render(snap, clear=True):
   if grp:
     lines.append("")
     lines.append(_fmt_groups(grp))
+  dep = snap.get("deploy")
+  if dep:
+    lines.append("")
+    lines.append(_fmt_deploy(dep))
   alerts = snap.get("alerts") or []
   lines.append("")
   if alerts:

@@ -751,6 +751,193 @@ def run_fleet(args):
   return 0 if (parity_ok and zero_shed) else 3
 
 
+# --- deploy mode: continuous train→serve rollout under chaos (--deploy) -----
+
+#: deploy-mode shapes: a registry with a baseline version serving on a
+#: fleet, then (leg A) a candidate driven CANARY→VERIFY→PROMOTE with the
+#: controller chaos-KILLED at the first promote boundary — resume() must
+#: converge every replica to ONE version with zero shed and v2-parity
+#: outputs — and (leg B) a POISONED candidate VERIFY must catch, roll
+#: back bit-identically and quarantine
+_DEPLOY_FULL = dict(layers=2, heads=4, d_model=128, d_ff=256, vocab=512,
+                    requests=24, slots=4, replicas=3,
+                    plens=(4, 8, 12), budgets=(8, 16, 32),
+                    max_seq=96, horizon=8)
+_DEPLOY_SMOKE = dict(layers=2, heads=2, d_model=32, d_ff=64, vocab=64,
+                     requests=8, slots=2, replicas=2, plens=(4, 6, 8),
+                     budgets=(4, 8), max_seq=24, horizon=4)
+
+
+def run_deploy(args):
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.serving import (
+      ControllerKilled, DeploymentController, ModelRegistry,
+      ServingEngine, ServingFleet)
+  from tensorflowonspark_tpu.utils import chaos
+
+  shape = _DEPLOY_SMOKE if args.smoke else _DEPLOY_FULL
+  if args.requests:
+    shape = dict(shape, requests=args.requests)
+  if args.replicas:
+    shape = dict(shape, replicas=args.replicas)
+  cfg = tfm.TransformerConfig(
+      vocab_size=shape["vocab"], num_layers=shape["layers"],
+      num_heads=shape["heads"], d_model=shape["d_model"],
+      d_ff=shape["d_ff"], max_seq_len=shape["max_seq"], remat=False,
+      dtype=jnp.float32)   # f32: the bit-parity gates must be exact
+  eos_id = 2
+  # three "training runs": distinct seeds stand in for checkpoints at
+  # successive steps — what publish_on_checkpoint would stream out
+  states = [tfm.create_state(jax.random.PRNGKey(s), cfg, seq_len=16)
+            for s in (0, 1, 2)]
+  workload = make_workload(shape, args.seed)
+  probe = workload[:3]
+
+  def reference_decode(params, prompt, budget):
+    out = np.asarray(tfm.greedy_generate_kv(
+        params, cfg, jnp.asarray(prompt)[None], int(budget),
+        eos_id=eos_id, pad_id=0))[0]
+    gen = out[len(prompt):]
+    stops = np.where(gen == eos_id)[0]
+    stop = (int(stops[0]) + 1) if len(stops) else int(budget)
+    return np.concatenate([np.asarray(prompt), gen[:stop]])
+
+  def make_factory(params, manifest):
+    def factory():
+      return ServingEngine(params, cfg, num_slots=shape["slots"],
+                           eos_id=eos_id, pad_id=0,
+                           horizon=shape["horizon"])
+    return factory
+
+  import tempfile
+  t0 = time.perf_counter()
+  with tempfile.TemporaryDirectory(prefix="tos-registry-") as root:
+    reg = ModelRegistry(root)
+    v1 = reg.publish(states[0].params, step=100)
+    v2 = reg.publish(states[1].params, step=200)
+    p1, m1 = reg.get(v1)
+    fleet = ServingFleet(make_factory(p1, m1),
+                         num_replicas=shape["replicas"]).start()
+    base_snap = fleet.stats_snapshot()
+    try:
+      for rid in fleet.replica_states():
+        fleet.set_replica_version(rid, v1)
+      ctl = DeploymentController(
+          fleet, reg, make_factory, reference_decode, probe,
+          baseline_version=v1, traffic_slice=0.5,
+          bake_seconds=0.3 if args.smoke else 1.5,
+          spot_checks=2 if args.smoke else 4, swap_timeout=300.0)
+
+      # ---- leg A: promote v2, controller killed mid-promote ----------------
+      os.environ[chaos.ENV_DEPLOY] = "promote:kill"
+      chaos.reset()
+      killed = False
+      try:
+        ctl.deploy(v2, bake_traffic=workload)
+      except ControllerKilled:
+        killed = True
+      finally:
+        os.environ.pop(chaos.ENV_DEPLOY, None)
+        chaos.reset()
+      served_mid = dict(fleet.served_versions())
+      # the fleet must keep serving THROUGH the partial rollout: drive
+      # the full workload against the mixed-version fleet before anyone
+      # repairs anything
+      mid_frids = [fleet.submit(p, max_new_tokens=b) for p, b in workload]
+      mid_outs = [fleet.result(fr, timeout=600) for fr in mid_frids]
+      resume_rep = ctl.resume(timeout=300.0)
+      served_after = dict(fleet.served_versions())
+      version_consistent = (set(served_after.values()) == {v2})
+      # post-convergence parity: every output bit-identical to the v2
+      # single-request reference decode
+      p2, _ = reg.get(v2)
+      refs2 = [reference_decode(p2, p, b) for p, b in workload]
+      outs2 = [fleet.result(fleet.submit(p, max_new_tokens=b),
+                            timeout=600) for p, b in workload]
+      promote_parity = all(
+          o.shape == r.shape and bool((o == r).all())
+          for o, r in zip(outs2, refs2))
+
+      # ---- leg B: poisoned candidate — VERIFY must catch + roll back -------
+      v3 = reg.publish(states[2].params, step=300)
+      os.environ[chaos.ENV_DEPLOY] = "canary:poison"
+      chaos.reset()
+      try:
+        verdict = ctl.deploy(v3, bake_traffic=workload)
+      finally:
+        os.environ.pop(chaos.ENV_DEPLOY, None)
+        chaos.reset()
+      poison_caught = ((not verdict["ok"])
+                       and verdict["parity"]["mismatches"] > 0)
+      rollback_ok = bool(verdict.get("rollback_bit_identical"))
+      quarantined = reg.is_quarantined(v3)
+      never_promoted = (reg.latest() == v2
+                        and set(fleet.served_versions().values()) == {v2})
+      delta = base_snap.delta()
+      zero_shed = int(delta.get("shed", 0)) == 0
+      completed_mid = sum(1 for o in mid_outs if o is not None)
+    finally:
+      fleet.stop()
+  wall = time.perf_counter() - t0
+
+  ok = (killed and zero_shed and version_consistent and promote_parity
+        and poison_caught and rollback_ok and quarantined
+        and never_promoted)
+  result = {
+      "metric": "serving_deploy_canary_rollout",
+      "mode": "smoke" if args.smoke else "full",
+      "seed": args.seed, "wall_s": round(wall, 3),
+      "workload": {"requests": shape["requests"], "slots": shape["slots"],
+                   "replicas": shape["replicas"]},
+      "model": {k: shape[k] for k in ("layers", "heads", "d_model",
+                                      "d_ff", "vocab", "max_seq")},
+      "versions": {"baseline": v1, "promoted": v2, "poisoned": v3},
+      "killed_mid_promote": killed,
+      "served_mid_kill": {str(k): v for k, v in served_mid.items()},
+      "completed_during_partial_rollout": completed_mid,
+      "resume": resume_rep,
+      "version_consistent": version_consistent,
+      "promote_parity": promote_parity,
+      "poison_caught_by_verify": poison_caught,
+      "rollback_bit_identical": rollback_ok,
+      "quarantined": quarantined,
+      "never_promoted": never_promoted,
+      "zero_shed": zero_shed,
+      "fleet_counters": {k: int(delta.get(k, 0)) for k in
+                         ("dispatched", "shed", "swaps", "failovers",
+                          "canary_dispatches")},
+      "note": "continuous train→serve rollout under chaos: candidate v2 "
+              "driven CANARY→VERIFY→PROMOTE with the controller KILLED "
+              "at the first promote boundary (TOS_CHAOS_DEPLOY) — the "
+              "mixed-version fleet keeps completing requests, then "
+              "resume() converges every replica to v2 with outputs "
+              "bit-identical to the v2 reference decode; then poisoned "
+              "candidate v3 (params corrupted at the canary build) is "
+              "caught by VERIFY's greedy parity spot-checks, rolled "
+              "back bit-identically and quarantined. All gates are "
+              "hard: killed, zero_shed, version_consistent, "
+              "promote_parity, poison_caught, rollback_bit_identical, "
+              "quarantined, never_promoted",
+  }
+  line = json.dumps(result)
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    bench_history.append_record(
+        "serve_bench_deploy", 1.0 if ok else 0.0,
+        "%s-r%d-n%d-seed%d" % (result["mode"], shape["requests"],
+                               shape["replicas"], args.seed),
+        extra={"zero_shed": zero_shed,
+               "version_consistent": version_consistent,
+               "poison_caught": poison_caught})
+  print(line)
+  return 0 if ok else 3
+
+
 # --- chaos mode: goodput + recovery latency under injected faults -----------
 
 #: deterministic fault schedules for --chaos (TOS_CHAOS_SERVE grammar,
@@ -1008,8 +1195,14 @@ def main():
                   help="ServingFleet of N replicas vs one engine on the "
                        "seeded Zipf workload, with a mid-run rolling "
                        "param swap (parity + zero-shed gated)")
+  ap.add_argument("--deploy", action="store_true",
+                  help="continuous train→serve rollout drive: registry "
+                       "publish → canary → SLO/parity verify → promote "
+                       "with a chaos kill mid-promote (resume must "
+                       "converge, zero-shed) plus a poisoned candidate "
+                       "that VERIFY must quarantine")
   ap.add_argument("--replicas", type=int, default=0,
-                  help="--fleet replica count override")
+                  help="--fleet/--deploy replica count override")
   ap.add_argument("--chaos-spec", default=None,
                   help="--chaos: override the injected TOS_CHAOS_SERVE "
                        "fault schedule")
@@ -1034,12 +1227,14 @@ def main():
     sys.exit(run_prefix(args))
   if args.fleet:
     sys.exit(run_fleet(args))
+  if args.deploy:
+    sys.exit(run_deploy(args))
   if args.smoke:
     # the per-config modes take their MODEL shape from bench.py, which
     # is fixed at import by TOS_BENCH_SMOKE — a flag can't shrink it
     # retroactively, so refuse a misleading half-smoke
     sys.exit("--smoke shrinks --compare/--chaos/--prefix-workload/"
-             "--fleet; for the per-config decode modes set "
+             "--fleet/--deploy; for the per-config decode modes set "
              "TOS_BENCH_SMOKE=1 instead")
   if os.environ.get("TOS_BENCH_SMOKE"):
     args.batch, args.prompt, args.steps = 2, 16, 16
