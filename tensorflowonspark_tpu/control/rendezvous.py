@@ -310,6 +310,10 @@ class HeartbeatSender(object):
     self._thread: Optional[threading.Thread] = None
     self._client: Optional["Client"] = None
     self._failures = 0
+    # serializes _beat (and the client teardown in stop) against the
+    # loop thread: stop() joins with a TIMEOUT, so the bye beat can
+    # overlap a wedged in-flight beat and race _client/_failures
+    self._beat_lock = threading.Lock()
 
   def set_progress(self, value) -> None:
     # numpy/jax scalars are not msgpack-serializable; coerce to builtins
@@ -324,6 +328,10 @@ class HeartbeatSender(object):
     self._progress = value
 
   def _beat(self, bye: bool = False) -> bool:
+    with self._beat_lock:
+      return self._beat_locked(bye)
+
+  def _beat_locked(self, bye: bool) -> bool:
     try:
       if self._client is None:
         # short per-request deadline: a beat that cannot be delivered
@@ -388,9 +396,10 @@ class HeartbeatSender(object):
     if self._thread is not None:
       self._thread.join(timeout=max(1.0, 2 * self.interval))
     self._beat(bye=True)                # best-effort clean departure
-    if self._client is not None:
-      self._client.close()
-      self._client = None
+    with self._beat_lock:
+      if self._client is not None:
+        self._client.close()
+        self._client = None
 
 
 def _parse_port_spec(spec: str) -> List[int]:

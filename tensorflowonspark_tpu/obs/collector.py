@@ -95,6 +95,11 @@ class ObsShipper(object):
     self._samplers: List = []
     self._clock_gauges = None
     self._clock_last = None
+    # serializes ship/obs_send (and the client teardown in stop) against
+    # the loop thread: stop() joins with a TIMEOUT, so the final flush
+    # can overlap a wedged in-flight ship and race _seq/_last_acked/
+    # _client. RLock: ship() holds it across its obs_send() call.
+    self._ship_lock = threading.RLock()
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
 
@@ -149,6 +154,10 @@ class ObsShipper(object):
     Named into the analyzer's blocking-verb set (TOS001): callers must
     pass an explicit ``timeout``.
     """
+    with self._ship_lock:
+      return self._obs_send_locked(msg, timeout)
+
+  def _obs_send_locked(self, msg: dict, timeout: float) -> Optional[dict]:
     t0 = time.monotonic()
     try:
       client = self._ensure_client()
@@ -183,6 +192,10 @@ class ObsShipper(object):
     """Snapshot, subtract, drain, send. True when the driver acked."""
     if timeout is None:
       timeout = max(0.5, 2 * self.interval)
+    with self._ship_lock:
+      return self._ship_locked(timeout)
+
+  def _ship_locked(self, timeout: float) -> bool:
     self._run_samplers()
     cur = self.registry.snapshot() if self.registry is not None else {}
     delta = metrics_mod.snapshot_delta(cur, self._last_acked)
@@ -238,9 +251,10 @@ class ObsShipper(object):
     if self.recorder is not None:
       self._jsonl.append_spans(self.recorder.drain(None))
     self._jsonl.close(metrics_snapshot=final)
-    if self._client is not None:
-      self._client.close()
-      self._client = None
+    with self._ship_lock:
+      if self._client is not None:
+        self._client.close()
+        self._client = None
 
 
 class ObsSink(object):

@@ -442,6 +442,513 @@ def test_tos008_registered_env_passes():
   assert "TOS008" not in rules_of(analyze_snippet(TOS008_GOOD))
 
 
+# --- TOS009: unsynchronized shared-state mutation ---------------------------
+
+# the PR 10 incident shape: a stats counter bumped bare from the loop
+# thread AND from client threads — interleaved `+=` drops increments
+TOS009_BAD = '''
+import threading
+
+class Stats(object):
+  def __init__(self):
+    self.count = 0
+    self._thread = None
+
+  def start(self):
+    self._thread = threading.Thread(target=self._loop, daemon=True)
+    self._thread.start()
+
+  def _loop(self):
+    while True:
+      self._bump()
+
+  def _bump(self):
+    self.count += 1
+
+  def record(self, n):
+    self.count += n
+'''
+
+TOS009_GOOD_LOCKED = '''
+import threading
+
+class Stats(object):
+  def __init__(self):
+    self.count = 0
+    self._lock = threading.Lock()
+    self._thread = None
+
+  def start(self):
+    self._thread = threading.Thread(target=self._loop, daemon=True)
+    self._thread.start()
+
+  def _loop(self):
+    while True:
+      with self._lock:
+        self.count += 1
+
+  def record(self, n):
+    with self._lock:
+      self.count += n
+'''
+
+# just below the threshold: both sides only STORE (atomic under the
+# GIL); no read-modify-write means no lost update to flag
+TOS009_GOOD_PLAIN_STORES = '''
+import threading
+
+class Flag(object):
+  def __init__(self):
+    self.state = "idle"
+    self._thread = None
+
+  def start(self):
+    self._thread = threading.Thread(target=self._loop, daemon=True)
+    self._thread.start()
+
+  def _loop(self):
+    self.state = "running"
+
+  def reset(self):
+    self.state = "idle"
+'''
+
+# just below the threshold: the RMW happens on the loop thread only —
+# the client side never mutates the attribute
+TOS009_GOOD_ONE_SIDED = '''
+import threading
+
+class Ticker(object):
+  def __init__(self):
+    self.ticks = 0
+    self._thread = None
+
+  def start(self):
+    self._thread = threading.Thread(target=self._loop, daemon=True)
+    self._thread.start()
+
+  def _loop(self):
+    self.ticks += 1
+
+  def snapshot(self):
+    return self.ticks
+'''
+
+
+def test_tos009_bare_rmw_on_both_sides_fires():
+  result = analyze_snippet(TOS009_BAD)
+  tos9 = [f for f in result["findings"] if f.rule == "TOS009"]
+  assert len(tos9) == 1
+  assert tos9[0].detail == "attr:count"
+  assert tos9[0].symbol.endswith(".Stats")
+
+
+def test_tos009_common_lock_passes():
+  assert "TOS009" not in rules_of(analyze_snippet(TOS009_GOOD_LOCKED))
+
+
+def test_tos009_plain_stores_pass():
+  assert "TOS009" not in rules_of(analyze_snippet(TOS009_GOOD_PLAIN_STORES))
+
+
+def test_tos009_single_sided_rmw_passes():
+  assert "TOS009" not in rules_of(analyze_snippet(TOS009_GOOD_ONE_SIDED))
+
+
+def test_tos009_check_then_set_fires():
+  src = TOS009_BAD.replace(
+      "self.count += n",
+      "if self.count < n:\n      self.count = n")
+  result = analyze_snippet(src)
+  assert any(f.rule == "TOS009" and f.detail == "attr:count"
+             for f in result["findings"])
+
+
+# --- TOS010: lock-order inversion -------------------------------------------
+
+TOS010_BAD = '''
+import threading
+
+class Pair(object):
+  def __init__(self):
+    self._a = threading.Lock()
+    self._b = threading.Lock()
+
+  def forward(self):
+    with self._a:
+      self._tail()
+
+  def _tail(self):
+    with self._b:
+      pass
+
+  def backward(self):
+    with self._b:
+      with self._a:
+        pass
+'''
+
+TOS010_GOOD = '''
+import threading
+
+class Pair(object):
+  def __init__(self):
+    self._a = threading.Lock()
+    self._b = threading.Lock()
+
+  def forward(self):
+    with self._a:
+      self._tail()
+
+  def _tail(self):
+    with self._b:
+      pass
+
+  def also_forward(self):
+    with self._a:
+      with self._b:
+        pass
+'''
+
+
+def test_tos010_cross_method_inversion_fires():
+  result = analyze_snippet(TOS010_BAD)
+  tos10 = [f for f in result["findings"] if f.rule == "TOS010"]
+  assert len(tos10) == 1
+  assert tos10[0].detail == "cycle:_a->_b->_a"
+
+
+def test_tos010_consistent_order_passes():
+  assert "TOS010" not in rules_of(analyze_snippet(TOS010_GOOD))
+
+
+# --- TOS011: metric-name drift ----------------------------------------------
+
+def analyze_sources(sources, only_files=None):
+  return run_analysis(paths=[], sources=sources, only_files=only_files)
+
+
+TOS011_PRODUCER = '''
+def make_task_fn(reg):
+  def _task(it):
+    reg.counter("serve.good").inc()
+    reg.gauge("fleet." + "depth_kind").set(1)
+    return it
+  return _task
+'''
+
+TOS011_CONSUMER_OK = '''
+_SAMPLED = ("serve.good", "fleet.queue_depth")
+'''
+
+TOS011_CONSUMER_DRIFTED = '''
+_SAMPLED = ("serve.good", "serve.gone")
+'''
+
+TOS011_DOC_OK = '''## Metric catalogue
+
+| name | type | where |
+|---|---|---|
+| `serve.good` | counter | fixture |
+| `fleet.<kind>` | gauge | fixture |
+'''
+
+TOS011_DOC_MISSING = '''## Metric catalogue
+
+| name | type | where |
+|---|---|---|
+| `fleet.<kind>` | gauge | fixture |
+'''
+
+
+def test_tos011_consumer_of_unrecorded_name_fires():
+  result = analyze_sources({
+      "fixture/prod.py": TOS011_PRODUCER,
+      "fixture/anomaly.py": TOS011_CONSUMER_DRIFTED})
+  tos11 = [f for f in result["findings"] if f.rule == "TOS011"]
+  assert [f.detail for f in tos11] == ["unrecorded:serve.gone"]
+  assert tos11[0].path == "fixture/anomaly.py"
+
+
+def test_tos011_recorded_names_and_prefixes_pass():
+  # fleet.queue_depth is covered by the dynamic "fleet." + k producer
+  result = analyze_sources({
+      "fixture/prod.py": TOS011_PRODUCER,
+      "fixture/anomaly.py": TOS011_CONSUMER_OK})
+  assert "TOS011" not in rules_of(result)
+
+
+def test_tos011_undocumented_metric_fires():
+  result = analyze_sources({
+      "fixture/prod.py": TOS011_PRODUCER,
+      "fixture/OBSERVABILITY.md": TOS011_DOC_MISSING})
+  assert any(f.detail == "undocumented:serve.good"
+             for f in result["findings"])
+
+
+def test_tos011_documented_catalogue_passes():
+  result = analyze_sources({
+      "fixture/prod.py": TOS011_PRODUCER,
+      "fixture/OBSERVABILITY.md": TOS011_DOC_OK})
+  assert "TOS011" not in rules_of(result)
+
+
+def test_tos011_real_anomaly_and_catalogue_agree():
+  """Integration: every detector-sampled name, TOP_METRICS entry, SLO
+  objective metric and obs_top field is recorded somewhere in the real
+  package, and every recorded name has its OBSERVABILITY.md row."""
+  result = run_analysis(paths=["tensorflowonspark_tpu"])
+  tos11 = [f for f in result["all_findings"] if f.rule == "TOS011"]
+  assert tos11 == [], "metric drift:\n%s" % "\n".join(map(repr, tos11))
+  scope = result["scopes"]["TOS011"]
+  assert "tensorflowonspark_tpu/obs/anomaly.py" in scope
+  assert "docs/OBSERVABILITY.md" in scope
+  assert "tools/obs_top.py" in scope
+
+
+def test_tos011_seeded_detector_drift_fires():
+  """The acceptance scenario: rename one detector-sampled metric in the
+  real obs/anomaly.py and the contract must fire on exactly that name."""
+  from tools.analyze.engine import collect_files
+  files = collect_files(["tensorflowonspark_tpu"])
+  path = "tensorflowonspark_tpu/obs/anomaly.py"
+  assert '"serve.queue_depth",' in files[path]
+  files[path] = files[path].replace('"serve.queue_depth",',
+                                    '"serve.queue_depthz",', 1)
+  result = run_analysis(paths=[], sources=files)
+  details = {f.detail for f in result["findings"] if f.rule == "TOS011"}
+  assert details == {"unrecorded:serve.queue_depthz"}
+
+
+def test_tos011_changed_mode_reevaluates_whole_contract():
+  # the drifted finding lives in anomaly.py, but a change to the
+  # PRODUCER file must still re-fire it: contract scope, not file scope
+  result = analyze_sources({
+      "fixture/prod.py": TOS011_PRODUCER,
+      "fixture/anomaly.py": TOS011_CONSUMER_DRIFTED},
+      only_files=["fixture/prod.py"])
+  assert any(f.detail == "unrecorded:serve.gone"
+             for f in result["findings"])
+
+
+# --- TOS012: rendezvous verb contract ---------------------------------------
+
+TOS012_SERVER = '''
+class Server(object):
+  def _handle(self, sock, msg):
+    mtype = msg.get("type")
+    if mtype == "REG":
+      self.send(sock, {"type": "ACK"})
+    elif mtype in ("SYNC", "SYNCQ"):
+      self.send(sock, {"type": "ACK"})
+    else:
+      self.send(sock, {"type": "ERROR"})
+'''
+
+TOS012_CLIENT_OK = '''
+class Client(object):
+  def register(self):
+    return self._request({"type": "REG", "executor_id": 0})
+'''
+
+TOS012_CLIENT_BAD = '''
+class Client(object):
+  def ping(self):
+    msg = {"type": "PING", "executor_id": 0}
+    return self._request(msg)
+'''
+
+
+def test_tos012_unhandled_client_verb_fires():
+  result = analyze_sources({
+      "fixture/server.py": TOS012_SERVER,
+      "fixture/client.py": TOS012_CLIENT_BAD})
+  tos12 = [f for f in result["findings"] if f.rule == "TOS012"]
+  assert [f.detail for f in tos12] == ["verb:PING:unhandled"]
+  assert tos12[0].path == "fixture/client.py"
+
+
+def test_tos012_handled_verb_and_replies_pass():
+  # the server's own reply dicts ({"type": "ACK"} as send()'s SECOND
+  # arg) must not register as client sends
+  result = analyze_sources({
+      "fixture/server.py": TOS012_SERVER,
+      "fixture/client.py": TOS012_CLIENT_OK})
+  assert "TOS012" not in rules_of(result)
+
+
+def test_tos012_no_dispatcher_no_check():
+  # a model without any server (most fixtures) skips the verb contract
+  result = analyze_sources({"fixture/client.py": TOS012_CLIENT_BAD})
+  assert "TOS012" not in rules_of(result)
+
+
+def test_tos012_rendezvous_server_must_dispatch_wire_verbs():
+  from tools.analyze import contracts
+  arms = "\n".join('    elif mtype == "%s":\n      pass' % v
+                   for v in contracts.WIRE_VERBS if v != "SYNC")
+  src = ('class Server(object):\n'
+         '  def _handle(self, sock, msg):\n'
+         '    mtype = msg.get("type")\n'
+         '    if mtype == "NOP":\n'
+         '      pass\n' + arms + '\n')
+  result = analyze_sources({"fixture/control/rendezvous.py": src})
+  details = {f.detail for f in result["findings"] if f.rule == "TOS012"}
+  assert details == {"verb:SYNC:no-dispatch-arm"}
+
+
+def test_tos012_real_wire_is_complete():
+  result = run_analysis(paths=["tensorflowonspark_tpu"])
+  tos12 = [f for f in result["all_findings"] if f.rule == "TOS012"]
+  assert tos12 == [], "verb drift:\n%s" % "\n".join(map(repr, tos12))
+  assert "tensorflowonspark_tpu/control/rendezvous.py" in \
+      result["scopes"]["TOS012"]
+
+
+# --- TOS013: chaos-point coverage -------------------------------------------
+
+TOS013_GOOD = '''
+import os
+
+ENV_KILL = "TOS_CHAOS_KILL"
+ENV_STALL = "TOS_CHAOS_STALL"
+_KNOWN_ENV = (ENV_KILL, ENV_STALL)
+
+
+def check_config():
+  os.environ.get(ENV_KILL)
+  os.environ.get(ENV_STALL)
+
+
+def kill_point(name):
+  return os.environ.get(ENV_KILL)
+
+
+def stall_point(name):
+  return os.environ.get(ENV_STALL)
+'''
+
+
+def test_tos013_knob_without_hook_fires():
+  src = TOS013_GOOD.replace(
+      "def stall_point(name):\n  return os.environ.get(ENV_STALL)", "")
+  result = analyze_sources({"fixture/chaos.py": src})
+  details = {f.detail for f in result["findings"] if f.rule == "TOS013"}
+  assert details == {"knob:TOS_CHAOS_STALL:no-hook"}
+
+
+def test_tos013_knob_not_validated_fires():
+  src = TOS013_GOOD.replace("  os.environ.get(ENV_STALL)\n", "")
+  result = analyze_sources({"fixture/chaos.py": src})
+  details = {f.detail for f in result["findings"] if f.rule == "TOS013"}
+  assert details == {"knob:TOS_CHAOS_STALL:unchecked"}
+
+
+def test_tos013_hooked_unregistered_knob_fires():
+  src = TOS013_GOOD.replace("_KNOWN_ENV = (ENV_KILL, ENV_STALL)",
+                            "_KNOWN_ENV = (ENV_KILL,)")
+  result = analyze_sources({"fixture/chaos.py": src})
+  assert any(f.detail == "knob:TOS_CHAOS_STALL:unregistered"
+             for f in result["findings"])
+
+
+def test_tos013_aligned_knobs_pass():
+  assert "TOS013" not in rules_of(
+      analyze_sources({"fixture/chaos.py": TOS013_GOOD}))
+
+
+# --- the incremental cache ---------------------------------------------------
+
+_CACHE_TREE = {
+    "pkg/a.py": TOS001_BAD,
+    "pkg/b.py": TOS009_BAD,
+    "pkg/c.py": "X = 1\n",
+}
+
+
+def _write_tree(root, tree=None):
+  for rel, src in (tree or _CACHE_TREE).items():
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+  return [str(root / "pkg")]
+
+
+def _finding_rows(result):
+  return [(f.rule, f.path, f.line, f.symbol, f.detail, f.msg)
+          for f in result["all_findings"]]
+
+
+def test_cache_warm_run_is_byte_identical(tmp_path, monkeypatch):
+  monkeypatch.chdir(tmp_path)
+  paths = _write_tree(tmp_path)
+  cache_file = str(tmp_path / "cache.json")
+  cold = run_analysis(paths=paths, cache_path=cache_file)
+  warm = run_analysis(paths=paths, cache_path=cache_file)
+  assert warm["model"] is None, "warm run must be a full cache hit"
+  assert _finding_rows(cold) == _finding_rows(warm)
+  assert cold["reachable_count"] == warm["reachable_count"]
+  assert json.dumps(_finding_rows(cold)) == json.dumps(_finding_rows(warm))
+
+
+def test_cache_partial_invalidation_tracks_the_edit(tmp_path, monkeypatch):
+  monkeypatch.chdir(tmp_path)
+  paths = _write_tree(tmp_path)
+  cache_file = str(tmp_path / "cache.json")
+  before = run_analysis(paths=paths, cache_path=cache_file)
+  assert any(f.rule == "TOS009" for f in before["all_findings"])
+  # fix the race in b.py: the cached finding must disappear while a.py's
+  # cached TOS001 results are reused
+  (tmp_path / "pkg" / "b.py").write_text(TOS009_GOOD_LOCKED)
+  after = run_analysis(paths=paths, cache_path=cache_file)
+  assert after["model"] is not None      # partial, not a full hit
+  assert not any(f.rule == "TOS009" for f in after["all_findings"])
+  assert any(f.rule == "TOS001" for f in after["all_findings"])
+  # and the refreshed cache serves the new state verbatim
+  warm = run_analysis(paths=paths, cache_path=cache_file)
+  assert warm["model"] is None
+  assert _finding_rows(after) == _finding_rows(warm)
+
+
+def test_cache_ignores_version_skew(tmp_path, monkeypatch):
+  monkeypatch.chdir(tmp_path)
+  paths = _write_tree(tmp_path)
+  cache_file = tmp_path / "cache.json"
+  run_analysis(paths=paths, cache_path=str(cache_file))
+  data = json.loads(cache_file.read_text())
+  data["analyzer"] = "someone-elses-analyzer"
+  cache_file.write_text(json.dumps(data))
+  result = run_analysis(paths=paths, cache_path=str(cache_file))
+  assert result["model"] is not None     # recomputed, not trusted
+
+
+def test_cache_corrupt_file_is_discarded(tmp_path, monkeypatch):
+  monkeypatch.chdir(tmp_path)
+  paths = _write_tree(tmp_path)
+  cache_file = tmp_path / "cache.json"
+  cache_file.write_text("{not json")
+  result = run_analysis(paths=paths, cache_path=str(cache_file))
+  assert any(f.rule == "TOS001" for f in result["all_findings"])
+
+
+# --- machine-readable output -------------------------------------------------
+
+def test_json_schema_is_stable(tmp_path, capsys):
+  from tools.analyze.__main__ import main
+  _write_tree(tmp_path)
+  rc = main(["--json", "--no-cache", "--no-baseline",
+             str(tmp_path / "pkg")])
+  payload = json.loads(capsys.readouterr().out)
+  assert rc == 1
+  assert payload["schema"] == 1
+  rows = payload["tos"]["findings"]
+  assert rows, "fixture tree must produce findings"
+  for row in rows:
+    assert sorted(row) == ["baselined", "detail", "line", "path",
+                           "qualname", "rule"]
+  assert all(row["baselined"] is False for row in rows)
+
+
 # --- suppression + baseline mechanics ---------------------------------------
 
 def test_inline_suppression():

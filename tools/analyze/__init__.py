@@ -5,39 +5,97 @@
 ``python -m tools.analyze --all``    — both (what ``make analyze`` runs)
 
 See docs/ANALYSIS.md for the rule catalogue, the incidents each rule
-encodes, and the baseline/suppression policy.
+encodes, the baseline/suppression policy, and the incremental cache.
 """
 
+import os
 from typing import Dict, List, Optional
 
 from tools.analyze import baseline as baseline_mod
+from tools.analyze import cache as cache_mod
+from tools.analyze import contracts as contracts_mod
+from tools.analyze import races as races_mod
 from tools.analyze.engine import RepoModel, collect_files
 from tools.analyze.rules import Finding, run_rules
 
 __all__ = ["run_analysis", "RepoModel", "Finding"]
 
+# fixture sources routed to the contract passes instead of the model:
+# the doc catalogue plus obs_top-style out-of-package metric readers
+_AUX_BASENAMES = ("obs_top.py",)
+
+
+def _split_aux(files: Dict[str, str]):
+  py, aux = {}, {}
+  for path, src in files.items():
+    if path.endswith(".md") or os.path.basename(path) in _AUX_BASENAMES:
+      aux[path] = src
+    else:
+      py[path] = src
+  return py, aux
+
+
+def _disk_aux() -> Dict[str, str]:
+  aux: Dict[str, str] = {}
+  for path in contracts_mod.EXTRA_CONSUMER_FILES + (contracts_mod.DOC_PATH,):
+    if os.path.exists(path):
+      with open(path, encoding="utf-8") as f:
+        aux[path] = f.read()
+  return aux
+
 
 def run_analysis(paths: List[str], baseline_path: Optional[str] = None,
                  only_files: Optional[List[str]] = None,
-                 sources: Optional[Dict[str, str]] = None) -> dict:
+                 sources: Optional[Dict[str, str]] = None,
+                 cache_path: Optional[str] = None) -> dict:
   """Run the TOS rule passes; returns a result dict.
 
   ``paths``: roots to parse (the whole set feeds the call graph, so
   reachability is computed repo-wide even with ``only_files``).
-  ``only_files``: restrict REPORTED findings to these files.
-  ``sources``: pre-loaded {path: source} (tests inject fixtures here).
+  ``only_files``: restrict REPORTED findings to these files. A contract
+  rule (TOS011–TOS013) whose scope intersects the slice reports ALL its
+  findings — its producers and consumers live in different files.
+  ``sources``: pre-loaded {path: source} (tests inject fixtures here;
+  ``.md`` entries and obs_top-style readers feed the contract passes).
+  ``cache_path``: enable the incremental cache (see tools/analyze/cache).
   """
-  files = sources if sources is not None else collect_files(paths)
-  model = RepoModel(files)
-  findings = run_rules(model)
-  for path, lineno, msg in model.parse_errors:
-    findings.append(Finding("TOS000", path, lineno, "<module>",
-                            "syntax", msg))
+  if sources is not None:
+    files, aux_sources = _split_aux(sources)
+  else:
+    files = collect_files(paths)
+    aux_sources = _disk_aux()
+
+  model: Optional[RepoModel] = None
+  if cache_path is not None and sources is None:
+    findings, reachable_count, model, scopes = cache_mod.analysis_pass(
+        files, aux_sources, cache_path)
+  else:
+    model = RepoModel(files)
+    findings = run_rules(model)
+    findings.extend(races_mod.run_races(model))
+    contract_findings, scopes = contracts_mod.run_contracts(model,
+                                                            aux_sources)
+    findings.extend(contract_findings)
+    for path, lineno, msg in model.parse_errors:
+      findings.append(Finding("TOS000", path, lineno, "<module>",
+                              "syntax", msg))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail,
+                                 f.symbol))
+    reachable_count = len(model.reachable())
+
   if only_files is not None:
     wanted = set(only_files)
-    findings = [f for f in findings if f.path in wanted]
+    # a changed file inside a contract's scope re-fires the whole
+    # contract: keep every finding of any scope-intersecting rule
+    live_rules = {rule for rule, scope in scopes.items()
+                  if scope & wanted}
+    findings = [f for f in findings
+                if f.path in wanted or f.rule in live_rules]
 
-  findings, suppressed = baseline_mod.apply_suppressions(findings, files)
+  sup_sources = dict(files)
+  sup_sources.update(aux_sources)      # inline ignores work in aux files too
+  findings, suppressed = baseline_mod.apply_suppressions(findings,
+                                                         sup_sources)
   baselined: List[Finding] = []
   stale: List[dict] = []
   all_findings = list(findings)
@@ -47,9 +105,13 @@ def run_analysis(paths: List[str], baseline_path: Optional[str] = None,
                                                              entries)
     if only_files is not None:
       # a partial run cannot see every finding, so absent matches for
-      # entries outside the slice are not staleness
+      # entries outside the slice are not staleness — except contract
+      # rules, which were fully re-evaluated above
       wanted = set(only_files)
-      stale = [e for e in stale if e["path"] in wanted]
+      live_rules = {rule for rule, scope in scopes.items()
+                    if scope & wanted}
+      stale = [e for e in stale
+               if e["path"] in wanted or e["rule"] in live_rules]
   return {
       "findings": findings,
       "all_findings": all_findings,
@@ -57,6 +119,7 @@ def run_analysis(paths: List[str], baseline_path: Optional[str] = None,
       "suppressed": suppressed,
       "stale": stale,
       "files": len(files),
-      "reachable_count": len(model.reachable()),
+      "reachable_count": reachable_count,
       "model": model,
+      "scopes": scopes,
   }

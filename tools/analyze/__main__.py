@@ -12,12 +12,26 @@ import sys
 from tools.analyze import run_analysis
 from tools.analyze import style as style_mod
 from tools.analyze.baseline import DEFAULT_BASELINE, write_baseline
+from tools.analyze.cache import DEFAULT_CACHE
 
 TOS_DEFAULT_PATHS = ["tensorflowonspark_tpu"]
 
+#: --json payload layout version; bump on any field change so CI diffing
+#: tools can hard-fail instead of misreading
+JSON_SCHEMA = 1
+
+
+def _finding_row(f, baselined):
+  """The stable --json finding shape (docs/ANALYSIS.md §Machine-readable
+  output): rule, path, line, qualname, detail, baselined."""
+  return {"rule": f.rule, "path": f.path, "line": f.line,
+          "qualname": f.symbol, "detail": f.detail,
+          "baselined": baselined}
+
 
 def _changed_files():
-  """Tracked-but-modified + staged + untracked .py files (fast iteration)."""
+  """Tracked-but-modified + staged + untracked .py files, plus .md files
+  (a doc-catalogue edit is a TOS011 contract input, not style input)."""
   # -uall: without it git collapses a brand-new package to one
   # "?? dir/" line and every file inside it would escape the gate
   out = subprocess.run(["git", "status", "--porcelain", "-uall"],
@@ -25,7 +39,7 @@ def _changed_files():
   files = []
   for line in out.stdout.splitlines():
     path = line[3:].split(" -> ")[-1].strip()
-    if path.endswith(".py"):
+    if path.endswith((".py", ".md")):
       files.append(path)
   return files
 
@@ -52,6 +66,10 @@ def main(argv=None):
                   help="rewrite the baseline from current findings and exit")
   ap.add_argument("--quiet", action="store_true",
                   help="suppress the per-finding lines (summary only)")
+  ap.add_argument("--no-cache", action="store_true",
+                  help="bypass the incremental cache (make analyze-cold)")
+  ap.add_argument("--cache", default=DEFAULT_CACHE,
+                  help="cache file (default: %s)" % DEFAULT_CACHE)
   args = ap.parse_args(argv)
 
   if args.write_baseline and args.changed:
@@ -61,25 +79,28 @@ def main(argv=None):
 
   changed = _changed_files() if args.changed else None
   if args.changed and not changed:
-    print("analyze: no changed .py files")
+    print("analyze: no changed .py/.md files")
     return 0
 
   rc = 0
-  payload = {}
+  payload = {"schema": JSON_SCHEMA}
+  cache_path = None if args.no_cache else args.cache
 
   if not args.style:   # TOS rules (default, or part of --all)
     paths = args.paths or TOS_DEFAULT_PATHS
     result = run_analysis(
         paths=paths,
         baseline_path=None if args.no_baseline else args.baseline,
-        only_files=changed)
+        only_files=changed,
+        cache_path=cache_path)
     if args.write_baseline:
       write_baseline(result["all_findings"], args.baseline)
       print("analyze: wrote %d baseline entries to %s (fill in the reason "
             "fields)" % (len(result["all_findings"]), args.baseline))
       return 0
     payload["tos"] = {
-        "findings": [vars(f) for f in result["findings"]],
+        "findings": [_finding_row(f, False) for f in result["findings"]] +
+                    [_finding_row(f, True) for f in result["baselined"]],
         "baselined": len(result["baselined"]),
         "suppressed": len(result["suppressed"]),
         "stale_baseline": result["stale"],
@@ -106,8 +127,12 @@ def main(argv=None):
   if args.style or args.all:
     style_paths = args.paths or None
     if changed is not None:
-      style_paths = changed
-    files, findings = style_mod.run_style(style_paths)
+      style_paths = [p for p in changed if p.endswith(".py")]
+    if style_paths == []:     # --changed slice held only .md files
+      files, findings = [], []
+    else:
+      files, findings = style_mod.run_style(style_paths,
+                                            cache_path=cache_path)
     payload["style"] = {"findings": [{"path": p, "line": ln, "msg": m}
                                      for p, ln, m in findings],
                         "files": len(files)}

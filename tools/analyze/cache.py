@@ -1,0 +1,242 @@
+"""Content-hash incremental cache for the analysis suite.
+
+The gate re-runs on every ``make analyze`` / tier-1 pass, but between
+runs almost nothing changes — so results are keyed on content digests
+and reused:
+
+- **analyzer digest** — sha256 over every ``tools/analyze/*.py`` source
+  plus the per-rule version maps. Any analyzer edit invalidates
+  everything (rule logic is not diffable more finely than that).
+- **full reuse** — when every analyzed file, aux consumer file, and the
+  doc catalogue hash to the cached digests, the stored findings are
+  returned verbatim: no parse, no call graph, no rule passes.
+- **per-file reuse** — otherwise the model is rebuilt (reachability and
+  the jitted set are whole-repo properties), but a file's per-function
+  results (TOS001–TOS007), race results (TOS009/TOS010) and parse
+  errors are reused when its ``(content, reachability-slice, jitted-
+  slice)`` key is unchanged. The reachability slice is the digest of
+  the file's executor-reachable functions, so an edit elsewhere that
+  flips reachability here invalidates exactly this file — the
+  "invalidated transitively through the call graph" contract.
+- **contracts** (TOS011–TOS013) and the env registry (TOS008) are
+  cross-file by definition and recomputed on any partial run.
+- the **style pass** caches per file on content digest alone.
+
+The cache lives in ``.tosa_cache.json`` (gitignored); ``--no-cache`` /
+``make analyze-cold`` bypasses it. Corrupt or version-skewed caches are
+discarded, never trusted.
+"""
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tools.analyze import contracts, races, rules
+from tools.analyze.engine import RepoModel
+from tools.analyze.rules import Finding
+
+CACHE_VERSION = 1
+DEFAULT_CACHE = ".tosa_cache.json"
+
+_ANALYZER_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def digest(text: str) -> str:
+  return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def digest_items(items) -> str:
+  return digest("\x00".join(sorted(items)))
+
+
+def analyzer_digest() -> str:
+  """Hash of the analyzer's own sources + declared rule versions."""
+  parts = []
+  for name in sorted(os.listdir(_ANALYZER_DIR)):
+    if not name.endswith(".py"):
+      continue
+    with open(os.path.join(_ANALYZER_DIR, name), encoding="utf-8") as f:
+      parts.append(name + "\x00" + f.read())
+  for versions in (rules.RULE_VERSIONS, races.RULE_VERSIONS,
+                   contracts.RULE_VERSIONS):
+    parts.append(json.dumps(versions, sort_keys=True))
+  return digest("\x01".join(parts))
+
+
+def load(path: str) -> Optional[dict]:
+  try:
+    with open(path, encoding="utf-8") as f:
+      data = json.load(f)
+  except (OSError, ValueError):
+    return None
+  if not isinstance(data, dict) or data.get("version") != CACHE_VERSION:
+    return None
+  return data
+
+
+def save(path: str, data: dict) -> None:
+  data["version"] = CACHE_VERSION
+  tmp_fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                 prefix=".tosa_cache.")
+  try:
+    with os.fdopen(tmp_fd, "w", encoding="utf-8") as f:
+      json.dump(data, f, sort_keys=True)
+    os.replace(tmp, path)
+  except OSError:
+    try:
+      os.unlink(tmp)
+    except OSError:
+      pass
+
+
+def _to_row(f: Finding) -> list:
+  return [f.rule, f.path, f.line, f.symbol, f.detail, f.msg]
+
+
+def _from_row(row: list) -> Finding:
+  return Finding(row[0], row[1], row[2], row[3], row[4], row[5])
+
+
+def _sort(findings: List[Finding]) -> List[Finding]:
+  findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail, f.symbol))
+  return findings
+
+
+def analyze_model(model: RepoModel,
+                  aux_sources: Optional[Dict[str, str]]) -> List[Finding]:
+  """The full (uncached) rule suite over a built model — the single
+  source of truth the cache layers must reproduce byte-for-byte."""
+  findings = rules.run_rules(model)
+  findings.extend(races.run_races(model))
+  cf, _scopes = contracts.run_contracts(model, aux_sources)
+  findings.extend(cf)
+  for path, lineno, msg in model.parse_errors:
+    findings.append(Finding("TOS000", path, lineno, "<module>",
+                            "syntax", msg))
+  return _sort(findings)
+
+
+def _perfile_keys(model: RepoModel, file_shas: Dict[str, str]):
+  """path -> [content sha, reachability-slice fp, jitted-slice fp]."""
+  reach = model.reachable()
+  jitted = rules._collect_jitted(model)
+  reach_by_path: Dict[str, list] = {}
+  jit_by_path: Dict[str, list] = {}
+  for qual, fn in model.functions.items():
+    if qual in reach:
+      reach_by_path.setdefault(fn.path, []).append(qual)
+    if qual in jitted:
+      jit_by_path.setdefault(fn.path, []).append(qual)
+  keys = {}
+  for path, sha in file_shas.items():
+    keys[path] = [sha, digest_items(reach_by_path.get(path, [])),
+                  digest_items(jit_by_path.get(path, []))]
+  return keys, jitted
+
+
+def _compute_file(model: RepoModel, path: str, jitted,
+                  class_by_path) -> List[Finding]:
+  """Per-file bucket: function rules + races + parse errors."""
+  out: List[Finding] = []
+  for fn in model.functions.values():
+    if fn.path == path:
+      out.extend(rules.run_function_rules(model, fn, jitted))
+  for cls, members in class_by_path.get(path, []):
+    out.extend(races.check_tos009(model, cls, members))
+    out.extend(races.check_tos010(model, cls, members))
+  for epath, lineno, msg in model.parse_errors:
+    if epath == path:
+      out.append(Finding("TOS000", path, lineno, "<module>", "syntax", msg))
+  return out
+
+
+def analysis_pass(files: Dict[str, str],
+                  aux_sources: Dict[str, str],
+                  cache_path: str) -> Tuple[List[Finding], int,
+                                            Optional[RepoModel], dict]:
+  """Cache-aware equivalent of ``RepoModel`` + :func:`analyze_model`.
+
+  Returns ``(findings, reachable_count, model_or_None, scopes)`` —
+  the model is None on a full cache hit (nothing was parsed).
+  """
+  adig = analyzer_digest()
+  file_shas = {p: digest(s) for p, s in files.items()}
+  aux_shas = {p: digest(s) for p, s in aux_sources.items()}
+  data = load(cache_path)
+  if data is not None and data.get("analyzer") != adig:
+    data = None
+
+  if data is not None and data.get("files") == file_shas \
+      and data.get("aux") == aux_shas:
+    findings = [_from_row(r) for r in data["findings"]]
+    scopes = {k: set(v) for k, v in data.get("scopes", {}).items()}
+    return findings, data["reachable_count"], None, scopes
+
+  model = RepoModel(files)
+  keys, jitted = _perfile_keys(model, file_shas)
+  cached_perfile = (data or {}).get("perfile", {})
+  class_by_path: Dict[str, list] = {}
+  for cls, members in sorted(races._class_members(model).items()):
+    path = next(iter(members.values())).path
+    class_by_path.setdefault(path, []).append((cls, members))
+
+  perfile: Dict[str, dict] = {}
+  findings: List[Finding] = []
+  for path in sorted(files):
+    old = cached_perfile.get(path)
+    if old is not None and old.get("key") == keys[path]:
+      rows = old["rows"]
+    else:
+      rows = [_to_row(f) for f in
+              _sort(_compute_file(model, path, jitted, class_by_path))]
+    perfile[path] = {"key": keys[path], "rows": rows}
+    findings.extend(_from_row(r) for r in rows)
+
+  # cross-file passes: always recomputed on a partial run
+  findings.extend(rules.check_tos008(model))
+  cf, scopes = contracts.run_contracts(model, aux_sources)
+  findings.extend(cf)
+  _sort(findings)
+
+  save(cache_path, {
+      "analyzer": adig,
+      "files": file_shas,
+      "aux": aux_shas,
+      "perfile": perfile,
+      "findings": [_to_row(f) for f in findings],
+      "scopes": {k: sorted(v) for k, v in scopes.items()},
+      "reachable_count": len(model.reachable()),
+      "style": (data or {}).get("style", {}),
+  })
+  return findings, len(model.reachable()), model, scopes
+
+
+def style_pass(files: List[str], cache_path: str,
+               lint_file: Callable[[str, list], None]) -> list:
+  """Per-file style results keyed on content digest alone."""
+  data = load(cache_path) or {}
+  if data.get("analyzer") != analyzer_digest():
+    data = {"analyzer": analyzer_digest()}
+  cached = data.get("style", {})
+  fresh: Dict[str, dict] = {}
+  findings: list = []
+  for path in files:
+    try:
+      with open(path, encoding="utf-8") as f:
+        sha = digest(f.read())
+    except OSError:
+      sha = None
+    old = cached.get(path)
+    if sha is not None and old is not None and old.get("sha") == sha:
+      rows = old["rows"]
+    else:
+      bucket: list = []
+      lint_file(path, bucket)
+      rows = [[p, ln, msg] for p, ln, msg in bucket]
+    fresh[path] = {"sha": sha, "rows": rows}
+    findings.extend((p, ln, msg) for p, ln, msg in rows)
+  data["style"] = fresh
+  save(cache_path, data)
+  return findings

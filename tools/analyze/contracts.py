@@ -1,0 +1,523 @@
+"""Cross-plane contract rule passes (the TOS011–TOS013 family).
+
+Unlike the per-function rules, each of these checks a *pair of surfaces*
+that must agree, so a change to any file on either side re-evaluates the
+whole contract (``run_contracts`` also reports each rule's file scope so
+``--changed`` can widen its slice):
+
+TOS011 — metric-name drift.  Producers are every name recorded through
+the registry verbs (``counter/gauge/histogram/quantiles`` with a string
+literal or a literal prefix); consumers are the detector sampled-name
+tuples, ``TOP_METRICS``/``TOP_METRIC_PREFIXES``, ``metric=`` kwargs
+(SLO objectives), and the ``obs_top`` field reads.  A consumer of a
+never-recorded name is dead monitoring; a recorded name missing from
+the OBSERVABILITY.md catalogue is an undocumented surface.
+
+TOS012 — rendezvous verb contract.  Every verb literal a client sends
+(``{"type": "VERB", ...}`` as a request payload) must have a dispatch
+arm in some server (``mtype = msg.get("type")`` + ``mtype == "VERB"``),
+and the canonical wire-verb set must all be dispatched by the rendezvous
+server — a dead or unregistered verb (the SYNC/SYNCQ/GROUP incident)
+turns into a client-visible ERROR only at runtime.
+
+TOS013 — chaos-point coverage.  Every ``TOS_CHAOS_*`` knob registered in
+``_KNOWN_ENV`` must be validated by ``check_config`` AND consulted by at
+least one live injection hook, and every hook's knob must be registered
+— a typo'd knob is a silent no-op (the class PR 3 fixed once by hand).
+"""
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.engine import RepoModel
+from tools.analyze.rules import Finding
+
+#: bumped when a rule's logic changes; the incremental cache keys on it
+RULE_VERSIONS = {"TOS011": 1, "TOS012": 1, "TOS013": 1}
+
+# the metric catalogue + consumers living outside the analyzed package;
+# read from disk when present so the contract sees the whole surface
+DOC_PATH = "docs/OBSERVABILITY.md"
+EXTRA_CONSUMER_FILES = ("tools/obs_top.py",)
+
+_RECORD_VERBS = ("counter", "gauge", "histogram", "quantiles")
+# consumer tuple/list assignment names (module or class scope)
+_CONSUMER_NAMES = re.compile(r"^(_SAMPLED|TOP_METRICS|_AVAIL_.*|.*_METRICS)$")
+_PREFIX_CONSUMER_NAMES = ("TOP_METRIC_PREFIXES",)
+_METRIC_NAME = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_<>]+)+$")
+
+# the canonical rendezvous wire: every verb a runtime client can block
+# on must have a Server._handle arm (TOS001's blocking-verb set is the
+# transport methods; this is the message vocabulary riding them)
+WIRE_VERBS = ("REG", "BEAT", "OBS", "HEALTH", "QINFO", "QUERY", "LIST",
+              "BARRIER", "BQUERY", "SYNC", "SYNCQ", "GROUP", "STOP")
+_VERB_RE = re.compile(r"^[A-Z][A-Z_]{1,30}$")
+
+_CHAOS_PREFIX = "TOS_CHAOS_"
+
+
+# -- TOS011: metric-name drift ----------------------------------------------
+
+def _str_const(node) -> Optional[str]:
+  if isinstance(node, ast.Constant) and isinstance(node.value, str):
+    return node.value
+  return None
+
+
+def _metric_arg(node) -> Optional[Tuple[str, bool]]:
+  """(name-or-prefix, is_prefix) for a registry-verb first argument."""
+  s = _str_const(node)
+  if s is not None:
+    return s, False
+  if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+    left = _str_const(node.left)
+    if left is not None:
+      return left, True
+  if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+    left = _str_const(node.left)
+    if left is not None:
+      return left.split("%")[0], True
+  if isinstance(node, ast.JoinedStr) and node.values:
+    lead = _str_const(node.values[0])
+    if lead is not None:
+      return lead, True
+  return None
+
+
+def _collect_producers(trees: Dict[str, ast.AST]):
+  """[(name_or_prefix, is_prefix, path, lineno)] from registry verbs."""
+  out = []
+  for path, tree in trees.items():
+    for node in ast.walk(tree):
+      if not (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in _RECORD_VERBS and node.args):
+        continue
+      got = _metric_arg(node.args[0])
+      if got is None:
+        continue
+      name, is_prefix = got
+      if "." not in name:        # registry names are dotted planes
+        continue
+      out.append((name, is_prefix, path, node.lineno))
+  return out
+
+
+def _tuple_strs(node) -> List[str]:
+  if not isinstance(node, (ast.Tuple, ast.List)):
+    return []
+  out = []
+  for e in node.elts:
+    s = _str_const(e)
+    if s is not None:
+      out.append(s)
+  return out
+
+
+def _collect_consumers(trees: Dict[str, ast.AST],
+                       aux_trees: Dict[str, ast.AST]):
+  """exact/prefix/pattern consumer lists, each [(value, path, lineno)]."""
+  exact, prefixes, patterns = [], [], []
+  for path, tree in trees.items():
+    pipe_prefix = pipe_suffix = None
+    for node in ast.walk(tree):
+      if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+          and isinstance(node.targets[0], ast.Name):
+        tname = node.targets[0].id
+        if _CONSUMER_NAMES.match(tname):
+          for s in _tuple_strs(node.value):
+            exact.append((s, path, node.lineno))
+        elif tname in _PREFIX_CONSUMER_NAMES:
+          for s in _tuple_strs(node.value):
+            prefixes.append((s, path, node.lineno))
+        elif tname == "_PIPE_PREFIX":
+          pipe_prefix = (_str_const(node.value), node.lineno)
+        elif tname == "_PIPE_SUFFIX":
+          pipe_suffix = (_str_const(node.value), node.lineno)
+      if isinstance(node, ast.keyword) and node.arg == "metric":
+        s = _str_const(node.value)
+        if s is not None and _METRIC_NAME.match(s):
+          exact.append((s, path, node.value.lineno))
+    if pipe_prefix and pipe_prefix[0] and pipe_suffix and pipe_suffix[0]:
+      patterns.append((pipe_prefix[0] + "*" + pipe_suffix[0],
+                       path, pipe_prefix[1]))
+    elif pipe_prefix and pipe_prefix[0]:
+      prefixes.append((pipe_prefix[0], path, pipe_prefix[1]))
+  for path, tree in aux_trees.items():
+    # obs_top-style readers: snap.get("serve.tokens"),
+    # name.startswith("feed.stage.")
+    for node in ast.walk(tree):
+      if not (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute) and node.args):
+        continue
+      s = _str_const(node.args[0])
+      if s is None:
+        continue
+      if node.func.attr == "get" and _METRIC_NAME.match(s):
+        exact.append((s, path, node.lineno))
+      elif node.func.attr == "startswith" and "." in s:
+        prefixes.append((s, path, node.lineno))
+  return exact, prefixes, patterns
+
+
+def _parse_doc_catalogue(doc_text: str) -> Tuple[Set[str], Set[str]]:
+  """(exact names, fnmatch patterns) from the '## Metric catalogue'
+  table: backticked comma-separated names in the first column;
+  ``<placeholder>`` segments become wildcards."""
+  exact: Set[str] = set()
+  patterns: Set[str] = set()
+  in_section = False
+  for line in doc_text.splitlines():
+    if line.startswith("## "):
+      in_section = "metric catalogue" in line.lower()
+      continue
+    if not in_section or not line.lstrip().startswith("|"):
+      continue
+    first_cell = line.split("|")[1] if line.count("|") >= 2 else ""
+    for name in re.findall(r"`([^`]+)`", first_cell):
+      name = name.strip()
+      if not name or " " in name:
+        continue
+      if "<" in name:
+        patterns.add(re.sub(r"<[^>]*>", "*", name))
+      else:
+        exact.add(name)
+  return exact, patterns
+
+
+def check_tos011(trees, aux_trees, doc_text, doc_path):
+  producers = _collect_producers(trees)
+  rec_exact = {n for n, p, _pa, _ln in producers if not p}
+  rec_prefix = {n for n, p, _pa, _ln in producers if p}
+  c_exact, c_prefix, c_pattern = _collect_consumers(trees, aux_trees)
+
+  def recorded(name):
+    return name in rec_exact or \
+        any(name.startswith(p) for p in rec_prefix)
+
+  def recorded_prefix(pre):
+    return any(e.startswith(pre) for e in rec_exact) or \
+        any(rp.startswith(pre) or pre.startswith(rp) for rp in rec_prefix)
+
+  def recorded_pattern(pat):
+    pre = pat.split("*")[0]
+    return any(fnmatch.fnmatch(e, pat) for e in rec_exact) or \
+        any(rp.startswith(pre) or pre.startswith(rp) for rp in rec_prefix)
+
+  for name, path, lineno in sorted(set(c_exact)):
+    if not recorded(name):
+      yield Finding(
+          "TOS011", path, lineno, "<metrics>", "unrecorded:%s" % name,
+          "metric %r is consumed here but never recorded by any "
+          "registry call — a rename upstream silently blinded this "
+          "consumer (see docs/ANALYSIS.md TOS011)" % name)
+  for pre, path, lineno in sorted(set(c_prefix)):
+    if not recorded_prefix(pre):
+      yield Finding(
+          "TOS011", path, lineno, "<metrics>", "unrecorded:%s*" % pre,
+          "metric prefix %r is consumed here but no recorded metric "
+          "matches it (see docs/ANALYSIS.md TOS011)" % pre)
+  for pat, path, lineno in sorted(set(c_pattern)):
+    if not recorded_pattern(pat):
+      yield Finding(
+          "TOS011", path, lineno, "<metrics>", "unrecorded:%s" % pat,
+          "metric pattern %r is consumed here but no recorded metric "
+          "matches it (see docs/ANALYSIS.md TOS011)" % pat)
+
+  if doc_text is None:
+    return
+  doc_exact, doc_patterns = _parse_doc_catalogue(doc_text)
+
+  def documented(name):
+    return name in doc_exact or \
+        any(fnmatch.fnmatch(name, p) for p in doc_patterns)
+
+  def documented_prefix(pre):
+    heads = {p.split("*")[0] for p in doc_patterns}
+    return any(e.startswith(pre) for e in doc_exact) or \
+        any(h.startswith(pre) or pre.startswith(h) for h in heads)
+
+  seen: Set[str] = set()
+  for name, is_prefix, path, lineno in sorted(producers,
+                                              key=lambda t: (t[0], t[2],
+                                                             t[3])):
+    if name in seen:
+      continue
+    seen.add(name)
+    if is_prefix:
+      if not documented_prefix(name):
+        yield Finding(
+            "TOS011", path, lineno, "<metrics>",
+            "undocumented:%s*" % name,
+            "metrics under prefix %r are recorded here but have no row "
+            "in the %s catalogue (see docs/ANALYSIS.md TOS011)"
+            % (name, doc_path))
+    elif not documented(name):
+      yield Finding(
+          "TOS011", path, lineno, "<metrics>", "undocumented:%s" % name,
+          "metric %r is recorded here but missing from the %s "
+          "catalogue's name column (see docs/ANALYSIS.md TOS011)"
+          % (name, doc_path))
+
+
+# -- TOS012: rendezvous verb contract ---------------------------------------
+
+def _dispatchers(model: RepoModel):
+  """[(fn, {verb arms})] for functions doing string-verb dispatch."""
+  out = []
+  for fn in model.functions.values():
+    dispatch_vars: Set[str] = set()
+    for node in fn.body_nodes():
+      if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+          and isinstance(node.targets[0], ast.Name) \
+          and isinstance(node.value, ast.Call) \
+          and isinstance(node.value.func, ast.Attribute) \
+          and node.value.func.attr == "get" and node.value.args \
+          and _str_const(node.value.args[0]) == "type":
+        dispatch_vars.add(node.targets[0].id)
+    if not dispatch_vars:
+      continue
+    arms: Set[str] = set()
+    for node in fn.body_nodes():
+      if not (isinstance(node, ast.Compare)
+              and isinstance(node.left, ast.Name)
+              and node.left.id in dispatch_vars
+              and len(node.ops) == 1):
+        continue
+      if isinstance(node.ops[0], ast.Eq):
+        s = _str_const(node.comparators[0])
+        if s is not None:
+          arms.add(s)
+      elif isinstance(node.ops[0], ast.In):
+        arms.update(_tuple_strs(node.comparators[0]))
+    if arms:
+      out.append((fn, arms))
+  return out
+
+
+def _sent_verbs(model: RepoModel):
+  """[(verb, fn, lineno)] — dict payloads with an uppercase "type" that
+  are passed as the first argument of a call (directly or via a local),
+  i.e. a client request; server replies (arg position > 0) and returned
+  reply dicts don't match."""
+  out = []
+  for fn in model.functions.values():
+    dict_verbs: Dict[str, Tuple[str, int]] = {}   # local name -> verb
+
+    def verb_of(node):
+      if not isinstance(node, ast.Dict):
+        return None
+      for k, v in zip(node.keys, node.values):
+        if k is not None and _str_const(k) == "type":
+          s = _str_const(v)
+          if s is not None and _VERB_RE.match(s):
+            return s
+      return None
+
+    for node in fn.body_nodes():
+      if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+          and isinstance(node.targets[0], ast.Name):
+        verb = verb_of(node.value)
+        if verb is not None:
+          dict_verbs[node.targets[0].id] = (verb, node.value.lineno)
+    for node in fn.body_nodes():
+      if not (isinstance(node, ast.Call) and node.args):
+        continue
+      arg0 = node.args[0]
+      verb = verb_of(arg0)
+      if verb is not None:
+        out.append((verb, fn, arg0.lineno))
+      elif isinstance(arg0, ast.Name) and arg0.id in dict_verbs:
+        verb, lineno = dict_verbs[arg0.id]
+        out.append((verb, fn, node.lineno))
+  return out
+
+
+def check_tos012(model: RepoModel):
+  dispatchers = _dispatchers(model)
+  if not dispatchers:
+    return       # no server in scope (most fixtures): nothing to check
+  all_arms: Set[str] = set()
+  for _fn, arms in dispatchers:
+    all_arms |= arms
+  seen: Set[Tuple[str, str]] = set()
+  for verb, fn, lineno in sorted(_sent_verbs(model),
+                                 key=lambda t: (t[1].path, t[2], t[0])):
+    if verb in all_arms:
+      continue
+    key = (verb, fn.qualname)
+    if key in seen:
+      continue
+    seen.add(key)
+    yield Finding(
+        "TOS012", fn.path, lineno, fn.qualname, "verb:%s:unhandled" % verb,
+        "client sends verb %r but no server dispatch arm handles it — "
+        "the request can only come back ERROR (see docs/ANALYSIS.md "
+        "TOS012)" % verb)
+  # the rendezvous server (the widest dispatcher in a *rendezvous*
+  # module) must dispatch the full canonical wire vocabulary
+  rv = [(fn, arms) for fn, arms in dispatchers
+        if "rendezvous" in fn.module.rsplit(".", 1)[-1]]
+  if not rv:
+    return
+  fn, arms = max(rv, key=lambda t: (len(t[1]), t[0].qualname))
+  for verb in WIRE_VERBS:
+    if verb not in arms:
+      yield Finding(
+          "TOS012", fn.path, fn.node.lineno, fn.qualname,
+          "verb:%s:no-dispatch-arm" % verb,
+          "wire verb %r has no dispatch arm in the rendezvous server — "
+          "a client blocking on it gets ERROR/timeout (the SYNC/SYNCQ/"
+          "GROUP incident; see docs/ANALYSIS.md TOS012)" % verb)
+
+
+# -- TOS013: chaos-point coverage -------------------------------------------
+
+def _env_get_consts(fn_node) -> Set[str]:
+  """Names X used as ``os.environ.get(X)`` / ``os.getenv(X)`` below."""
+  out: Set[str] = set()
+  for node in ast.walk(fn_node):
+    if not (isinstance(node, ast.Call) and node.args):
+      continue
+    func = node.func
+    is_env_get = (
+        isinstance(func, ast.Attribute) and func.attr == "get"
+        and isinstance(func.value, ast.Attribute)
+        and func.value.attr == "environ") or (
+        isinstance(func, ast.Attribute) and func.attr == "getenv")
+    if is_env_get and isinstance(node.args[0], ast.Name):
+      out.add(node.args[0].id)
+  return out
+
+
+def check_tos013(model: RepoModel):
+  for mod in sorted(model.modules.values(), key=lambda m: m.path):
+    known_node = None
+    env_values: Dict[str, str] = {}     # const name -> env string
+    for node in mod.tree.body:
+      if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+          and isinstance(node.targets[0], ast.Name):
+        tname = node.targets[0].id
+        if tname == "_KNOWN_ENV":
+          known_node = node
+        else:
+          s = _str_const(node.value)
+          if s is not None and s.startswith(_CHAOS_PREFIX):
+            env_values[tname] = s
+    if known_node is None:
+      continue
+    known = [e.id for e in known_node.value.elts
+             if isinstance(e, ast.Name)] \
+        if isinstance(known_node.value, (ast.Tuple, ast.List)) else []
+    check_fn = None
+    hooks: Dict[str, Set[str]] = {}     # fn name -> env consts consulted
+    for node in mod.tree.body:
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        consts = _env_get_consts(node) & set(env_values)
+        if node.name == "check_config":
+          check_fn = (node, consts)
+        elif consts:
+          hooks[node.name] = consts
+    validated = check_fn[1] if check_fn else set()
+    hooked: Set[str] = set()
+    for consts in hooks.values():
+      hooked |= consts
+    for const in known:
+      env = env_values.get(const, const)
+      if const not in hooked:
+        yield Finding(
+            "TOS013", mod.path, known_node.lineno, "<module>",
+            "knob:%s:no-hook" % env,
+            "chaos knob %s is registered in _KNOWN_ENV but no injection "
+            "hook consults it — setting it is a silent no-op (see "
+            "docs/ANALYSIS.md TOS013)" % env)
+      if check_fn is not None and const not in validated:
+        yield Finding(
+            "TOS013", mod.path, known_node.lineno, "<module>",
+            "knob:%s:unchecked" % env,
+            "chaos knob %s is registered in _KNOWN_ENV but check_config "
+            "never parses its spec — a malformed value fails at the "
+            "injection point instead of at arm time (see "
+            "docs/ANALYSIS.md TOS013)" % env)
+    for fn_name, consts in sorted(hooks.items()):
+      for const in sorted(consts - set(known)):
+        yield Finding(
+            "TOS013", mod.path, known_node.lineno, fn_name,
+            "knob:%s:unregistered" % env_values[const],
+            "hook %s() consults chaos knob %s which is not registered "
+            "in _KNOWN_ENV — check_config cannot validate it and a typo "
+            "in the env var is a silent no-op (see docs/ANALYSIS.md "
+            "TOS013)" % (fn_name, env_values[const]))
+
+
+# -- driver ------------------------------------------------------------------
+
+def _load_aux(aux_sources: Optional[Dict[str, str]]):
+  """(py trees, doc text, doc path) from explicit sources or disk."""
+  aux_trees: Dict[str, ast.AST] = {}
+  doc_text = None
+  doc_path = DOC_PATH
+  if aux_sources is None:
+    aux_sources = {}
+    for path in EXTRA_CONSUMER_FILES:
+      if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+          aux_sources[path] = f.read()
+    if os.path.exists(DOC_PATH):
+      with open(DOC_PATH, encoding="utf-8") as f:
+        aux_sources[DOC_PATH] = f.read()
+  for path, text in aux_sources.items():
+    if path.endswith(".md"):
+      doc_text = text
+      doc_path = path
+    else:
+      try:
+        aux_trees[path] = ast.parse(text, filename=path)
+      except SyntaxError:
+        continue     # the style pass owns reporting broken sources
+  return aux_trees, doc_text, doc_path
+
+
+def run_contracts(model: RepoModel,
+                  aux_sources: Optional[Dict[str, str]] = None):
+  """All contract findings + per-rule file scopes.
+
+  ``aux_sources``: {path: text} for the doc catalogue and out-of-package
+  consumers (tests inject fixtures); None = read the defaults from disk.
+  Returns ``(findings, scopes)`` where ``scopes[rule]`` is the set of
+  files whose change must re-trigger that rule.
+  """
+  aux_trees, doc_text, doc_path = _load_aux(aux_sources)
+  trees = {m.path: m.tree for m in model.modules.values()}
+
+  findings: List[Finding] = []
+  scopes: Dict[str, Set[str]] = {"TOS011": set(), "TOS012": set(),
+                                 "TOS013": set()}
+
+  producers = _collect_producers(trees)
+  c_exact, c_prefix, c_pattern = _collect_consumers(trees, aux_trees)
+  scopes["TOS011"].update(pa for _n, _p, pa, _ln in producers)
+  for lst in (c_exact, c_prefix, c_pattern):
+    scopes["TOS011"].update(pa for _v, pa, _ln in lst)
+  scopes["TOS011"].update(aux_trees)
+  if doc_text is not None:
+    scopes["TOS011"].add(doc_path)
+  findings.extend(check_tos011(trees, aux_trees, doc_text, doc_path))
+
+  for fn, _arms in _dispatchers(model):
+    scopes["TOS012"].add(fn.path)
+  for _verb, fn, _ln in _sent_verbs(model):
+    scopes["TOS012"].add(fn.path)
+  findings.extend(check_tos012(model))
+
+  for mod in model.modules.values():
+    for node in mod.tree.body:
+      if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+          and isinstance(node.targets[0], ast.Name) \
+          and node.targets[0].id == "_KNOWN_ENV":
+        scopes["TOS013"].add(mod.path)
+  findings.extend(check_tos013(model))
+  return findings, scopes

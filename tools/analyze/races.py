@@ -1,0 +1,402 @@
+"""Concurrency-race rule passes (the TOS009/TOS010 family).
+
+TOS009 — unsynchronized shared-state mutation.  Each class's methods are
+split into *thread-side* (reachable from a ``Thread(target=self._run)`` /
+``Timer`` / ``submit`` hand-off inside the class) and *client-side*
+(public API).  An instance attribute mutated on both sides is flagged
+when at least one of the sites is a non-atomic read-modify-write
+(``+=``, ``x = x + ...``, ``self.d[k] += ...``, check-then-set) and the
+two paths can hold no common lock — the PR 10 stats-counter / PR 14
+router-scoring bug class.
+
+TOS010 — lock-order inversion.  Per class, every ``with self._lock:``
+nesting (including one-hop propagation through intra-class calls)
+contributes an acquisition edge; a cycle in that graph is a latent
+deadlock between two call paths.
+
+Both passes are syntactic over-approximations in the house style: they
+track ``self.<attr>`` context managers as locks, propagate held-lock
+sets through direct ``self.method()`` calls, and never try to model
+aliasing.  Escapes: ``# tosa: ignore[TOS009]`` / baseline with a reason.
+"""
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from tools.analyze.engine import FuncInfo, RepoModel
+from tools.analyze.rules import Finding
+
+#: bumped when a rule's logic changes; the incremental cache keys on it
+RULE_VERSIONS = {"TOS009": 1, "TOS010": 1}
+
+_THREAD_CTORS = ("Thread", "Process")
+# methods a class may expose without being "client API" for TOS009
+_NON_CLIENT = ("__init__", "__new__", "__del__", "__repr__", "__str__")
+# cap on distinct held-lock contexts tracked per method (worklist bound)
+_MAX_CONTEXTS = 8
+
+
+class _MethodFacts(object):
+  """Lock/mutation/call facts for one method, from a held-lock-aware walk."""
+
+  def __init__(self, fn: FuncInfo):
+    self.fn = fn
+    self.thread_targets: Set[str] = set()
+    # (callee method name, locks held at the call site)
+    self.calls: List[Tuple[str, FrozenSet[str]]] = []
+    # (attr, "rmw"|"write", locks held, lineno)
+    self.mutations: List[Tuple[str, str, FrozenSet[str], int]] = []
+    # (lock attr, locks already held, lineno)
+    self.acquisitions: List[Tuple[str, FrozenSet[str], int]] = []
+
+
+def _self_attr(node) -> Optional[str]:
+  if isinstance(node, ast.Attribute) and \
+      isinstance(node.value, ast.Name) and node.value.id == "self":
+    return node.attr
+  return None
+
+
+def _reads_self_attrs(expr) -> Set[str]:
+  out = set()
+  for n in ast.walk(expr):
+    if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+      a = _self_attr(n)
+      if a is not None:
+        out.add(a)
+  return out
+
+
+def _ctor_name(func) -> Optional[str]:
+  if isinstance(func, ast.Name):
+    return func.id
+  if isinstance(func, ast.Attribute):
+    return func.attr
+  return None
+
+
+class _Walker(object):
+  """Statement walk tracking held ``with self.X:`` locks + guard attrs."""
+
+  def __init__(self, facts: _MethodFacts, method_names: Set[str]):
+    self.facts = facts
+    self.methods = method_names
+
+  def walk(self, stmts, held: Tuple[str, ...], guards: FrozenSet[str]):
+    for st in stmts:
+      self._stmt(st, held, guards)
+
+  def _stmt(self, st, held, guards):
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+      return   # nested defs are separate FuncInfos with their own facts
+    if isinstance(st, ast.With):
+      locks = []
+      for item in st.items:
+        a = _self_attr(item.context_expr)
+        if a is not None:
+          self.facts.acquisitions.append((a, frozenset(held),
+                                          item.context_expr.lineno))
+          locks.append(a)
+        self._exprs(item.context_expr, held, guards)
+      self.walk(st.body, held + tuple(locks), guards)
+      return
+    if isinstance(st, (ast.If, ast.While)):
+      self._exprs(st.test, held, guards)
+      inner = guards | frozenset(_reads_self_attrs(st.test))
+      self.walk(st.body, held, inner)
+      self.walk(st.orelse, held, guards)
+      return
+    if isinstance(st, ast.For):
+      self._exprs(st.iter, held, guards)
+      self.walk(st.body, held, guards)
+      self.walk(st.orelse, held, guards)
+      return
+    if isinstance(st, ast.Try):
+      self.walk(st.body, held, guards)
+      for h in st.handlers:
+        self.walk(h.body, held, guards)
+      self.walk(st.orelse, held, guards)
+      self.walk(st.finalbody, held, guards)
+      return
+    # leaf statements: mutations + embedded calls
+    if isinstance(st, ast.AugAssign):
+      attr = self._store_attr(st.target)
+      if attr is not None:
+        self.facts.mutations.append((attr, "rmw", frozenset(held),
+                                     st.lineno))
+      self._exprs(st.value, held, guards)
+      return
+    if isinstance(st, ast.Assign):
+      reads = _reads_self_attrs(st.value)
+      for tgt in st.targets:
+        for t in tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]:
+          attr = self._store_attr(t)
+          if attr is None:
+            continue
+          kind = "rmw" if (attr in reads or attr in guards) else "write"
+          self.facts.mutations.append((attr, kind, frozenset(held),
+                                       st.lineno))
+      self._exprs(st.value, held, guards)
+      return
+    for child in ast.iter_child_nodes(st):
+      if isinstance(child, (ast.expr, ast.keyword)):
+        self._exprs(child, held, guards)
+
+  def _store_attr(self, target) -> Optional[str]:
+    """Attr behind a store target: ``self.a`` or ``self.a[k]``."""
+    if isinstance(target, ast.Subscript):
+      target = target.value
+    a = _self_attr(target)
+    return a
+
+  def _exprs(self, expr, held, guards):
+    for n in ast.walk(expr):
+      if not isinstance(n, ast.Call):
+        continue
+      # self.method(...) intra-class call edge
+      a = _self_attr(n.func)
+      if a is not None and a in self.methods:
+        self.facts.calls.append((a, frozenset(held)))
+      # bare-name call of a sibling nested def
+      if isinstance(n.func, ast.Name) and n.func.id in self.methods:
+        self.facts.calls.append((n.func.id, frozenset(held)))
+      # self._lock.acquire() — acquisition edge (scope unknown; TOS007
+      # already flags the bare acquire, so no held-set extension here)
+      if isinstance(n.func, ast.Attribute) and n.func.attr == "acquire":
+        la = _self_attr(n.func.value)
+        if la is not None:
+          self.facts.acquisitions.append((la, frozenset(held), n.lineno))
+      # thread hand-off: Thread/Process(target=...), Timer(s, fn),
+      # executor.submit(fn, ...)
+      ctor = _ctor_name(n.func)
+      cand = []
+      if ctor in _THREAD_CTORS:
+        cand = [kw.value for kw in n.keywords if kw.arg == "target"]
+      elif ctor == "Timer":
+        cand = [kw.value for kw in n.keywords
+                if kw.arg in ("function", "target")]
+        if not cand and len(n.args) >= 2:
+          cand = [n.args[1]]
+      elif isinstance(n.func, ast.Attribute) and n.func.attr == "submit" \
+          and n.args:
+        cand = [n.args[0]]
+      for c in cand:
+        t = _self_attr(c)
+        if t is None and isinstance(c, ast.Name):
+          t = c.id
+        if t is not None and t in self.methods:
+          self.facts.thread_targets.add(t)
+
+
+def _method_facts(fn: FuncInfo, method_names: Set[str]) -> _MethodFacts:
+  facts = _MethodFacts(fn)
+  _Walker(facts, method_names).walk(fn.node.body, (), frozenset())
+  return facts
+
+
+def _propagate(entries: List[str], facts: Dict[str, _MethodFacts]) -> \
+    Dict[str, Set[FrozenSet[str]]]:
+  """Held-lock contexts reaching each method from the given entries."""
+  incoming: Dict[str, Set[FrozenSet[str]]] = {}
+  work = [(e, frozenset()) for e in entries]
+  while work:
+    name, locks = work.pop()
+    cur = incoming.setdefault(name, set())
+    if locks in cur or len(cur) >= _MAX_CONTEXTS:
+      continue
+    cur.add(locks)
+    f = facts.get(name)
+    if f is None:
+      continue
+    for callee, held in f.calls:
+      work.append((callee, locks | held))
+  return incoming
+
+
+def _mutation_contexts(incoming, facts):
+  """attr -> [(kind, effective lock set, lineno, method name)]."""
+  out: Dict[str, list] = {}
+  for name, bases in incoming.items():
+    f = facts.get(name)
+    if f is None:
+      continue
+    for attr, kind, held, lineno in f.mutations:
+      for base in bases:
+        out.setdefault(attr, []).append((kind, base | held, lineno, name))
+  return out
+
+
+def _class_members(model: RepoModel):
+  """class qualname -> {method name: FuncInfo} (nested defs included)."""
+  classes: Dict[str, Dict[str, FuncInfo]] = {}
+  for fn in model.functions.values():
+    if fn.cls:
+      classes.setdefault(fn.cls, {})[fn.name] = fn
+  return classes
+
+
+def check_tos009(model: RepoModel, cls: str,
+                 members: Dict[str, FuncInfo]) -> Iterator[Finding]:
+  names = set(members)
+  facts = {n: _method_facts(f, names) for n, f in members.items()
+           if n != "__init__"}
+  thread_entries = set()
+  lock_like = set()
+  for f in facts.values():
+    thread_entries.update(f.thread_targets)
+    lock_like.update(a for a, _h, _ln in f.acquisitions)
+  # __init__ may also be the spawner: scan it for targets/locks only
+  if "__init__" in members:
+    init_facts = _method_facts(members["__init__"], names)
+    thread_entries.update(init_facts.thread_targets)
+    lock_like.update(a for a, _h, _ln in init_facts.acquisitions)
+  thread_entries &= names
+  if not thread_entries:
+    return
+  client_entries = [
+      n for n, f in members.items()
+      if f.parent_func is None and n not in thread_entries
+      and n not in _NON_CLIENT and not (n.startswith("_")
+                                        and not n.startswith("__"))]
+  if not client_entries:
+    return
+  t_ctx = _mutation_contexts(_propagate(sorted(thread_entries), facts),
+                             facts)
+  c_ctx = _mutation_contexts(_propagate(sorted(client_entries), facts),
+                             facts)
+  path = next(iter(members.values())).path
+  for attr in sorted(set(t_ctx) & set(c_ctx)):
+    if attr in lock_like:
+      continue
+    hit = None
+    for t_kind, t_locks, t_line, t_m in t_ctx[attr]:
+      for c_kind, c_locks, c_line, c_m in c_ctx[attr]:
+        if "rmw" not in (t_kind, c_kind):
+          continue
+        if t_locks & c_locks:
+          continue
+        cand = (t_line if t_kind == "rmw" else c_line,
+                t_m, t_line, c_m, c_line)
+        if hit is None or cand < hit:
+          hit = cand
+    if hit is not None:
+      line, t_m, t_line, c_m, c_line = hit
+      yield Finding(
+          "TOS009", path, line, cls, "attr:%s" % attr,
+          "attribute 'self.%s' mutated from the thread side (%s:%d) and "
+          "the client side (%s:%d) with no common lock; a read-modify-"
+          "write on either path can lose updates under contention — hold "
+          "one lock on both paths (see docs/ANALYSIS.md TOS009)"
+          % (attr, t_m, t_line, c_m, c_line))
+
+
+def check_tos010(model: RepoModel, cls: str,
+                 members: Dict[str, FuncInfo]) -> Iterator[Finding]:
+  names = set(members)
+  facts = {n: _method_facts(f, names) for n, f in members.items()}
+  incoming = _propagate(sorted(names), facts)
+  edges: Dict[Tuple[str, str], int] = {}
+  for name, bases in incoming.items():
+    for lock, held, lineno in facts[name].acquisitions:
+      for base in bases:
+        for h in (base | held) - {lock}:
+          key = (h, lock)
+          if key not in edges or lineno < edges[key]:
+            edges[key] = lineno
+  if not edges:
+    return
+  graph: Dict[str, Set[str]] = {}
+  for a, b in edges:
+    graph.setdefault(a, set()).add(b)
+    graph.setdefault(b, set())
+  path = next(iter(members.values())).path
+  for cycle in _cycles(graph):
+    closure = list(cycle) + [cycle[0]]
+    line = min(edges.get((closure[i], closure[i + 1]), 1 << 30)
+               for i in range(len(cycle)))
+    yield Finding(
+        "TOS010", path, line, cls, "cycle:%s" % "->".join(closure),
+        "lock-order inversion: 'self.%s' is acquired while holding "
+        "'self.%s' on one path and the reverse on another; two threads "
+        "interleaving these paths deadlock — pick one global order "
+        "(see docs/ANALYSIS.md TOS010)" % (closure[1], closure[0]))
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+  """One canonical (min-node-first, shortest) cycle per cyclic SCC."""
+  sccs = _tarjan(graph)
+  out = []
+  for scc in sccs:
+    scc_set = set(scc)
+    if len(scc) == 1 and scc[0] not in graph.get(scc[0], ()):
+      continue
+    start = min(scc)
+    # BFS back to start inside the SCC → shortest cycle through start
+    prev = {start: None}
+    queue = [start]
+    cycle = None
+    while queue and cycle is None:
+      node = queue.pop(0)
+      for nxt in sorted(graph.get(node, ())):
+        if nxt not in scc_set:
+          continue
+        if nxt == start:
+          path = [node]
+          while prev[path[-1]] is not None:
+            path.append(prev[path[-1]])
+          cycle = list(reversed(path))
+          break
+        if nxt not in prev:
+          prev[nxt] = node
+          queue.append(nxt)
+    if cycle:
+      out.append(cycle)
+  return sorted(out)
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[List[str]]:
+  index: Dict[str, int] = {}
+  low: Dict[str, int] = {}
+  on_stack: Set[str] = set()
+  stack: List[str] = []
+  sccs: List[List[str]] = []
+  counter = [0]
+
+  def strongconnect(v):
+    index[v] = low[v] = counter[0]
+    counter[0] += 1
+    stack.append(v)
+    on_stack.add(v)
+    for w in sorted(graph.get(v, ())):
+      if w not in index:
+        strongconnect(w)
+        low[v] = min(low[v], low[w])
+      elif w in on_stack:
+        low[v] = min(low[v], index[w])
+    if low[v] == index[v]:
+      scc = []
+      while True:
+        w = stack.pop()
+        on_stack.discard(w)
+        scc.append(w)
+        if w == v:
+          break
+      sccs.append(sorted(scc))
+
+  for v in sorted(graph):
+    if v not in index:
+      strongconnect(v)
+  return sccs
+
+
+def run_races(model: RepoModel,
+              paths: Optional[Set[str]] = None) -> List[Finding]:
+  """TOS009 + TOS010 over every class (optionally path-restricted)."""
+  findings: List[Finding] = []
+  for cls, members in sorted(_class_members(model).items()):
+    path = next(iter(members.values())).path
+    if paths is not None and path not in paths:
+      continue
+    findings.extend(check_tos009(model, cls, members))
+    findings.extend(check_tos010(model, cls, members))
+  return findings

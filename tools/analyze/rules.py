@@ -601,17 +601,30 @@ def check_tos008(model: RepoModel) -> Iterator[Finding]:
 
 # --- driver -----------------------------------------------------------------
 
+#: bumped when a rule's logic changes; the incremental cache keys on it
+RULE_VERSIONS = {"TOS001": 1, "TOS002": 1, "TOS003": 1, "TOS004": 1,
+                 "TOS005": 1, "TOS006": 1, "TOS007": 1, "TOS008": 1}
+
+
+def run_function_rules(model: RepoModel, fn: FuncInfo,
+                       jitted: set) -> List[Finding]:
+  """The per-function passes (TOS001–TOS007) for one function."""
+  findings: List[Finding] = []
+  findings.extend(check_tos001(model, fn))
+  findings.extend(check_tos002(model, fn))
+  findings.extend(check_tos003(model, fn))
+  findings.extend(check_tos004(model, fn))
+  findings.extend(check_tos005(model, fn, jitted))
+  findings.extend(check_tos006(model, fn))
+  findings.extend(check_tos007(model, fn))
+  return findings
+
+
 def run_rules(model: RepoModel) -> List[Finding]:
   findings: List[Finding] = []
   jitted = _collect_jitted(model)
   for fn in model.functions.values():
-    findings.extend(check_tos001(model, fn))
-    findings.extend(check_tos002(model, fn))
-    findings.extend(check_tos003(model, fn))
-    findings.extend(check_tos004(model, fn))
-    findings.extend(check_tos005(model, fn, jitted))
-    findings.extend(check_tos006(model, fn))
-    findings.extend(check_tos007(model, fn))
+    findings.extend(run_function_rules(model, fn, jitted))
   findings.extend(check_tos008(model))
   findings.sort(key=lambda f: (f.path, f.line, f.rule))
   return findings

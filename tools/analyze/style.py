@@ -220,9 +220,16 @@ def collect_py_files(roots):
   return sorted(engine.collect_files(list(roots)))
 
 
-def run_style(paths=None):
-  """Lint the given paths (or the defaults); returns (files, findings)."""
+def run_style(paths=None, cache_path=None):
+  """Lint the given paths (or the defaults); returns (files, findings).
+
+  ``cache_path``: reuse per-file results keyed on content digest (see
+  tools/analyze/cache.py; ``make analyze-cold`` bypasses it).
+  """
   files = collect_py_files(paths or DEFAULT_PATHS)
+  if cache_path is not None:
+    from tools.analyze import cache
+    return files, cache.style_pass(files, cache_path, lint_file)
   findings = []
   for path in files:
     lint_file(path, findings)
