@@ -9,7 +9,8 @@ PY ?= python
 	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
 	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
 	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke \
-	feed-bench-graph feed-bench-graph-smoke slo-smoke elastic-chaos \
+	feed-bench-graph feed-bench-graph-smoke feed-bench-wire \
+	feed-bench-wire-smoke slo-smoke elastic-chaos \
 	train-bench-groups train-bench-groups-smoke deploy-chaos \
 	serve-bench-deploy serve-bench-deploy-smoke
 
@@ -23,7 +24,7 @@ validate: test dryrun mosaic-gate
 lint:
 	$(PY) tools/lint.py
 
-# tosa: the distributed-runtime static analysis suite (TOS001-TOS013 rule
+# tosa: the distributed-runtime static analysis suite (TOS001-TOS014 rule
 # passes + the style pass) — see docs/ANALYSIS.md. Exit 0 means every
 # finding is fixed, suppressed inline, or baselined with a reason.
 # Incremental: warm runs replay .tosa_cache.json buckets (byte-identical
@@ -84,6 +85,15 @@ feed-bench-graph-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  $(PY) tools/feed_bench.py --graph --smoke
 
+feed-bench-wire:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/feed_bench.py --wire --steps 120 --batch 64 \
+	  --chunk 128 --json-out bench_artifacts/feed_bench_wire.json
+
+feed-bench-wire-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/feed_bench.py --wire --smoke
+
 # paired per-step vs fused train-loop comparison at the dispatch-
 # dominated harness shape; writes the committed artifact + history line
 train-bench:
@@ -124,6 +134,7 @@ train-bench-groups-smoke:
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
 check: analyze obs-smoke obs-top-smoke slo-smoke train-bench-smoke \
 	fleet-chaos serve-bench-fleet-smoke feed-bench-graph-smoke \
+	feed-bench-wire-smoke \
 	elastic-chaos train-bench-groups-smoke deploy-chaos \
 	serve-bench-deploy-smoke
 	$(PY) -m pytest tests/test_analyze.py tests/test_utils.py \

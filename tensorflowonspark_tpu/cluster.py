@@ -879,7 +879,9 @@ def run(engine: Engine, main_fn, tf_args=None,
         restart_backoff_cap: float = 5.0,
         train_unroll: Optional[int] = None,
         group_map: Optional[Dict[int, int]] = None,
-        elastic: bool = False) -> TPUCluster:
+        elastic: bool = False,
+        feed_segment=None,
+        feed_target_bytes: Optional[int] = None) -> TPUCluster:
   """Start a cluster and run ``main_fn(tf_args, ctx)`` on every node.
 
   Signature parity with the reference's ``TFCluster.run``
@@ -913,10 +915,22 @@ def run(engine: Engine, main_fn, tf_args=None,
   denominator reduced — instead of failing the job; only losing every
   group is fatal. ``ClusterSupervisor.readmit`` re-opens the budget when
   capacity returns (docs/ROBUSTNESS.md §Elastic training).
+
+  ``feed_segment`` (a ``data.datapipe.FeederSegment`` from
+  ``Dataset.split_pushdown()``) runs the graph's pushable map/filter
+  prefix inside every feeder task BEFORE the wire codec — filtered rows
+  never ship, projecting maps shrink columns on the wire; the consumer
+  side runs the remainder graph. ``feed_target_bytes`` sets the feeders'
+  adaptive per-envelope byte budget (see ``node.ENV_FEED_TARGET_BYTES``;
+  None/0 keeps the fixed ``feed_chunk_size`` row count). See
+  docs/PERFORMANCE.md §Wire efficiency.
   """
   num_executors = num_executors or engine.num_executors
   if train_unroll is not None and int(train_unroll) < 1:
     raise ValueError("train_unroll must be >= 1, got %r" % (train_unroll,))
+  if feed_target_bytes is not None and int(feed_target_bytes) < 0:
+    raise ValueError("feed_target_bytes must be >= 0, got %r"
+                     % (feed_target_bytes,))
   if feed_transport == "auto":
     # shared-memory rings require the feeder task and the node to share a
     # host, which only engines with colocated executors guarantee; the
@@ -1021,6 +1035,12 @@ def run(engine: Engine, main_fn, tf_args=None,
       "group_map": ({int(k): int(v) for k, v in group_map.items()}
                     if group_map else None),
       "elastic": bool(elastic),
+      # wire-efficient feed plane (docs/PERFORMANCE.md §Wire efficiency):
+      # the pushdown segment feeder tasks run before the codec, and the
+      # adaptive per-envelope byte budget (None/0 = fixed row count)
+      "feed_segment": feed_segment,
+      "feed_target_bytes": (int(feed_target_bytes)
+                            if feed_target_bytes else None),
   }
 
   # launch node bring-up asynchronously so that (a) feeding can start and

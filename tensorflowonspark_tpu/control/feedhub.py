@@ -21,14 +21,69 @@ markers as in-band items.
 import collections
 import logging
 import os
+import socket
 import threading
 import time
+from multiprocessing import connection as _mpconn
 from multiprocessing.managers import BaseManager
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tensorflowonspark_tpu.control.marker import Marker
 
 logger = logging.getLogger(__name__)
+
+
+def _sock_nodelay(conn) -> None:
+  """Disable Nagle on a live manager connection.
+
+  CPython's ``Connection._send_bytes`` writes the length header and the
+  body as TWO separate ``send()`` calls for payloads over 16 KiB; with
+  Nagle on, that interacts with the peer's delayed ACK into an ~40 ms
+  stall per message EACH WAY for mid-size (16–64 KiB) payloads —
+  exactly where wire-encoded chunk envelopes land (measured: 88 ms per
+  put+get round trip vs 0.4 ms just above 64 KiB). Socket options stick
+  to the underlying socket, so setting them through a dup'd fd covers
+  the Connection's own handle. Non-TCP transports raise and are left
+  untouched.
+  """
+  try:
+    s = socket.fromfd(conn.fileno(), socket.AF_INET, socket.SOCK_STREAM)
+    try:
+      s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    finally:
+      s.close()
+  except (OSError, ValueError):  # tosa: ignore[TOS004] - non-TCP transport
+    pass
+
+
+_nodelay_installed = False
+
+
+def _install_nodelay() -> None:
+  """Patch ``multiprocessing.connection`` so every manager socket this
+  process dials or accepts runs with TCP_NODELAY (idempotent; called on
+  the server via ``_init_server`` and on clients via start/connect —
+  proxies dial lazily per thread, so per-call hooks cannot cover them)."""
+  global _nodelay_installed
+  if _nodelay_installed:
+    return
+  _nodelay_installed = True
+  orig_client = _mpconn.SocketClient
+
+  def _client_nodelay(address):
+    c = orig_client(address)
+    _sock_nodelay(c)
+    return c
+
+  _mpconn.SocketClient = _client_nodelay
+  orig_accept = _mpconn.SocketListener.accept
+
+  def _accept_nodelay(self):
+    c = orig_accept(self)
+    _sock_nodelay(c)
+    return c
+
+  _mpconn.SocketListener.accept = _accept_nodelay
 
 
 class ChunkEnvelope(object):
@@ -271,6 +326,9 @@ _kv_lock = threading.Lock()
 
 def _init_server(queue_names, qmax):
   global _queues, _kv
+  # runs in the manager SERVER process before its Listener is created, so
+  # every accepted connection gets TCP_NODELAY (see _install_nodelay)
+  _install_nodelay()
   _queues = {name: FeedQueue(maxsize=qmax) for name in queue_names}
   # the error queue must never block its writer
   if "error" in _queues:
@@ -402,6 +460,7 @@ def start(authkey: bytes, queue_names: Sequence[str],
     host: advertised host for remote mode (defaults to this host's IP).
   """
   bind_host = "127.0.0.1" if mode == "local" else ""
+  _install_nodelay()
   # spawn, not fork: the caller (an engine executor) typically has live
   # queue-feeder threads, and forking a process that holds their locks can
   # deadlock the manager child before it ever listens
@@ -420,6 +479,7 @@ def start(authkey: bytes, queue_names: Sequence[str],
 
 def connect(addr: Tuple[str, int], authkey: bytes) -> FeedHub:
   """Connect to an existing feed hub (parity: TFManager.py:68-83)."""
+  _install_nodelay()
   mgr = FeedHubManager(address=(addr[0], int(addr[1])), authkey=authkey)
   mgr.connect()
   return FeedHub(mgr, (addr[0], int(addr[1])), authkey, owned=False)

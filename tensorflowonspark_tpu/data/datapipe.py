@@ -80,6 +80,10 @@ ENV_DATA_AUTOTUNE_INTERVAL = "TOS_DATA_AUTOTUNE_INTERVAL"
 ENV_DATA_MAX_WORKERS = "TOS_DATA_MAX_WORKERS"
 #: per-stage hand-off buffer depth cap the autotuner may grow to (TOS008)
 ENV_DATA_BUFFER_CAP = "TOS_DATA_BUFFER_CAP"
+#: feeder-side transform pushdown master switch (default on; ``0`` keeps
+#: every stage consumer-side — :meth:`Dataset.split_pushdown` then always
+#: returns the whole graph as the consumer segment) — env registry: TOS008
+ENV_FEED_PUSHDOWN = "TOS_FEED_PUSHDOWN"
 
 _DEFAULT_INTERVAL = 0.5
 _DEFAULT_MAX_WORKERS = 4
@@ -450,6 +454,56 @@ def _make_filter(pred: Callable, columnar: bool) -> Callable:
     return [("data", chunk if chunk is not None else kept)]
 
   return _apply
+
+
+class FeederSegment(object):
+  """The pushable prefix of a :class:`Dataset` graph, run FEEDER-side.
+
+  Holds the leading stateless ``map``/``filter`` ops split off by
+  :meth:`Dataset.split_pushdown`. The segment travels to feeder tasks via
+  cluster_meta (cloudpickled with the task closure, like the user fns)
+  and executes inside the feeder BEFORE ``node.put_rows_chunk`` encodes —
+  a filtered row never touches the codec, a projecting map shrinks
+  columns before the wire.
+
+  Pushdown moves COMPUTATION, never ORDER: the ops are applied to each
+  chunk in stream position by the same stage bodies the consumer-side
+  executor would run (``_make_map``/``_make_filter``), so
+  ``deterministic=True`` and the fused-loop bit-identical-trajectory
+  contract hold unchanged. Markers never enter a segment — they ride
+  alone as chunk-boundary envelopes outside ``put_rows_chunk``.
+  """
+
+  __slots__ = ("ops",)
+
+  def __init__(self, ops: List):
+    self.ops = list(ops)
+
+  def compile(self) -> Callable:
+    """Build the feeder-side runner: ``rows -> ColumnChunk | rows | None``
+    (None when the segment filters the whole chunk away). Built once per
+    feeder task; the bodies are exactly the consumer-side stage bodies."""
+    bodies = [_make_map(fn, columnar) if kind == "map"
+              else _make_filter(fn, columnar)
+              for kind, fn, columnar in self.ops]
+
+    def _run(rows):
+      chunk = _rows_to_chunk(rows)
+      items = [("data", chunk if chunk is not None else rows)]
+      for body in bodies:
+        out = []
+        for item in items:
+          out.extend(body(item))
+        items = out
+        if not items:
+          return None
+      # map/filter bodies are 1 -> <=1, so one item survives at most
+      return items[0][1]
+
+    return _run
+
+  def __repr__(self):
+    return "FeederSegment(%s)" % ",".join(op[0] for op in self.ops)
 
 
 class _ShuffleState(object):
@@ -876,6 +930,9 @@ class GraphExecutor(object):
 
   def _start_source(self) -> None:
     src = self._plan._source
+    if src[0] == "pending":
+      raise ValueError("cannot start a pipeline() template: bind() it to "
+                       "a DataFeed first")
     if src[0] == "interleave":
       t = threading.Thread(target=self._source_interleave, args=(src[1],
                                                                  src[2]),
@@ -1381,6 +1438,66 @@ class Dataset(object):
                train_mode=feed.train_mode)
 
   @classmethod
+  def pipeline(cls) -> "Dataset":
+    """DRIVER-side graph template with a pending source.
+
+    Compose transforms on it, call :meth:`split_pushdown` to carve off
+    the feeder segment for ``cluster.run(feed_segment=...)``, then
+    :meth:`bind` the consumer remainder to the executor's
+    :class:`datafeed.DataFeed` inside the user main fn. A pending graph
+    cannot start — :meth:`bind` it first."""
+    return cls(("pending", None))
+
+  def bind(self, feed) -> "Dataset":
+    """Bind a pending graph (:meth:`pipeline`) to a live feed: the
+    :meth:`from_feed` source plus THIS graph's ops. Column names and
+    marker semantics come from the feed, exactly as ``from_feed``."""
+    if self._source[0] != "pending":
+      raise ValueError("bind() is for pipeline() templates; this graph "
+                       "already has a %r source" % (self._source[0],))
+    feed._stop_pipeline()
+    out = Dataset(("feed", feed), self._ops, feed.input_tensors,
+                  feed.train_mode, self._depths)
+    return out
+
+  def split_pushdown(self):
+    """Split this graph at the first non-pushable stage.
+
+    Returns ``(feeder_segment, consumer_dataset)``. Pushable stages are
+    the LEADING stateless ``map``/``filter`` ops — ``shuffle``/``batch``/
+    ``slab`` and everything after stay consumer-side, and ``interleave``
+    sources never push (the merge point is the consumer). Returns
+    ``(None, self)`` when nothing pushes (including when
+    ``TOS_FEED_PUSHDOWN=0`` disables the split)."""
+    if os.environ.get(ENV_FEED_PUSHDOWN, "1").strip().lower() in (
+        "0", "false", "off"):
+      return None, self
+    if self._source[0] == "interleave":
+      return None, self
+    k = 0
+    for op in self._ops:
+      if op[0] in ("map", "filter"):
+        k += 1
+      else:
+        break
+    if k == 0:
+      return None, self
+    segment = FeederSegment([tuple(op) for op in self._ops[:k]])
+    depths: Dict[int, int] = {}
+    for i, d in self._depths.items():
+      if i < 0:
+        depths[i] = max(d, depths.get(i, 0))
+      elif i < k:
+        # a prefetch declared after a pushed stage now pads the buffer
+        # after the consumer-side source instead
+        depths[-1] = max(d, depths.get(-1, 0))
+      else:
+        depths[i - k] = d
+    rest = Dataset(self._source, self._ops[k:], self._columns,
+                   self._train_mode, depths)
+    return segment, rest
+
+  @classmethod
   def from_chunks(cls, chunks, columns: Optional[List[str]] = None,
                   train_mode: bool = True) -> "Dataset":
     """Source over an iterable of chunks: ``ColumnChunk``\\ s, row
@@ -1480,6 +1597,9 @@ class Dataset(object):
   def start(self, deterministic: bool = True,
             autotune: Optional[bool] = None) -> GraphExecutor:
     """Materialize and start the executor (callers own ``stop()``)."""
+    if self._source[0] == "pending":
+      raise ValueError("cannot start a pipeline() template: bind() it to "
+                       "a DataFeed first")
     return GraphExecutor(self, deterministic=deterministic,
                          autotune=autotune).start()
 

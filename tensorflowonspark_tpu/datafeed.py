@@ -203,7 +203,7 @@ class DataFeed(object):
     #: :meth:`stats_snapshot`, never by zeroing: the fetch thread keeps
     #: read-modify-writing these entries)
     self.stats = {"fetch_s": 0.0, "decode_s": 0.0, "assemble_s": 0.0,
-                  "chunks": 0, "columnar_chunks": 0}
+                  "chunks": 0, "columnar_chunks": 0, "aligned_batches": 0}
     # obs seam (docs/OBSERVABILITY.md): cached once so the disabled case
     # is one None check per batch
     self._rec = obs_spans.active()
@@ -341,7 +341,12 @@ class DataFeed(object):
     row-path semantics: end-of-feed ends the batch (partial OK) and sets
     ``done_feeding``; ``EndPartition`` is skipped in train mode and ends
     the batch in inference mode. Each output column is ONE
-    ``np.concatenate`` over chunk slices — the only copy on the path.
+    ``np.concatenate`` over chunk slices — the only copy on the path —
+    and an ALIGNED batch (the whole stretch inside one chunk) skips even
+    that: the column slices hand out directly as READ-ONLY zero-copy
+    views of the decoded chunk (``stats["aligned_batches"]`` counts
+    them). Callers must treat batch arrays as immutable on that path —
+    the views share the chunk's buffer with sibling batches.
     """
     import numpy as np
     plan = []             # (ColumnChunk, start, stop)
@@ -422,12 +427,23 @@ class DataFeed(object):
     if self.input_tensors is not None:
       ncols = min(ncols, len(self.input_tensors))
     out = []
+    aligned = len(plan) == 1
     for j in range(ncols):
-      pieces = [cc.cols[j][a:b] for cc, a, b in plan]
-      arr = np.concatenate(pieces)  # the hand-off copy (always copies)
+      if aligned:
+        # aligned fast path: the whole batch sits inside one chunk, so
+        # the slice IS the column — a zero-copy read-only view (safe to
+        # hand out: the decoded chunk's buffer is msgpack-owned bytes,
+        # never a transport scratch buffer)
+        cc, a, b = plan[0]
+        arr = cc.cols[j][a:b]
+      else:
+        pieces = [cc.cols[j][a:b] for cc, a, b in plan]
+        arr = np.concatenate(pieces)  # the hand-off copy
       if dtype is not None and arr.dtype != np.dtype(dtype):
         arr = arr.astype(dtype)
       out.append(arr)
+    if aligned:
+      self.stats["aligned_batches"] += 1
     self.stats["assemble_s"] += time.perf_counter() - t0
     return out
 

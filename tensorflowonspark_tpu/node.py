@@ -25,6 +25,7 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 import time
 import traceback
 from typing import Dict, List, Optional
@@ -49,6 +50,20 @@ ENV_NODE_PORT = "TOS_TPU_NODE_PORT"
 #: fresh compiles — obs/device.py). Unset = no persistent cache.
 #: (env registry: TOS008)
 ENV_COMPILE_CACHE = "TOS_COMPILE_CACHE"
+
+#: feeder byte budget per wire envelope: when set (> 0), feeders size
+#: chunks adaptively from observed encoded bytes/row instead of the fixed
+#: ``feed_chunk_size`` row count — small rows stop paying per-envelope
+#: manager round-trips, fat rows stop ping-ponging off ``MAX_PAYLOAD``
+#: splits. ``cluster.run(feed_target_bytes=...)`` takes precedence over
+#: the env. 0/unset = fixed row count. (env registry: TOS008)
+ENV_FEED_TARGET_BYTES = "TOS_FEED_TARGET_BYTES"
+
+#: adaptive-sizing row-count clamp, both directions: an envelope never
+#: carries fewer rows than the floor (per-envelope overhead would
+#: dominate) nor more than the cap (consumer-side latency + memory)
+_ADAPT_MIN_ROWS = 16
+_ADAPT_MAX_ROWS = 8192
 
 
 def _setup_compile_cache() -> bool:
@@ -347,6 +362,44 @@ def _start_obs_shipper(server_addr, executor_id: int, sender):
   # watermarks ride the normal OBS wire to the driver's detector loop
   obs_device.install(shipper)
   return shipper.start()
+
+
+# feeder-task obs shipper: one per executor PROCESS, shared across feed
+# tasks (they are too short-lived to each own a thread + socket)
+_feeder_shipper = None
+_feeder_shipper_addr = None
+_feeder_shipper_lock = threading.Lock()
+
+
+def _ensure_feeder_shipper(server_addr, executor_id: int):
+  """Obs shipper for feeder tasks (None when ``TOS_OBS`` is off).
+
+  ENGINE-mode feed tasks run in the engine's executor process, which
+  hosts no node runtime — the node's shipper
+  (:func:`_start_obs_shipper`) lives in the background-runner process.
+  Without a shipper HERE, the feeder-side wire counters
+  (``feed.wire_bytes``/``feed.wire_rows``/``feed.wire_enc.*``) stay
+  process-local and never reach the driver's sink. Cached across feed
+  tasks; re-pointed when a new cluster (fresh rendezvous server) reuses
+  a persistent executor process. The sink merges metric deltas
+  additively per executor id, so this coexists with the node's shipper
+  (the feeder process owns a disjoint metric set)."""
+  global _feeder_shipper, _feeder_shipper_addr
+  from tensorflowonspark_tpu.obs import metrics as obs_metrics
+  if not (obs_metrics.enabled() and server_addr):
+    return None
+  addr = (server_addr[0], int(server_addr[1]))
+  with _feeder_shipper_lock:
+    if _feeder_shipper is not None and _feeder_shipper_addr == addr:
+      return _feeder_shipper
+    if _feeder_shipper is not None:
+      _feeder_shipper.stop(timeout=1.0)
+    from tensorflowonspark_tpu.obs import collector as obs_collector
+    shipper = obs_collector.ObsShipper(addr, executor_id,
+                                       label="feeder").start()
+    _feeder_shipper = shipper
+    _feeder_shipper_addr = addr
+    return shipper
 
 
 def _background_runner(fn_bytes: bytes, tf_args, ctx_kwargs: dict,
@@ -736,35 +789,157 @@ def input_channel(hub, qname: str = "input"):
   return ring if ring is not None else hub.get_queue(qname)
 
 
-def put_rows_chunk(channel, rows, timeout=None) -> None:
-  """Ship one feed chunk as a single chunk-boundary envelope.
+def _slice_chunk(chunk, a: int, b: int):
+  """Row-range slice of a pending chunk (row list or ColumnChunk)."""
+  from tensorflowonspark_tpu.control import chunkcodec
+  if isinstance(chunk, chunkcodec.ColumnChunk):
+    return chunkcodec.ColumnChunk([c[a:b] for c in chunk.cols],
+                                  chunk.scalar, chunk.tuples, b - a)
+  return chunk[a:b]
+
+
+def put_rows_chunk(channel, rows, timeout=None, stats=None) -> int:
+  """Ship one feed chunk as one or more chunk-boundary envelopes.
 
   The chunk is encoded ONCE in the feeder process (columnar for
-  homogeneous rows, ``control/chunkcodec.py``) and travels as one unit on
-  either transport: a ring payload, or a hub-queue ``ChunkEnvelope``
-  whose manager pickle is a bytes memcpy instead of a per-row object
-  walk. Chunk boundaries survive to the consumer, which is what lets
-  ``DataFeed`` assemble batches from column views instead of row tuples.
-  Oversized chunks split at the row level so both transports stay within
-  ``chunkcodec.MAX_PAYLOAD``.
+  homogeneous rows, with per-column wire encodings —
+  ``control/chunkcodec.py``) and travels as one unit on either transport:
+  a ring payload, or a hub-queue ``ChunkEnvelope`` whose manager pickle
+  is a bytes memcpy instead of a per-row object walk. Chunk boundaries
+  survive to the consumer, which is what lets ``DataFeed`` assemble
+  batches from column views instead of row tuples.
+
+  Splitting operates on the ENCODED payload size (compression widens the
+  effective row budget): oversized chunks halve at the row level until
+  every envelope fits ``chunkcodec.MAX_PAYLOAD``, in row order. A SINGLE
+  row whose encoded payload still exceeds the bound raises
+  :class:`chunkcodec.OversizedRowError` — a structured error instead of
+  the former unbounded recursion.
+
+  ``rows`` may be a row list or an already-columnar ``ColumnChunk``
+  (e.g. a pushdown segment's output). Returns total encoded bytes
+  shipped; ``stats`` (optional dict) accumulates per-column encoding
+  counts for chunks that shipped.
   """
   from tensorflowonspark_tpu.control import chunkcodec
-  rows = list(rows)
-  payload = chunkcodec.encode(rows)
-  if len(payload) > chunkcodec.MAX_PAYLOAD and len(rows) > 1:
-    half = len(rows) // 2
-    put_rows_chunk(channel, rows[:half], timeout=timeout)
-    put_rows_chunk(channel, rows[half:], timeout=timeout)
-    return
-  channel.put_chunk(len(rows), payload, block=True, timeout=timeout)
+  from tensorflowonspark_tpu.obs import metrics as obs_metrics
+  if not isinstance(rows, chunkcodec.ColumnChunk):
+    rows = list(rows)
+  enc_counts: Dict[str, int] = {}
+  total_bytes = 0
+  total_rows = 0
+  # LIFO work stack: push the back half first so rows ship in order
+  stack = [rows]
+  while stack:
+    chunk = stack.pop()
+    n = chunk.n if isinstance(chunk, chunkcodec.ColumnChunk) else len(chunk)
+    tally: Dict[str, int] = {}
+    payload = chunkcodec.encode(chunk, tally)
+    if len(payload) > chunkcodec.MAX_PAYLOAD:
+      if n <= 1:
+        raise chunkcodec.OversizedRowError(
+            "a single row encodes to %d bytes, above the transport bound "
+            "(chunkcodec.MAX_PAYLOAD = %d); it cannot be split further at "
+            "the row level" % (len(payload), chunkcodec.MAX_PAYLOAD))
+      half = n // 2
+      stack.append(_slice_chunk(chunk, half, n))
+      stack.append(_slice_chunk(chunk, 0, half))
+      continue
+    channel.put_chunk(n, payload, block=True, timeout=timeout)
+    total_bytes += len(payload)
+    total_rows += n
+    # merge the tally only for envelopes that actually shipped (an
+    # oversized encode attempt is re-encoded after the split)
+    for name, cnt in tally.items():
+      enc_counts[name] = enc_counts.get(name, 0) + cnt
+  if stats is not None:
+    for name, cnt in enc_counts.items():
+      stats[name] = stats.get(name, 0) + cnt
+  reg = obs_metrics.active()
+  if reg is not None and total_rows:
+    reg.counter("feed.wire_bytes").inc(total_bytes)
+    reg.counter("feed.wire_rows").inc(total_rows)
+    for name, cnt in enc_counts.items():
+      reg.counter("feed.wire_enc." + name).inc(cnt)
+  return total_bytes
+
+
+class _ChunkSizer(object):
+  """Adaptive rows-per-envelope targeting ``target`` encoded bytes.
+
+  Tracks an EWMA of observed encoded bytes per SOURCE row (pushdown and
+  compression both fold into the ratio: a selective filter or a 4x codec
+  simply makes source rows cheap on the wire, so the next envelope
+  carries more of them). The row target stays clamped to
+  ``[_ADAPT_MIN_ROWS, _ADAPT_MAX_ROWS]`` both ways."""
+
+  __slots__ = ("target", "rows", "_bpr")
+
+  def __init__(self, base_rows: int, target_bytes: int):
+    self.target = int(target_bytes)
+    self.rows = max(_ADAPT_MIN_ROWS, min(int(base_rows), _ADAPT_MAX_ROWS))
+    self._bpr = 0.0
+
+  def observe(self, n_rows: int, n_bytes: int) -> None:
+    if n_rows <= 0:
+      return
+    bpr = n_bytes / float(n_rows)
+    self._bpr = bpr if not self._bpr else 0.5 * self._bpr + 0.5 * bpr
+    if self._bpr > 0:
+      self.rows = max(_ADAPT_MIN_ROWS,
+                      min(int(self.target / self._bpr), _ADAPT_MAX_ROWS))
+
+
+def _feed_plan(cluster_meta: Dict, chunk_size: Optional[int]):
+  """Resolve one feeder task's shipping plan from cluster_meta (executor
+  side): ``(chunk_size, run_segment, sizer)``. The pushdown segment
+  compiles once per task; the sizer exists only when a byte budget is
+  set (``feed_target_bytes`` cluster param, else ``TOS_FEED_TARGET_BYTES``)."""
+  from tensorflowonspark_tpu.control import chunkcodec
+  chunk_size = chunk_size or cluster_meta.get("feed_chunk_size", 256)
+  # a new stream's columns owe nothing to the last one: drop any probe
+  # backoff left by a previous feeder task in this process, or a fresh
+  # compressible stream would ship its leading chunks raw
+  chunkcodec._probe_backoff.clear()
+  segment = cluster_meta.get("feed_segment")
+  run_segment = segment.compile() if segment is not None else None
+  target = cluster_meta.get("feed_target_bytes")
+  if not target:
+    try:
+      target = int(os.environ.get(ENV_FEED_TARGET_BYTES, "0") or 0)
+    except ValueError:
+      target = 0
+  sizer = _ChunkSizer(chunk_size, target) if target and target > 0 else None
+  return chunk_size, run_segment, sizer
+
+
+def _flush_chunk(queue, chunk, run_segment, sizer, timeout,
+                 stats=None) -> int:
+  """Apply the pushdown segment (if any) to one accumulated source chunk
+  and ship the survivors. Returns rows actually DELIVERED (post-segment)
+  — a pushed-down filter drops rows feeder-side, and inference collects
+  one result per delivered row, not per source row. The sizer observes
+  SOURCE rows against shipped bytes so its budget covers the whole
+  segment+codec pipeline."""
+  src_n = len(chunk)
+  out = chunk
+  if run_segment is not None:
+    out = run_segment(chunk)
+  n = 0 if out is None else (out.n if hasattr(out, "n") else len(out))
+  nbytes = put_rows_chunk(queue, out, timeout=timeout, stats=stats) \
+      if n else 0
+  if sizer is not None and src_n:
+    sizer.observe(src_n, nbytes)
+  return n
 
 
 class DualInput(object):
   """CONSUMER-side input draining the shm ring AND the hub queue.
 
-  Co-host feeders (and the end-of-feed markers from shutdown tasks, which
-  always run on the node's own executor) arrive on the ring; feeders on
-  other hosts fall back to the hub queue. Per-partition row order is
+  Co-host feeders (and the end-of-feed markers from co-hosted shutdown
+  tasks) arrive on the ring; feeders on other hosts — and shutdown tasks
+  the shared queue placed off-host — fall back to the hub queue.
+  Per-partition row order is
   preserved because any single feeder uses exactly one channel.
   ``task_done`` routes to whichever channel produced the last batch, so
   queue join backpressure still works for remote feeders.
@@ -941,10 +1116,11 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
   ``put_rows_chunk`` — encoded once (columnar for homogeneous rows) and
   shipped whole — preserving blocking backpressure and the
   terminating-state drain semantics (TFSparkNode.py:492-531).
-  ``chunk_size`` defaults to the cluster's ``feed_chunk_size``.
+  ``chunk_size`` defaults to the cluster's ``feed_chunk_size``; a
+  ``feed_segment`` in cluster_meta (datapipe pushdown) runs here before
+  the codec, and a ``feed_target_bytes`` budget sizes chunks adaptively.
   """
   authkey = cluster_meta["authkey"]
-  chunk_size = chunk_size or cluster_meta.get("feed_chunk_size", 256)
 
   def _train(iterator):
     executor_id = hostinfo.read_executor_id(os.getcwd())
@@ -961,23 +1137,28 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
       for _ in iterator:
         pass
       return [0]
+    shipper = _ensure_feeder_shipper(cluster_meta.get("server_addr"),
+                                     executor_id)
+    size, run_segment, sizer = _feed_plan(cluster_meta, chunk_size)
     iterator = _materialize_partition(iterator)
     rows = 0
+    flushes = 0
     chunk = []
     for item in iterator:
       chunk.append(item)
-      if len(chunk) >= chunk_size:
-        put_rows_chunk(queue, chunk, timeout=feed_timeout)
+      if len(chunk) >= (sizer.rows if sizer is not None else size):
         rows += len(chunk)
+        _flush_chunk(queue, chunk, run_segment, sizer, feed_timeout)
         chunk = []
+        flushes += 1
         # poll the error queue every 8th flushed chunk — at the flush
         # point only (a per-item check would re-fire hundreds of times
-        # while `rows` sits on the boundary value)
-        if (rows // chunk_size) % 8 == 0:
+        # while the count sits on a boundary value)
+        if flushes % 8 == 0:
           _check_errors(hub, "feeding")
     if chunk:
-      put_rows_chunk(queue, chunk, timeout=feed_timeout)
       rows += len(chunk)
+      _flush_chunk(queue, chunk, run_segment, sizer, feed_timeout)
     # wait until the consumer processed everything, surfacing errors
     # (parity :504-517)
     deadline = time.monotonic() + feed_timeout
@@ -988,6 +1169,10 @@ def make_train_fn(cluster_info, cluster_meta, feed_timeout=600, qname="input",
             "feed timeout (%ds) waiting for node to consume %d rows"
             % (feed_timeout, rows))
     _check_errors(hub, "feeding")
+    if shipper is not None:
+      # final flush: this may be the run's last feed task, and engine
+      # teardown won't wait for the cadence thread's next round
+      shipper.ship(timeout=5.0)
     logger.info("fed %d rows to executor %d", rows, executor_id)
     return [rows]
 
@@ -999,7 +1184,6 @@ def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
   """Inference task: feed one partition, collect its results from the output
   queue (parity: TFSparkNode.inference, TFSparkNode.py:538-599)."""
   authkey = cluster_meta["authkey"]
-  chunk_size = chunk_size or cluster_meta.get("feed_chunk_size", 256)
 
   def _inference(iterator):
     from tensorflowonspark_tpu.control.marker import EndPartition
@@ -1007,19 +1191,23 @@ def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
     executor_id = hostinfo.read_executor_id(os.getcwd())
     hub = _get_hub(cluster_info, executor_id, authkey)
     queue = input_channel(hub, qname)
+    shipper = _ensure_feeder_shipper(cluster_meta.get("server_addr"),
+                                     executor_id)
+    size, run_segment, sizer = _feed_plan(cluster_meta, chunk_size)
+    # `count` is rows DELIVERED to the node (post-pushdown): a pushed-down
+    # filter drops rows feeder-side and they produce no results, so the
+    # collection loop below must not wait for them
     count = 0
     chunk = []
     for item in iterator:
       chunk.append(item)
-      if len(chunk) >= chunk_size:
-        put_rows_chunk(queue, chunk, timeout=feed_timeout)
-        count += len(chunk)
+      if len(chunk) >= (sizer.rows if sizer is not None else size):
+        count += _flush_chunk(queue, chunk, run_segment, sizer, feed_timeout)
         chunk = []
     if chunk:
-      put_rows_chunk(queue, chunk, timeout=feed_timeout)
-      count += len(chunk)
+      count += _flush_chunk(queue, chunk, run_segment, sizer, feed_timeout)
     if count == 0:
-      return []  # empty partitions short-circuit (parity :569-570)
+      return []  # empty/fully-filtered partitions short-circuit (parity :569-570)
     queue.put(EndPartition(), block=True, timeout=feed_timeout)
 
     deadline = time.monotonic() + feed_timeout
@@ -1040,6 +1228,8 @@ def make_inference_fn(cluster_info, cluster_meta, feed_timeout=600,
         continue
       results.extend(got)
       out_q.task_done(len(got))
+    if shipper is not None:
+      shipper.ship(timeout=5.0)   # final flush before the task returns
     return results
 
   return _inference
@@ -1078,16 +1268,44 @@ def make_tb_kill_fn(cluster_info, cluster_meta):
 def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
                      queues=("input",)):
   """Shutdown task: send end-of-feed, await node exit, surface late errors
-  (parity: TFSparkNode.shutdown, TFSparkNode.py:602-656)."""
+  (parity: TFSparkNode.shutdown, TFSparkNode.py:602-656).
+
+  The partition payload names the executor whose node this task stops.
+  Engine shutdown tasks ride the SHARED queue, so both tasks can land on
+  whichever executor frees up first — if this task acted on the slot it
+  happens to occupy, one node could receive two end-of-feed markers while
+  the other receives none and hangs until engine teardown. Host-local side
+  effects (TensorBoard SIGTERM, /dev/shm reap) only run when the target
+  node is co-hosted with this task."""
   authkey = cluster_meta["authkey"]
 
-  def _shutdown(iterator):
-    for _ in iterator:
-      pass
-    executor_id = hostinfo.read_executor_id(os.getcwd())
-    hub = _get_hub(cluster_info, executor_id, authkey)
+  def _host_of(eid):
+    for n in cluster_info:
+      if n["executor_id"] == eid:
+        return n["hub_addr"][0]
+    return None
 
-    _kill_tensorboard(hub)
+  def _shutdown(iterator):
+    target = None
+    for item in iterator:
+      target = item
+    here = hostinfo.read_executor_id(os.getcwd())
+    executor_id = here if target is None else int(target)
+    if executor_id == here:
+      # local: the cwd hub_addr file is authoritative (relaunched nodes
+      # rewrite it; cluster_info may still name the dead hub)
+      hub = _get_hub(cluster_info, executor_id, authkey)
+    else:
+      entry = next((n for n in cluster_info
+                    if n["executor_id"] == executor_id), None)
+      if entry is None:
+        raise RuntimeError("no cluster node found for executor %d"
+                           % executor_id)
+      hub = feedhub.connect(tuple(entry["hub_addr"]), authkey)
+    co_hosted = executor_id == here or _host_of(executor_id) == _host_of(here)
+
+    if co_hosted:
+      _kill_tensorboard(hub)  # pid signal — only valid on the node's host
 
     for qname in queues:
       input_channel(hub, qname).put(None, block=True, timeout=60)
@@ -1103,9 +1321,15 @@ def make_shutdown_fn(cluster_info, cluster_meta, grace_secs=0,
 
     # the input ring (if any) has served its purpose; unlink the shm
     # segment so repeated runs don't accumulate /dev/shm usage
-    if hub.get("ring_name"):
+    ring_name = hub.get("ring_name")
+    if ring_name:
       from tensorflowonspark_tpu.control import shmring
-      shmring.release(executor_id)
+      if executor_id == here:
+        shmring.release(executor_id)
+      elif co_hosted:
+        # the ring is held by the target's executor process, not this one;
+        # reap the segment by name (open mappings stay valid)
+        shmring.unlink_stale(ring_name)
 
     # late-error propagation with peek-and-put-back (parity :644-650)
     eq = hub.get_queue("error")

@@ -857,6 +857,51 @@ def test_tos013_aligned_knobs_pass():
       analyze_sources({"fixture/chaos.py": TOS013_GOOD}))
 
 
+# --- TOS014: wire-encoding registry parity -----------------------------------
+
+TOS014_GOOD = '''
+def _enc_rle(b):
+  return b
+
+
+def _dec_rle(b):
+  return b
+
+
+_ENCODERS = {"rle": _enc_rle, "zz": _enc_rle}
+_DECODERS = {"rle": _dec_rle, "zz": _dec_rle}
+'''
+
+TOS014_BAD = TOS014_GOOD.replace(
+    '_DECODERS = {"rle": _dec_rle, "zz": _dec_rle}',
+    '_DECODERS = {"rle": _dec_rle}')
+
+
+def test_tos014_encoder_without_decoder_fires():
+  result = analyze_sources({"fixture/codec.py": TOS014_BAD})
+  details = {f.detail for f in result["findings"] if f.rule == "TOS014"}
+  assert details == {"encoding:zz:no-decoder"}
+
+
+def test_tos014_matched_registries_pass():
+  assert "TOS014" not in rules_of(
+      analyze_sources({"fixture/codec.py": TOS014_GOOD}))
+
+
+def test_tos014_extra_decoder_arm_is_fine():
+  # a decoder-only arm is forward compatibility, not drift
+  src = TOS014_GOOD.replace(
+      '_ENCODERS = {"rle": _enc_rle, "zz": _enc_rle}',
+      '_ENCODERS = {"rle": _enc_rle}')
+  assert "TOS014" not in rules_of(
+      analyze_sources({"fixture/codec.py": src}))
+
+
+def test_tos014_live_codec_registries_are_aligned():
+  from tensorflowonspark_tpu.control import chunkcodec
+  assert set(chunkcodec._ENCODERS) <= set(chunkcodec._DECODERS)
+
+
 # --- the incremental cache ---------------------------------------------------
 
 _CACHE_TREE = {

@@ -2,8 +2,19 @@
 feed chunks, transparent fallback for everything else."""
 
 import numpy as np
+import pytest
 
 from tensorflowonspark_tpu.control import chunkcodec
+
+
+@pytest.fixture(autouse=True)
+def _fresh_probe_state():
+  # every test models a fresh feeder stream: per-column probe backoff
+  # from a previous test's declines must not leak into this one
+  # (production streams get the same reset from node._feed_plan)
+  chunkcodec._probe_backoff.clear()
+  yield
+  chunkcodec._probe_backoff.clear()
 
 
 def _roundtrip(chunk):
@@ -170,3 +181,266 @@ class TestFallback:
     rows = [np.array([1, "x"], dtype=object) for _ in range(3)]
     out = _roundtrip(rows)
     assert out[1][1] == "x"
+
+
+def _wire_ids(chunk, **kw):
+  import msgpack
+  msg = msgpack.unpackb(chunkcodec.encode(chunk, **kw), raw=False)
+  assert msg["f"] == 1
+  return [c.get("e", 0) for c in msg["c"]]
+
+
+class TestWireEncodings:
+  """Per-column wire encodings: every encoding must round-trip EXACTLY
+  (bit-identical values AND types) — consumers cannot observe which
+  encoding a chunk rode in on."""
+
+  def _exact(self, rows, want_enc=None, stats_has=None):
+    stats = {}
+    payload = chunkcodec.encode(rows, stats)
+    if want_enc is not None:
+      import msgpack
+      msg = msgpack.unpackb(payload, raw=False)
+      assert [c.get("e", 0) for c in msg["c"]] == want_enc
+    if stats_has is not None:
+      for k in stats_has:
+        assert stats.get(k, 0) > 0, (k, stats)
+    out = chunkcodec.decode(payload)
+    assert len(out) == len(rows)
+    for a, b in zip(rows, out):
+      if isinstance(a, tuple):
+        for x, y in zip(a, b):
+          if isinstance(x, np.ndarray):
+            assert x.dtype == y.dtype
+            np.testing.assert_array_equal(x, y)
+          else:
+            assert type(y) is type(x) and x == y
+      else:
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+    return payload
+
+  def test_dict_low_cardinality_ints(self):
+    rows = [(np.zeros(4, np.float32), i % 7) for i in range(200)]
+    self._exact(rows, stats_has=["dict"])
+
+  def test_dict_respects_cardinality_bound(self):
+    # > 256 distinct values: index stream can't stay uint8 -> not dict
+    rows = [(np.zeros(4, np.float32), i * 3) for i in range(400)]
+    stats = {}
+    chunkcodec.encode(rows, stats)
+    assert stats.get("dict", 0) == 0
+    self._exact(rows)
+
+  def test_dict_never_applies_to_floats(self):
+    # float dict would collapse NaN payload patterns in np.unique,
+    # breaking bit parity — floats must pick raw or zlib only
+    rows = [(np.zeros(4, np.int64), float(i % 3)) for i in range(300)]
+    assert _wire_ids(rows)[1] != chunkcodec._E_DICT
+
+  def test_delta_monotone_ids(self):
+    rows = [(np.zeros(4, np.float32), 10_000 + 3 * i) for i in range(200)]
+    self._exact(rows, stats_has=["delta"])
+
+  def test_delta_negative_start_and_dtype_fidelity(self):
+    base = np.arange(-50, 150, dtype=np.int16)
+    rows = [(np.zeros(4, np.float32), v) for v in base.tolist()]
+    payload = self._exact(rows)
+    out = chunkcodec.decode(payload)
+    assert all(type(r[1]) is int for r in out)
+
+  def test_delta_rejects_non_monotone(self):
+    vals = list(range(300))
+    vals[150] = 0   # one dip kills monotonicity
+    rows = [(np.zeros(4, np.float32), v) for v in vals]
+    stats = {}
+    chunkcodec.encode(rows, stats)
+    assert stats.get("delta", 0) == 0
+    self._exact(rows)
+
+  def test_delta_rejects_wide_span(self):
+    # span > uint32: frame-of-reference deltas would overflow the wire dtype
+    rows = [(np.zeros(4, np.float32), i * (1 << 40)) for i in range(200)]
+    stats = {}
+    chunkcodec.encode(rows, stats)
+    assert stats.get("delta", 0) == 0
+    self._exact(rows)
+
+  def test_bitpack_bools(self):
+    rng = np.random.default_rng(7)
+    rows = [rng.integers(0, 2, 64).astype(bool) for _ in range(32)]
+    payload = self._exact(rows, stats_has=["bitpack"])
+    # 32*64 bools -> 256 packed bytes; envelope must reflect that
+    assert len(payload) < 32 * 64
+
+  def test_zlib_compressible_floats(self):
+    rows = [np.zeros(300, np.float64) for _ in range(64)]
+    payload = self._exact(rows, stats_has=["zlib"])
+    assert len(payload) < rows[0].nbytes  # 64 rows in less than one raw row
+
+  def test_incompressible_stays_raw_zero_copy(self):
+    rng = np.random.default_rng(0)
+    rows = [rng.standard_normal(256).astype(np.float32) for _ in range(32)]
+    stats = {}
+    payload = chunkcodec.encode(rows, stats)
+    assert stats == {"raw": 1}
+    chunk = chunkcodec.decode_columns(payload)
+    col = chunk.cols[0]
+    assert not col.flags.writeable
+    assert col.base is not None   # a view over the msgpack bin, not a copy
+
+  def test_small_columns_skip_the_heuristic(self):
+    rows = [(np.zeros(2, np.float32), i % 3) for i in range(8)]
+    stats = {}
+    chunkcodec.encode(rows, stats)
+    assert "dict" not in stats and "zlib" not in stats
+
+  def test_encoded_columns_decode_read_only(self):
+    rows = [(np.arange(784, dtype=np.int32) % 16, i % 5, 100 + i)
+            for i in range(256)]
+    chunk = chunkcodec.decode_columns(chunkcodec.encode(rows))
+    for col in chunk.cols:
+      assert not col.flags.writeable
+
+  def test_rows_after_encoded_decode_are_writable(self):
+    rows = [np.arange(784, dtype=np.int32) % 16 for _ in range(64)]
+    out = _roundtrip(rows)
+    out[0] += 1   # pickle parity holds through every encoding
+    np.testing.assert_array_equal(out[1], rows[1])
+
+  def test_env_spec_disables_encoders(self, monkeypatch):
+    monkeypatch.setenv(chunkcodec.ENV_FEED_WIRE_ENCODINGS, "raw")
+    rows = [(np.arange(784, dtype=np.int32) % 16, i % 5) for i in range(256)]
+    stats = {}
+    payload = chunkcodec.encode(rows, stats)
+    assert set(stats) == {"raw"}
+    import msgpack
+    msg = msgpack.unpackb(payload, raw=False)
+    assert all("e" not in c for c in msg["c"])
+
+  def test_env_spec_selects_subset(self, monkeypatch):
+    monkeypatch.setenv(chunkcodec.ENV_FEED_WIRE_ENCODINGS, "delta")
+    rows = [(np.arange(784, dtype=np.int32) % 16, 100 + i)
+            for i in range(256)]
+    stats = {}
+    chunkcodec.encode(rows, stats)
+    assert stats.get("delta", 0) == 1 and "dict" not in stats
+
+  def test_unknown_wire_id_is_a_structured_error(self):
+    import msgpack
+    rows = [np.ones(256, np.float32) for _ in range(4)]
+    msg = msgpack.unpackb(chunkcodec.encode(rows), raw=False)
+    msg["c"][0]["e"] = 250
+    bad = msgpack.packb(msg, use_bin_type=True)
+    try:
+      chunkcodec.decode_columns(bad)
+    except ValueError as e:
+      assert "wire-encoding" in str(e)
+    else:
+      raise AssertionError("unknown wire id must not decode silently")
+
+  def test_registry_parity(self):
+    # the TOS014 contract, asserted at runtime too: every encoder has a
+    # decoder arm, and every wire id maps back to a registry name
+    assert set(chunkcodec._ENCODERS) <= set(chunkcodec._DECODERS)
+    assert set(chunkcodec._WIRE_IDS) == set(chunkcodec._ENCODERS)
+
+  def test_column_chunk_reencodes_without_rows(self):
+    rows = [(np.arange(784, dtype=np.int32) % 16, i % 5, 100 + i)
+            for i in range(256)]
+    chunk = chunkcodec.decode_columns(chunkcodec.encode(rows))
+    stats = {}
+    payload = chunkcodec.encode(chunk, stats)
+    assert stats.get("dict", 0) >= 1
+    out = chunkcodec.decode(payload)
+    for a, b in zip(rows, out):
+      np.testing.assert_array_equal(a[0], b[0])
+      assert type(b[1]) is int and (a[1], a[2]) == (b[1], b[2])
+
+  def test_sliced_column_chunk_encodes(self):
+    # put_rows_chunk splits oversized chunks by slicing column views
+    rows = [(np.arange(64, dtype=np.int32), i % 5) for i in range(64)]
+    chunk = chunkcodec.decode_columns(chunkcodec.encode(rows))
+    half = chunkcodec.ColumnChunk([c[:32] for c in chunk.cols],
+                                  chunk.scalar, chunk.tuples, 32)
+    out = chunkcodec.decode(chunkcodec.encode(half))
+    assert len(out) == 32
+    for a, b in zip(rows[:32], out):
+      np.testing.assert_array_equal(a[0], b[0])
+      assert a[1] == b[1]
+
+  def test_pure_scalar_column_chunk_falls_back_to_pickle(self):
+    chunk = chunkcodec.decode_columns(chunkcodec.encode(
+        [(np.ones(2, np.float32), i) for i in range(4)]))
+    scalars = chunkcodec.ColumnChunk([chunk.cols[1]], [1], False, 4)
+    out = chunkcodec.decode(chunkcodec.encode(scalars))
+    assert out == [0, 1, 2, 3] and all(type(v) is int for v in out)
+
+
+class TestProbeBackoff:
+  """Probe hysteresis: a column that declines every enabled encoder backs
+  off exponentially (capped), any successful pick resets it, and a new
+  feeder stream starts clean — so incompressible columns pay a handful of
+  probes per thousand chunks instead of one per chunk."""
+
+  def _noise_chunk(self, s):
+    rs = np.random.RandomState(s)
+    px = rs.rand(8, 64).astype(np.float32)   # 2 KiB >= MIN_ENCODE_BYTES
+    return [(px[i], float(rs.rand())) for i in range(8)]
+
+  def test_declined_probes_back_off(self, monkeypatch):
+    calls = {"n": 0}
+    orig = chunkcodec._ENCODERS["zlib"]
+
+    def counting(arr, raw):
+      calls["n"] += 1
+      return orig(arr, raw)
+
+    monkeypatch.setitem(chunkcodec._ENCODERS, "zlib", counting)
+    for s in range(64):
+      out = _roundtrip(self._noise_chunk(s))
+      assert len(out) == 8
+    # exponential backoff probes chunks 0, 2, 6, 14, 30, 62 — not all 64
+    assert 0 < calls["n"] <= 10
+
+  def test_successful_pick_resets_backoff(self):
+    for s in range(8):
+      chunkcodec.encode(self._noise_chunk(s))
+    key = (0, "<f4")
+    assert chunkcodec._probe_backoff.get(key)
+    # same column turns compressible: once its current skip window runs
+    # out it re-probes, picks zlib, and the backoff state drops
+    zeros = [(np.zeros(64, np.float32), 0.0) for _ in range(8)]
+    picked_at = None
+    for i in range(chunkcodec._PROBE_BACKOFF_MAX + 1):
+      stats = {}
+      chunkcodec.encode(zeros, stats)
+      if stats.get("zlib"):
+        picked_at = i
+        break
+    assert picked_at is not None
+    assert picked_at <= chunkcodec._PROBE_BACKOFF_MAX
+    assert key not in chunkcodec._probe_backoff
+
+  def test_backoff_skip_is_capped(self):
+    for s in range(200):
+      chunkcodec.encode(self._noise_chunk(s))
+    state = chunkcodec._probe_backoff[(0, "<f4")]
+    assert state[0] <= chunkcodec._PROBE_BACKOFF_MAX
+
+  def test_feed_plan_starts_streams_clean(self):
+    for s in range(8):
+      chunkcodec.encode(self._noise_chunk(s))
+    assert chunkcodec._probe_backoff
+    from tensorflowonspark_tpu.node import _feed_plan
+    _feed_plan({}, 128)
+    assert not chunkcodec._probe_backoff
+
+  def test_backoff_never_changes_payload_values(self):
+    # while backing off the column ships raw — bit-identical round-trip
+    for s in range(6):
+      rows = self._noise_chunk(s)
+      out = _roundtrip(rows)
+      for (a_px, a_sc), (b_px, b_sc) in zip(rows, out):
+        np.testing.assert_array_equal(a_px, b_px)
+        assert a_sc == b_sc

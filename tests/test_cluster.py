@@ -373,6 +373,44 @@ def test_late_exception_after_feeding(engine):
     c.shutdown(grace_secs=1, timeout=120)
 
 
+def test_shutdown_task_targets_payload_executor(tmp_path, monkeypatch):
+  """The engine's shared task queue can place BOTH shutdown tasks on one
+  executor (whichever frees up first). The end-of-feed marker must reach
+  the hub of the executor named in the partition payload — not the hub of
+  the slot the task happens to occupy — or the untargeted node never sees
+  its marker and hangs in the feed loop until engine teardown (exposed
+  when TCP_NODELAY made node stop fast enough for placements to collide)."""
+  from tensorflowonspark_tpu import node as node_mod
+  from tensorflowonspark_tpu.control import feedhub
+  from tensorflowonspark_tpu.utils import hostinfo
+
+  authkey = b"k"
+  hubs = [feedhub.start(authkey, ["input", "error"], mode="local")
+          for _ in range(2)]
+  try:
+    for h in hubs:
+      h.set("state", "stopped")   # nodes already exited; no wait loop
+    cluster_info = [
+        {"executor_id": i, "job_name": "worker", "task_index": i,
+         "hub_addr": list(h.addr)} for i, h in enumerate(hubs)]
+    wd = tmp_path / "exec0"       # this task occupies executor 0's slot...
+    wd.mkdir()
+    hostinfo.write_executor_id(0, str(wd))
+    monkeypatch.chdir(wd)
+
+    fn = node_mod.make_shutdown_fn(cluster_info, {"authkey": authkey})
+    # ...but its payload targets executor 1: the marker must reach hub 1
+    assert fn(iter([1])) == [1]
+    assert hubs[1].get_queue("input").get_many(1, block=False) == [None]
+    assert hubs[0].get_queue("input").qsize() == 0
+    # a correctly-placed task (payload matches the slot) marks its own hub
+    assert fn(iter([0])) == [0]
+    assert hubs[0].get_queue("input").get_many(1, block=False) == [None]
+  finally:
+    for h in hubs:
+      h.shutdown()
+
+
 def test_port_reservation_semantics(engine):
   """release_port=False keeps the node port reserved until user code releases
   it (parity :93-121)."""

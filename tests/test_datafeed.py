@@ -530,3 +530,185 @@ class TestSlabFeed:
       assert isinstance(item, Slab)
       assert isinstance(item.data["x"], jax.Array)
       assert item.data["x"].shape == (2, 2, 2)
+
+
+class TestWirePlane:
+  """put_rows_chunk encoded-size splitting + the OversizedRowError
+  contract, adaptive chunk sizing bounds, and the aligned zero-copy
+  assembly fast path (parity across chunk-boundary / partial-tail
+  shapes)."""
+
+  @pytest.fixture(autouse=True)
+  def _fresh_stream(self):
+    # each test models a fresh feeder stream: probe backoff left by a
+    # previous test's columns must not leak in (matches _feed_plan's
+    # per-stream reset)
+    from tensorflowonspark_tpu.control import chunkcodec
+    chunkcodec._probe_backoff.clear()
+    yield
+    chunkcodec._probe_backoff.clear()
+
+  class _Sink:
+    """Stub channel recording (rows, encoded bytes) per envelope."""
+
+    def __init__(self):
+      self.envelopes = []
+
+    def put_chunk(self, n, payload, block=True, timeout=None):
+      self.envelopes.append((n, len(payload)))
+
+  def test_oversized_chunk_splits_on_encoded_size(self, hub):
+    from tensorflowonspark_tpu.control import chunkcodec
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    rng = np.random.default_rng(3)
+    # 20 MiB of incompressible float32: must split into >= 5 envelopes,
+    # every one within the encoded bound, rows in order
+    rows = [rng.standard_normal(1 << 18).astype(np.float32)
+            for _ in range(20)]
+    sink = self._Sink()
+    nbytes = put_rows_chunk(sink, rows, timeout=10)
+    assert nbytes >= 20 * (1 << 20)
+    assert len(sink.envelopes) >= 5
+    assert all(b <= chunkcodec.MAX_PAYLOAD for _, b in sink.envelopes)
+    assert sum(n for n, _ in sink.envelopes) == 20
+    # the same rows round-trip through a real hub queue
+    q = hub.get_queue("input")
+    put_rows_chunk(q, rows, timeout=10)
+    q.put(None)
+    feed = DataFeed(hub, pipeline_depth=0, input_mapping={"only": "x"})
+    batch = feed.next_batch_arrays(20)["x"]
+    np.testing.assert_array_equal(batch, np.stack(rows))
+
+  def test_compression_widens_the_envelope_budget(self, hub):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    # 20 MiB raw, but all-zero: the zlib-encoded payload fits ONE envelope
+    rows = [np.zeros(1 << 18, np.float32) for _ in range(20)]
+    stats = {}
+    sink = self._Sink()
+    nbytes = put_rows_chunk(sink, rows, timeout=10, stats=stats)
+    assert len(sink.envelopes) == 1
+    assert stats.get("zlib", 0) == 1
+    assert nbytes < 1 << 20
+    q = hub.get_queue("input")
+    put_rows_chunk(q, rows, timeout=10)
+    q.put(None)
+    feed = DataFeed(hub, pipeline_depth=0, input_mapping={"only": "x"})
+    np.testing.assert_array_equal(feed.next_batch_arrays(20)["x"],
+                                  np.zeros((20, 1 << 18), np.float32))
+
+  def test_single_unencodable_row_is_a_structured_error(self, hub):
+    from tensorflowonspark_tpu.control import chunkcodec
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    rng = np.random.default_rng(5)
+    row = rng.standard_normal(chunkcodec.MAX_PAYLOAD // 4 + 4096)
+    with pytest.raises(chunkcodec.OversizedRowError, match="MAX_PAYLOAD"):
+      put_rows_chunk(q, [row.astype(np.float32)], timeout=5)
+    assert q.qsize() == 0   # nothing partial shipped
+
+  def test_sizer_converges_to_byte_budget(self):
+    from tensorflowonspark_tpu.node import _ChunkSizer
+    sizer = _ChunkSizer(256, 1 << 19)
+    for _ in range(8):
+      sizer.observe(sizer.rows, sizer.rows * 100)   # 100 B/row observed
+    target_rows = (1 << 19) // 100
+    assert abs(sizer.rows - target_rows) <= target_rows * 0.01
+
+  def test_sizer_clamps_both_ways(self):
+    from tensorflowonspark_tpu import node
+    fat = node._ChunkSizer(256, 1024)
+    for _ in range(8):
+      fat.observe(256, 256 * 100_000)         # 100 KB/row, tiny budget
+    assert fat.rows == node._ADAPT_MIN_ROWS
+    thin = node._ChunkSizer(256, 1 << 30)
+    for _ in range(8):
+      thin.observe(256, 256)                  # 1 B/row, huge budget
+    assert thin.rows == node._ADAPT_MAX_ROWS
+
+  def test_feed_plan_resolves_target_from_meta_over_env(self, monkeypatch):
+    from tensorflowonspark_tpu import node
+    monkeypatch.setenv(node.ENV_FEED_TARGET_BYTES, "4096")
+    _, seg, sizer = node._feed_plan({"feed_chunk_size": 64,
+                                     "feed_target_bytes": 1 << 20}, None)
+    assert seg is None and sizer is not None and sizer.target == 1 << 20
+    _, _, sizer = node._feed_plan({"feed_chunk_size": 64}, None)
+    assert sizer is not None and sizer.target == 4096
+    monkeypatch.delenv(node.ENV_FEED_TARGET_BYTES)
+    _, _, sizer = node._feed_plan({"feed_chunk_size": 64}, None)
+    assert sizer is None   # no budget -> fixed row count
+
+  def _fill(self, hub, chunks):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    q = hub.get_queue("input")
+    for c in chunks:
+      put_rows_chunk(q, c, timeout=5)
+    q.put(None)
+
+  def test_aligned_batch_is_zero_copy_and_read_only(self, hub):
+    chunks = [[(np.full(3, i, np.float32), i) for i in range(8)]]
+    self._fill(hub, chunks)
+    feed = DataFeed(hub, pipeline_depth=0,
+                    input_mapping={"c0": "x", "c1": "y"})
+    batch = feed.next_batch_arrays(4)
+    assert feed.stats["aligned_batches"] == 1
+    assert not batch["x"].flags.writeable
+    assert batch["x"].base is not None    # a view, not the hand-off copy
+    np.testing.assert_array_equal(batch["y"], np.arange(4))
+    tail = feed.next_batch_arrays(4)      # second half of the same chunk
+    assert feed.stats["aligned_batches"] == 2
+    np.testing.assert_array_equal(tail["y"], np.arange(4, 8))
+    # sibling batches share the chunk buffer but never overlap
+    np.testing.assert_array_equal(batch["x"][:, 0], np.arange(4))
+
+  def test_spanning_batch_still_copies_and_matches(self, hub):
+    chunks = [[(np.full(3, 4 * c + i, np.float32), 4 * c + i)
+               for i in range(4)] for c in range(3)]
+    self._fill(hub, chunks)
+    feed = DataFeed(hub, pipeline_depth=0,
+                    input_mapping={"c0": "x", "c1": "y"})
+    span = feed.next_batch_arrays(6)      # crosses the chunk 0/1 boundary
+    assert feed.stats["aligned_batches"] == 0
+    assert span["x"].flags.writeable      # the hand-off copy, as before
+    np.testing.assert_array_equal(span["y"], np.arange(6))
+    aligned = feed.next_batch_arrays(2)   # inside chunk 1's tail
+    assert feed.stats["aligned_batches"] == 1
+    np.testing.assert_array_equal(aligned["y"], [6, 7])
+    rest = feed.next_batch_arrays(6)      # chunk 2 + end-of-feed tail
+    np.testing.assert_array_equal(rest["y"], np.arange(8, 12))
+    assert feed.should_stop()
+
+  @pytest.mark.parametrize("batch_size", [2, 4, 5, 8, 12])
+  def test_assembly_parity_across_shapes(self, batch_size):
+    """Aligned and spanning paths must hand out identical values for
+    every batch/chunk alignment, partial tail included."""
+    chunks = [[(np.full(3, 4 * c + i, np.float32), 4 * c + i)
+               for i in range(4)] for c in range(3)]
+    h = feedhub.start(b"k", ["input", "output", "error"], mode="local")
+    try:
+      self._fill(h, chunks)
+      feed = DataFeed(h, pipeline_depth=0,
+                      input_mapping={"c0": "x", "c1": "y"})
+      seen = []
+      while not feed.should_stop():
+        batch = feed.next_batch_arrays(batch_size)
+        if batch:
+          assert len(batch["y"]) <= batch_size
+          seen.extend(np.asarray(batch["y"]).tolist())
+    finally:
+      h.shutdown()
+    assert seen == list(range(12))
+
+  def test_wire_counters_reach_the_obs_registry(self, hub):
+    from tensorflowonspark_tpu.node import put_rows_chunk
+    from tensorflowonspark_tpu.obs import metrics as obs_metrics
+    reg = obs_metrics.activate(obs_metrics.MetricsRegistry())
+    try:
+      put_rows_chunk(hub.get_queue("input"),
+                     [(np.arange(784, dtype=np.int32) % 16, i % 5)
+                      for i in range(256)], timeout=5)
+    finally:
+      obs_metrics.deactivate()
+    snap = reg.snapshot()
+    assert snap["feed.wire_rows"]["value"] == 256
+    assert snap["feed.wire_bytes"]["value"] > 0
+    assert snap["feed.wire_enc.dict"]["value"] >= 1

@@ -625,3 +625,188 @@ class TestObsWiring:
         ex.stop()
     finally:
       obs_spans.deactivate()
+
+
+def _pd_map(x, y):
+  return (x[:, :2] * 2.0).astype(np.float32), y
+
+
+def _pd_filter(x, y):
+  return np.asarray(y) % 3 != 0
+
+
+class TestPushdown:
+  """Feeder-side transform pushdown (split_pushdown / FeederSegment):
+  the pushable map/filter prefix applied FEEDER-side before the wire
+  codec + the consumer remainder must be batch-for-batch bit-identical
+  to the full consumer-side graph — pushdown moves computation, never
+  order. Covered on both transports (hub queue and shm ring), with the
+  end-of-feed tail and EndPartition boundaries included."""
+
+  def _graph(self, src):
+    return (src.map(_pd_map, columnar=True)
+            .filter(_pd_filter, columnar=True))
+
+  def test_split_carves_the_stateless_prefix(self):
+    ds = (self._graph(Dataset.from_chunks([], columns=["x", "y"]))
+          .shuffle(8, seed=1).batch(4))
+    seg, rest = ds.split_pushdown()
+    assert seg is not None
+    assert [op[0] for op in seg.ops] == ["map", "filter"]
+    assert [op[0] for op in rest._ops] == ["shuffle", "batch"]
+    assert rest._columns == ds._columns
+    assert rest._train_mode == ds._train_mode
+
+  def test_split_stops_at_first_stateful_stage(self):
+    ds = (Dataset.from_chunks([], columns=["x", "y"])
+          .map(_pd_map, columnar=True).shuffle(8, seed=1)
+          .filter(_pd_filter, columnar=True).batch(4))
+    seg, rest = ds.split_pushdown()
+    assert [op[0] for op in seg.ops] == ["map"]
+    assert [op[0] for op in rest._ops] == ["shuffle", "filter", "batch"]
+
+  def test_split_disabled_by_env(self, monkeypatch):
+    monkeypatch.setenv(datapipe.ENV_FEED_PUSHDOWN, "0")
+    ds = self._graph(Dataset.from_chunks([], columns=["x", "y"])).batch(4)
+    seg, rest = ds.split_pushdown()
+    assert seg is None and rest is ds
+
+  def test_no_leading_prefix_no_split(self):
+    ds = (Dataset.from_chunks([], columns=["x", "y"])
+          .shuffle(8, seed=1).batch(4))
+    seg, rest = ds.split_pushdown()
+    assert seg is None and rest is ds
+
+  def test_interleave_never_pushes(self):
+    srcs = [Dataset.from_chunks([], columns=["x", "y"]) for _ in range(2)]
+    ds = self._graph(Dataset.interleave(srcs)).batch(4)
+    seg, rest = ds.split_pushdown()
+    assert seg is None and rest is ds
+
+  def test_prefetch_depths_remap_to_consumer_indices(self):
+    ds = (Dataset.from_chunks([], columns=["x", "y"])
+          .map(_pd_map, columnar=True).prefetch(6)
+          .shuffle(8, seed=1).prefetch(3).batch(4))
+    seg, rest = ds.split_pushdown()
+    assert [op[0] for op in seg.ops] == ["map"]
+    # the pushed stage's prefetch pads the consumer-side source buffer;
+    # the shuffle's depth shifts with its new index
+    assert rest._depths == {-1: 6, 0: 3}
+
+  def test_segment_compile_matches_consumer_stages(self):
+    chunks = _chunks(5, 4)
+    seg, _ = (self._graph(Dataset.from_chunks(chunks, columns=["x", "y"]))
+              .batch(6).split_pushdown())
+    run = seg.compile()
+    for rows in chunks:
+      out = run(rows)
+      assert isinstance(out, ColumnChunk)
+      keep = [r for r in rows if r[1] % 3 != 0]
+      assert out.n == len(keep)
+      np.testing.assert_array_equal(
+          out.cols[0], np.stack([(r[0][:2] * 2.0).astype(np.float32)
+                                 for r in keep]))
+      assert out.cols[1].tolist() == [r[1] for r in keep]
+
+  def test_segment_filters_whole_chunk_to_none(self):
+    seg = datapipe.FeederSegment(
+        [("filter", lambda x, y: np.zeros(len(y), bool), True)])
+    assert seg.compile()(_chunks(1, 4)[0]) is None
+
+  def test_pending_template_cannot_start(self):
+    tmpl = Dataset.pipeline().map(_pd_map, columnar=True).batch(4)
+    with pytest.raises(ValueError, match="bind"):
+      tmpl.batches()
+
+  def test_bind_requires_pending_source(self, hub):
+    feed = DataFeed(hub, input_mapping={"c0": "x", "c1": "y"},
+                    pipeline_depth=0)
+    with pytest.raises(ValueError, match="pipeline"):
+      Dataset.from_chunks([]).bind(feed)
+
+  ROWS = 38   # 7 full 5-row chunks + a 3-row tail; EndPartition mid-way
+
+  def _rows(self):
+    return [(np.random.RandomState(i).rand(4).astype("float32"), i)
+            for i in range(self.ROWS)]
+
+  def _fill_raw(self, q, chunks):
+    for i, c in enumerate(chunks):
+      put_rows_chunk(q, c, timeout=5)
+      if i == 3:
+        q.put(EndPartition())
+    q.put(None)
+
+  def _fill_pushed(self, q, chunks, segment):
+    from tensorflowonspark_tpu import node
+    run = segment.compile()
+    for i, c in enumerate(chunks):
+      node._flush_chunk(q, c, run, None, 5)
+      if i == 3:
+        q.put(EndPartition())
+    q.put(None)
+
+  def _batches(self, ds):
+    out = []
+    for b in ds.batches():
+      out.append({k: np.asarray(v) for k, v in b.items()})
+    return out
+
+  def _assert_parity(self, ref, got):
+    assert len(ref) == len(got)
+    for a, b in zip(ref, got):
+      assert set(a) == set(b)
+      for k in a:
+        assert a[k].dtype == b[k].dtype
+        np.testing.assert_array_equal(a[k], b[k])
+
+  @pytest.mark.parametrize("train_mode", [True, False])
+  def test_pushdown_parity_queue_transport(self, hub, train_mode):
+    rows = self._rows()
+    chunks = [rows[i:i + 5] for i in range(0, len(rows), 5)]
+    self._fill_raw(hub.get_queue("input"), chunks)
+    feed = DataFeed(hub, input_mapping={"c0": "x", "c1": "y"},
+                    pipeline_depth=0, train_mode=train_mode)
+    ref = self._batches(self._graph(Dataset.from_feed(feed)).batch(8))
+
+    h2 = feedhub.start(b"k", ["input", "output", "error"], mode="local")
+    try:
+      tmpl = self._graph(Dataset.pipeline()).batch(8)
+      seg, rest = tmpl.split_pushdown()
+      assert seg is not None
+      self._fill_pushed(h2.get_queue("input"), chunks, seg)
+      feed2 = DataFeed(h2, input_mapping={"c0": "x", "c1": "y"},
+                       pipeline_depth=0, train_mode=train_mode)
+      got = self._batches(rest.bind(feed2))
+    finally:
+      h2.shutdown()
+    self._assert_parity(ref, got)
+
+  def test_pushdown_parity_shm_ring_transport(self, hub):
+    import uuid
+    from tensorflowonspark_tpu.control import shmring
+    rows = self._rows()
+    chunks = [rows[i:i + 5] for i in range(0, len(rows), 5)]
+    self._fill_raw(hub.get_queue("input"), chunks)
+    feed = DataFeed(hub, input_mapping={"c0": "x", "c1": "y"},
+                    pipeline_depth=0)
+    ref = self._batches(self._graph(Dataset.from_feed(feed)).batch(8))
+
+    h2 = feedhub.start(b"k", ["input", "output", "error"], mode="local")
+    name = "tos_pd_%s" % uuid.uuid4().hex[:8]
+    try:
+      with shmring.ShmRing.create(name, capacity=1 << 20) as ring:
+        h2.set("ring_name", name)
+        from tensorflowonspark_tpu import node
+        prod = node.input_channel(h2)   # resolves the advertised ring
+        assert isinstance(prod, shmring.RingQueueAdapter)
+        tmpl = self._graph(Dataset.pipeline()).batch(8)
+        seg, rest = tmpl.split_pushdown()
+        self._fill_pushed(prod, chunks, seg)
+        feed2 = DataFeed(h2, input_mapping={"c0": "x", "c1": "y"},
+                         pipeline_depth=0)
+        got = self._batches(rest.bind(feed2))
+        del ring
+    finally:
+      h2.shutdown()
+    self._assert_parity(ref, got)

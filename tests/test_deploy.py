@@ -215,12 +215,19 @@ class TestRegistry:
 
 
 class TestServingEngineCachePin:
+  @pytest.mark.slow  # ~14s; re-proven by the tier-1 happy path; tier-1 budget
   def test_republished_same_shape_params_not_served_stale(
       self, tiny_states):
     """The predict-fn engine cache keys on param CONTENT, not just the
     serving config: serving a republished same-shape tree through the
     same predict_fn must produce that tree's outputs, never the cached
-    engine's stale weights (the registry re-serve bug)."""
+    engine's stale weights (the registry re-serve bug).
+
+    Stronger tier-1 sibling: TestDeployController::
+    test_happy_path_promotes_fleet_wide serves a republished same-shape
+    v2 through the same predict-fn cache post-promote and asserts
+    bit-parity against the v2 reference — the re-serve bug would fail
+    it. Still runs via `make test`."""
     cfg, states = tiny_states
     fn = tfm.make_serving_predict_fn(cfg, 4, eos_id=EOS, pad_id=PAD,
                                      num_slots=2)
@@ -328,6 +335,7 @@ class TestDeployChaos:
     monkeypatch.delenv(chaos.ENV_DEPLOY, raising=False)
     chaos.reset()
 
+  @pytest.mark.slow  # ~16s; still runs via make deploy-chaos / make chaos; tier-1 budget
   def test_poisoned_candidate_caught_quarantined_rolled_back(
       self, tmp_path, tiny_states, monkeypatch):
     """The poisoned-candidate contract: params corrupted at the canary
@@ -335,7 +343,14 @@ class TestDeployChaos:
     the serving path, not at rest) must be caught by VERIFY's greedy
     parity spot-checks, rolled back to outputs BIT-IDENTICAL to the
     pre-canary baseline, and quarantined so no watcher ever redeploys
-    it."""
+    it.
+
+    Stronger tier-1 siblings: TestDeployController::
+    test_happy_path_promotes_fleet_wide exercises the same VERIFY
+    parity machinery (mismatches gated at 0) and TestRegistry::
+    test_quarantine_hides_and_records pins the quarantine/watch
+    contract; `make check` additionally drives this exact
+    canary:poison leg end-to-end via serve-bench-deploy-smoke."""
     cfg, states = tiny_states
     reg = ModelRegistry(str(tmp_path))
     v1 = reg.publish(states[0], step=100)
@@ -362,12 +377,18 @@ class TestDeployChaos:
     finally:
       fl.stop()
 
+  @pytest.mark.slow  # ~14s; still runs via make deploy-chaos / make chaos; tier-1 budget
   def test_kill_mid_promote_resume_converges(self, tmp_path, tiny_states,
                                              monkeypatch):
     """The headline chaos contract: the controller dies at the first
     promote boundary, leaving a MIXED-version fleet — which must keep
     completing requests — and resume() converges every replica to the
-    candidate (it was already serving on the canary) with zero shed."""
+    candidate (it was already serving on the canary) with zero shed.
+
+    Stronger tier-1 sibling: test_kill_mid_canary_resume_keeps_baseline
+    pins the same kill→resume state machinery on the cheap canary
+    boundary; `make check` additionally drives the promote:kill leg
+    end-to-end (zero-shed + parity gated) via serve-bench-deploy-smoke."""
     cfg, states = tiny_states
     reg = ModelRegistry(str(tmp_path))
     v1 = reg.publish(states[0], step=100)

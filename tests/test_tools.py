@@ -459,6 +459,48 @@ class TestFeedBenchGraphSmoke:
       assert stages[name]["busy_s"] >= 0.0
 
 
+class TestFeedBenchWireSmoke:
+  def test_smoke_holds_batch_parity_across_wire_legs(self):
+    """`feed_bench --wire --smoke` drives the REAL wire plane on CPU:
+    four paired queue-transport legs (raw baseline, feeder-side
+    pushdown, per-column wire encodings, adaptive envelope budget) plus
+    the incompressible probe-cost pair. The smoke shape gates the
+    bit-identical-batch contract (every leg's per-batch hashes match)
+    and that the heuristic declines float noise — the >=2x bytes/row
+    and >=1.2x rows/s numbers are shape questions the full
+    `make feed-bench-wire` run answers."""
+    import json
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "feed_bench.py"),
+         "--wire", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-3000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "feed_wire_rows_per_sec"
+    assert result["batch_parity"] is True
+    rep = result["reps"][0]
+    # pushdown delivered fewer wire rows than the raw baseline (the
+    # filter ran feeder-side) at fewer bytes per source row
+    assert rep["pushdown"]["wire_rows"] < rep["baseline"]["wire_rows"]
+    assert rep["pushdown"]["bytes_per_row"] < rep["baseline"][
+        "bytes_per_row"]
+    # the codec actually engaged on the compressible workload...
+    assert any(k != "raw" and v for k, v in rep["compress"]["enc"].items())
+    assert rep["compress"]["bytes_per_row"] < rep["pushdown"][
+        "bytes_per_row"]
+    # ...and declined the incompressible float column (zlib never fires)
+    assert rep["incompressible"]["float_column_stayed_raw"] is True
+    for leg in ("baseline", "pushdown", "compress", "adaptive"):
+      assert result["legs"][leg]["rows_per_sec"] > 0
+
+
 class TestObsTopSmoke:
   @pytest.mark.slow  # make check runs obs-top-smoke directly; tier-1 budget
   def test_smoke_monitors_live_cluster_through_health_wire(self, tmp_path):

@@ -53,7 +53,7 @@ def run_analysis(paths: List[str], baseline_path: Optional[str] = None,
   ``paths``: roots to parse (the whole set feeds the call graph, so
   reachability is computed repo-wide even with ``only_files``).
   ``only_files``: restrict REPORTED findings to these files. A contract
-  rule (TOS011–TOS013) whose scope intersects the slice reports ALL its
+  rule (TOS011–TOS014) whose scope intersects the slice reports ALL its
   findings — its producers and consumers live in different files.
   ``sources``: pre-loaded {path: source} (tests inject fixtures here;
   ``.md`` entries and obs_top-style readers feed the contract passes).

@@ -18,7 +18,7 @@ and reused:
   the file's executor-reachable functions, so an edit elsewhere that
   flips reachability here invalidates exactly this file — the
   "invalidated transitively through the call graph" contract.
-- **contracts** (TOS011–TOS013) and the env registry (TOS008) are
+- **contracts** (TOS011–TOS014) and the env registry (TOS008) are
   cross-file by definition and recomputed on any partial run.
 - the **style pass** caches per file on content digest alone.
 

@@ -1,4 +1,4 @@
-"""Cross-plane contract rule passes (the TOS011–TOS013 family).
+"""Cross-plane contract rule passes (the TOS011–TOS014 family).
 
 Unlike the per-function rules, each of these checks a *pair of surfaces*
 that must agree, so a change to any file on either side re-evaluates the
@@ -24,6 +24,12 @@ TOS013 — chaos-point coverage.  Every ``TOS_CHAOS_*`` knob registered in
 ``_KNOWN_ENV`` must be validated by ``check_config`` AND consulted by at
 least one live injection hook, and every hook's knob must be registered
 — a typo'd knob is a silent no-op (the class PR 3 fixed once by hand).
+
+TOS014 — wire-encoding registry parity.  Every ``_ENCODERS`` key must
+have a ``_DECODERS`` arm in the same module — an encoder without its
+decoder ships chunks the consumer cannot read, and the hole only shows
+up at decode time on a live feed (the chunkcodec per-column encodings
+are the motivating surface).
 """
 
 import ast
@@ -36,7 +42,7 @@ from tools.analyze.engine import RepoModel
 from tools.analyze.rules import Finding
 
 #: bumped when a rule's logic changes; the incremental cache keys on it
-RULE_VERSIONS = {"TOS011": 1, "TOS012": 1, "TOS013": 1}
+RULE_VERSIONS = {"TOS011": 1, "TOS012": 1, "TOS013": 1, "TOS014": 1}
 
 # the metric catalogue + consumers living outside the analyzed package;
 # read from disk when present so the contract sees the whole surface
@@ -453,6 +459,45 @@ def check_tos013(model: RepoModel):
             "TOS013)" % (fn_name, env_values[const]))
 
 
+# -- TOS014: wire-encoding registry parity -----------------------------------
+
+_CODEC_REGISTRIES = ("_ENCODERS", "_DECODERS")
+
+
+def _codec_registries(mod):
+  """{registry name: (node, {string keys})} for codec dict-literal assigns."""
+  out: Dict[str, Tuple[ast.Assign, Set[str]]] = {}
+  for node in mod.tree.body:
+    if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+        and isinstance(node.targets[0], ast.Name) \
+        and node.targets[0].id in _CODEC_REGISTRIES \
+        and isinstance(node.value, ast.Dict):
+      keys = set()
+      for k in node.value.keys:
+        s = _str_const(k)
+        if s is not None:
+          keys.add(s)
+      out[node.targets[0].id] = (node, keys)
+  return out
+
+
+def check_tos014(model: RepoModel):
+  for mod in sorted(model.modules.values(), key=lambda m: m.path):
+    regs = _codec_registries(mod)
+    if "_ENCODERS" not in regs:
+      continue
+    enc_node, enc_keys = regs["_ENCODERS"]
+    _dec_node, dec_keys = regs.get("_DECODERS", (None, set()))
+    for name in sorted(enc_keys - dec_keys):
+      yield Finding(
+          "TOS014", mod.path, enc_node.lineno, "<module>",
+          "encoding:%s:no-decoder" % name,
+          "wire encoding %r is registered in _ENCODERS but has no "
+          "_DECODERS arm — chunks encoded with it cannot be decoded by "
+          "the consumer and fail only at read time on a live feed (see "
+          "docs/ANALYSIS.md TOS014)" % name)
+
+
 # -- driver ------------------------------------------------------------------
 
 def _load_aux(aux_sources: Optional[Dict[str, str]]):
@@ -495,7 +540,7 @@ def run_contracts(model: RepoModel,
 
   findings: List[Finding] = []
   scopes: Dict[str, Set[str]] = {"TOS011": set(), "TOS012": set(),
-                                 "TOS013": set()}
+                                 "TOS013": set(), "TOS014": set()}
 
   producers = _collect_producers(trees)
   c_exact, c_prefix, c_pattern = _collect_consumers(trees, aux_trees)
@@ -520,4 +565,9 @@ def run_contracts(model: RepoModel,
           and node.targets[0].id == "_KNOWN_ENV":
         scopes["TOS013"].add(mod.path)
   findings.extend(check_tos013(model))
+
+  for mod in model.modules.values():
+    if _codec_registries(mod):
+      scopes["TOS014"].add(mod.path)
+  findings.extend(check_tos014(model))
   return findings, scopes

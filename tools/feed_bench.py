@@ -121,6 +121,10 @@ def feeder_main(addr_str, total_rows, chunk, mode):
     except Exception:  # noqa: BLE001 - ring unavailable: queue fallback
       pass
 
+  if mode in ("wire", "wire_push"):
+    _wire_feeder(hub, chan, total_rows, chunk, push=(mode == "wire_push"))
+    return
+
   rng = np.random.RandomState(0)
   image = rng.rand(28 * 28).astype("float32")
   full = [(image, int(i % 10)) for i in range(chunk)]
@@ -754,6 +758,408 @@ def graph_main(args):
   return 0
 
 
+# --- the --wire mode: feed-plane wire efficiency -----------------------------
+#
+# The PR-19 question: with the same lazy Dataset graph, how much wire and
+# consumer work do (a) feeder-side pushdown, (b) per-column wire
+# encodings, and (c) the adaptive byte budget each remove — WITHOUT
+# changing a single delivered batch? Four paired legs over the queue
+# transport (the transport where every byte crosses the hub manager, so
+# wire bytes are the cost being priced):
+#
+#   baseline   raw chunks, consumer-side filter+map       (the status quo)
+#   pushdown   filter+map run feeder-side, raw wire
+#   compress   pushdown + per-column encodings (dict/delta/bitpack/zlib)
+#   adaptive   compress + TOS_FEED_TARGET_BYTES envelope byte budget
+#
+# Every leg hashes every delivered batch (values + dtypes + shapes); the
+# four hash lists must be IDENTICAL — the wire plane moves computation
+# and re-encodes bytes, it never reorders or perturbs a batch. A fifth
+# paired leg feeds INCOMPRESSIBLE float noise with encodings on vs off:
+# the sampled heuristic must decline every column, pricing the probe
+# itself (gate: <= 2% median rows/s regression).
+#
+# Row shape: px int32 (784,) in [0,256) (dict-able), label int64 in
+# [0,10) (dict-able), rid int64 = the global row index (monotone:
+# delta-able). Row content is a pure function of rid, so the adaptive
+# leg's different chunk boundaries cannot change the data.
+
+
+def _wire_filter(x, y, r):
+  return (y % 4) != 0
+
+
+def _wire_map(x, y, r):
+  # stays int32 with 16 distinct values: the mapped column is still
+  # dict-able, so the compress leg prices the codec on REAL mapped
+  # output, not on the raw source rows
+  return (x[:, :196] % 16).astype("int32"), y, r
+
+
+def _wire_graph(src):
+  return (src.filter(_wire_filter, columnar=True)
+          .map(_wire_map, columnar=True))
+
+
+def _wire_rows(start, n, data):
+  """Rows [start, start+n) as (px, label, rid) tuples — content is a
+  pure function of the global row index (chunk-boundary independent)."""
+  import numpy as np
+  idx = np.arange(start, start + n, dtype=np.int64)
+  if data == "rand":
+    # incompressible: uniform float32 noise (random mantissas — the zlib
+    # probe must decline). Per-chunk seeding is fine here: the
+    # incompressible legs never resize chunks.
+    px = np.random.RandomState(start + 1).rand(n, 784).astype("float32")
+  else:
+    cols = np.arange(784, dtype=np.int64)
+    px = ((idx[:, None] * 2654435761 + cols[None, :] * 40503
+           + (idx[:, None] % 97) * (cols[None, :] % 89)) % 256)
+    # source records are WIDER than the training projection (the graph's
+    # map keeps px[:, :196]): tiling the base block out to 3136 features
+    # prices what pushdown actually saves — the baseline must ship every
+    # column of every row, dropped or not, to the consumer
+    px = np.tile(px.astype("int32"), (1, 4))
+  return [(px[i], int(idx[i] % 10), int(idx[i])) for i in range(n)]
+
+
+def _wire_feeder(hub, chan, total_rows, chunk, push):
+  """Wire-mode feeder body: accumulate source rows, optionally run the
+  pushdown segment, ship via the production ``_flush_chunk`` path, and
+  publish a wire report (bytes/rows/encoding picks from the obs
+  counters) to the hub BEFORE the end-of-feed marker."""
+  from tensorflowonspark_tpu import node
+  from tensorflowonspark_tpu.data.datapipe import Dataset
+
+  reg = obs_metrics.MetricsRegistry()
+  obs_metrics.activate(reg)
+  try:
+    meta = {"feed_segment": None, "feed_target_bytes": None}
+    if push:
+      seg, _rest = _wire_graph(Dataset.pipeline()).split_pushdown()
+      meta["feed_segment"] = seg
+    size, run_segment, sizer = node._feed_plan(meta, chunk)
+    data = os.environ.get("TOS_BENCH_WIRE_DATA", "hash")
+    t0 = time.perf_counter()
+    buf, sent = [], 0
+    while sent < total_rows:
+      n = min(chunk, total_rows - sent)
+      buf.extend(_wire_rows(sent, n, data))
+      sent += n
+      limit = sizer.rows if sizer is not None else size
+      while len(buf) >= limit:
+        node._flush_chunk(chan, buf[:limit], run_segment, sizer, 120)
+        del buf[:limit]
+        limit = sizer.rows if sizer is not None else size
+    if buf:
+      node._flush_chunk(chan, buf, run_segment, sizer, 120)
+    snap = reg.snapshot()
+
+    def _val(name):
+      return (snap.get(name) or {}).get("value", 0)
+
+    report = {
+        "source_rows": total_rows,
+        "wire_bytes": _val("feed.wire_bytes"),
+        "wire_rows": _val("feed.wire_rows"),
+        "enc": {k.split("feed.wire_enc.", 1)[1]: v["value"]
+                for k, v in snap.items()
+                if k.startswith("feed.wire_enc.")},
+        "feeder_wall_s": round(time.perf_counter() - t0, 4),
+    }
+    hub.set("feeder_report", json.dumps(report))
+  finally:
+    obs_metrics.deactivate()
+  chan.put(None)   # AFTER the report: the consumer reads it post-stream
+
+
+def _batch_hash(b):
+  import hashlib
+  import numpy as np
+  h = hashlib.sha1()
+  for k in sorted(b):
+    a = np.ascontiguousarray(b[k])
+    h.update(k.encode())
+    h.update(str(a.dtype).encode())
+    h.update(np.asarray(a.shape, "int64").tobytes())
+    h.update(a.tobytes())
+  return h.hexdigest()
+
+
+def _wire_leg(leg, args, total_rows, data="hash"):
+  """One paired leg; returns rows_per_sec / bytes_per_row / enc picks /
+  per-batch hashes. ``leg``: baseline | pushdown | compress | adaptive |
+  inc_off | inc_on (the inc_* legs skip the consumer graph: they price
+  the encode probe on data it must decline)."""
+  from tensorflowonspark_tpu import node as node_mod
+  from tensorflowonspark_tpu.control import chunkcodec, feedhub
+  from tensorflowonspark_tpu.data.datapipe import Dataset
+  from tensorflowonspark_tpu.datafeed import DataFeed
+
+  env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+  env.pop(chunkcodec.ENV_FEED_WIRE_ENCODINGS, None)   # default: enabled
+  env.pop(node_mod.ENV_FEED_TARGET_BYTES, None)
+  if leg in ("baseline", "pushdown", "inc_off"):
+    env[chunkcodec.ENV_FEED_WIRE_ENCODINGS] = ""      # encodings off
+  if leg == "adaptive":
+    env[node_mod.ENV_FEED_TARGET_BYTES] = str(args.wire_target)
+  env["TOS_BENCH_WIRE_DATA"] = data
+  mode = "wire" if leg in ("baseline", "inc_off", "inc_on") else "wire_push"
+
+  # qmax is in ROWS: the default 1024-row window cannot hold even one
+  # adaptive envelope (a MiB-scale byte budget spans thousands of rows), so
+  # the feeder would ping-pong with the consumer instead of pipelining.
+  # One deeper window, shared by every leg, keeps the comparison fair.
+  hub = feedhub.start(AUTHKEY, ["input", "output", "error", "control"],
+                      mode="remote", qmax=8192)
+  try:
+    os.sched_setaffinity(hub._manager._process.pid,
+                         {1 % (os.cpu_count() or 1)})
+  except (AttributeError, OSError):
+    pass
+  try:
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--feeder",
+         "%s:%d" % hub.addr, str(total_rows), str(args.chunk), mode],
+        env=env)
+    try:
+      feed = DataFeed(hub, train_mode=True,
+                      input_mapping={"c0": "x", "c1": "y", "c2": "r"},
+                      pipeline_depth=0)
+      if leg == "baseline":
+        ds = _wire_graph(Dataset.from_feed(feed)).batch(args.batch)
+      elif leg in ("inc_off", "inc_on"):
+        ds = Dataset.from_feed(feed).batch(args.batch)
+      else:
+        tmpl = _wire_graph(Dataset.pipeline()).batch(args.batch)
+        _seg, rest = tmpl.split_pushdown()
+        ds = rest.bind(feed)
+      hashes, rows, t0 = [], 0, None
+      for b in ds.batches():
+        hashes.append(_batch_hash(b))
+        if t0 is None:
+          t0 = time.perf_counter()   # clock from the FIRST batch: the
+          continue                   # feeder's startup import is not wire
+        rows += len(next(iter(b.values())))
+      dt = time.perf_counter() - t0 if t0 is not None else 0.0
+      report = json.loads(hub.get("feeder_report") or "{}")
+      return {
+          "rows_per_sec": rows / dt if dt > 0 else None,
+          "bytes_per_row": (report.get("wire_bytes", 0)
+                            / max(1, report.get("source_rows", 1))),
+          "wire_bytes": report.get("wire_bytes", 0),
+          "wire_rows": report.get("wire_rows", 0),
+          "enc": report.get("enc", {}),
+          "feeder_wall_s": report.get("feeder_wall_s"),
+          "batches": len(hashes),
+          "hashes": hashes,
+      }
+    finally:
+      proc.terminate()
+      proc.wait(timeout=10)
+  finally:
+    hub.shutdown()
+
+
+def _probe_cost_pct(args):
+  """Host cost of the declined encode probe on incompressible data.
+
+  The wire path is byte-identical with encodings on or off (every pick
+  stays raw — the stream pair proves that with hashes), so the ONLY cost
+  the registry adds is the encode-side heuristic. Under probe backoff
+  that cost is far below wall-clock A/B resolution on a shared box, so
+  it is priced as a product of robust parts instead: (exact count of
+  encoder probe calls across a backoff-steady chunk window) x (tight-loop
+  unit cost per encoder) / (measured cost of the same window with
+  encodings off). The count is deterministic; jitter only touches the
+  two unit timings, where it scales an already-sub-percent number."""
+  import numpy as np
+  from tensorflowonspark_tpu.control import chunkcodec
+
+  # fully incompressible: EVERY column (array and scalars) is float noise,
+  # so every probe declines and the per-column backoff reaches steady state
+  chunks = []
+  for s in range(64):
+    rs = np.random.RandomState(s + 1)
+    px = rs.rand(args.chunk, 784).astype("float32")
+    lab, rid = rs.rand(args.chunk), rs.rand(args.chunk)
+    chunks.append([(px[i], float(lab[i]), float(rid[i]))
+                   for i in range(args.chunk)])
+
+  def window(spec):
+    os.environ[chunkcodec.ENV_FEED_WIRE_ENCODINGS] = spec
+    t0 = time.process_time()
+    for rows in chunks:
+      chunkcodec.decode_columns(chunkcodec.encode(rows))
+    return time.process_time() - t0
+
+  prev = os.environ.get(chunkcodec.ENV_FEED_WIRE_ENCODINGS)
+  orig = dict(chunkcodec._ENCODERS)
+  counts: dict = {}
+
+  def _counted(name, fn):
+    def probed(arr, raw):
+      counts[name] = counts.get(name, 0) + 1
+      return fn(arr, raw)
+    return probed
+
+  try:
+    # 1) exact steady-state probe count: warm one window (backoff ramps),
+    #    then count encoder calls over a second, steady window
+    chunkcodec._probe_backoff.clear()
+    for name, fn in orig.items():
+      chunkcodec._ENCODERS[name] = _counted(name, fn)
+    window(chunkcodec.DEFAULT_WIRE_ENCODINGS)
+    counts.clear()
+    window(chunkcodec.DEFAULT_WIRE_ENCODINGS)
+    chunkcodec._ENCODERS.update(orig)
+
+    # 2) unit cost per declining probe, on the big column (conservative
+    #    for the scalar columns: the zlib probe slice is size-capped)
+    px_arr = np.stack([r[0] for r in chunks[0]])
+    raw = px_arr.tobytes()
+    unit = {}
+    for name, fn in orig.items():
+      best = None
+      for _ in range(3):
+        t0 = time.process_time()
+        for _ in range(200):
+          fn(px_arr, raw)
+        dt = (time.process_time() - t0) / 200
+        best = dt if best is None else min(best, dt)
+      unit[name] = best
+
+    # 3) the same window with encodings off, the cost being regressed
+    t_off = _median([window("") for _ in range(5)])
+    probe_s = sum(counts.get(n, 0) * unit[n] for n in orig)
+    return 100.0 * probe_s / t_off if t_off > 0 else 0.0
+  finally:
+    chunkcodec._ENCODERS.update(orig)
+    if prev is None:
+      os.environ.pop(chunkcodec.ENV_FEED_WIRE_ENCODINGS, None)
+    else:
+      os.environ[chunkcodec.ENV_FEED_WIRE_ENCODINGS] = prev
+
+
+def wire_main(args):
+  """``--wire``: paired pushdown/compression/adaptive legs + the
+  incompressible probe-cost pair."""
+  _pin_to_core(0)
+  legs = ("baseline", "pushdown", "compress", "adaptive")
+  # a short tail past the chunk-aligned span: the end-of-feed flush (and
+  # under adaptive sizing, a non-budget-sized final envelope) is
+  # exercised inside the measured, hashed stream
+  tail = 3 * args.batch + max(1, args.batch // 4)
+  total_rows = args.steps * args.batch + tail
+  # the inc stream pair is a PARITY check (encodings on/off must deliver
+  # identical batches and decline float noise); its host cost is priced
+  # separately by _probe_cost_pct
+  inc_rows = max(args.batch * 4, total_rows // 4)
+
+  reps, parity = [], True
+  ovh_pcts = []
+  for _ in range(max(1, args.reps)):
+    rep, ref_hashes = {}, None
+    for leg in legs:
+      r = _wire_leg(leg, args, total_rows)
+      if ref_hashes is None:
+        ref_hashes = r["hashes"]
+      else:
+        parity = parity and (r["hashes"] == ref_hashes)
+      rep[leg] = {k: v for k, v in r.items() if k != "hashes"}
+    off = _wire_leg("inc_off", args, inc_rows, data="rand")
+    on = _wire_leg("inc_on", args, inc_rows, data="rand")
+    parity = parity and (off["hashes"] == on["hashes"])
+    # the heuristic must DECLINE incompressible float noise: the px column
+    # (float32, the only zlib candidate — dict/delta/bitpack exclude
+    # floats outright) must never pick zlib; the tiny int lab/rid columns
+    # legitimately dict/delta-encode regardless of px entropy
+    inc_clean = not on["enc"].get("zlib", 0)
+    ovh_pcts.append(_probe_cost_pct(args))
+    rep["incompressible"] = {
+        "off_rows_per_sec": round(off["rows_per_sec"] or 0, 1),
+        "on_rows_per_sec": round(on["rows_per_sec"] or 0, 1),
+        "float_column_stayed_raw": inc_clean,
+        "enc_on": on["enc"],
+        "probe_cost_pct": round(ovh_pcts[-1], 2),
+    }
+    parity = parity and inc_clean
+    reps.append(rep)
+
+  def _med(leg, key):
+    vals = [r[leg][key] for r in reps if r[leg].get(key)]
+    return _median(vals) if vals else None
+
+  base_bpr = _med("baseline", "bytes_per_row")
+  comp_bpr = _med("compress", "bytes_per_row")
+  base_rps = _med("baseline", "rows_per_sec")
+  adapt_rps = _med("adaptive", "rows_per_sec")
+  reduction = (base_bpr / comp_bpr) if base_bpr and comp_bpr else None
+  speedup = (adapt_rps / base_rps) if base_rps and adapt_rps else None
+  ovh = _median(ovh_pcts) if ovh_pcts else None
+
+  result = {
+      "metric": "feed_wire_rows_per_sec",
+      "legs": {leg: {
+          "rows_per_sec": round(_med(leg, "rows_per_sec") or 0, 1),
+          "bytes_per_row": round(_med(leg, "bytes_per_row") or 0, 1),
+          "enc": reps[0][leg]["enc"],
+      } for leg in legs},
+      "bytes_per_row_reduction": round(reduction, 2) if reduction else None,
+      "delivered_speedup": round(speedup, 3) if speedup else None,
+      "incompressible_overhead_pct": round(ovh, 2) if ovh is not None
+      else None,
+      "batch_parity": parity,
+      "reps": reps,
+      "config": {"steps": args.steps, "batch": args.batch,
+                 "chunk": args.chunk, "reps": args.reps,
+                 "tail_rows": tail, "total_rows": total_rows,
+                 "wire_target_bytes": args.wire_target,
+                 "smoke": bool(args.smoke)},
+      "note": "paired queue-transport legs over one lazy graph "
+              "(filter+map+batch): baseline = raw chunks + consumer-side "
+              "ops; pushdown = ops run feeder-side; compress = pushdown "
+              "+ per-column wire encodings; adaptive = compress + "
+              "TOS_FEED_TARGET_BYTES envelope budget. bytes_per_row is "
+              "wire bytes per SOURCE row (feeder obs counters); "
+              "rows_per_sec is delivered batch rows after the first "
+              "batch. Every delivered batch is hashed (values + dtypes "
+              "+ shapes) and all legs must match bit-for-bit. The "
+              "incompressible pair feeds float noise with encodings "
+              "on/off: the float column must stay raw and the streams "
+              "must hash identically; the declined probe's host cost is "
+              "priced in-process as exact backoff-steady probe counts x "
+              "tight-loop unit costs over the measured cost of the same "
+              "window with encodings off, and must stay <= 2%.",
+  }
+  line = json.dumps(result)
+  print(line)
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    if adapt_rps:
+      bench_history.append_record(
+          "feed_bench_wire", adapt_rps,
+          "wire-b%d-s%d-c%d-t%d" % (args.batch, args.steps, args.chunk,
+                                    args.wire_target),
+          extra={"bytes_per_row_reduction": result[
+                     "bytes_per_row_reduction"],
+                 "delivered_speedup": result["delivered_speedup"],
+                 "overhead_pct": result["incompressible_overhead_pct"],
+                 "obs": int(obs_metrics.enabled())})
+  ok = parity
+  if not args.smoke:
+    ok = ok and (reduction or 0) >= 2.0 and (speedup or 0) >= 1.2 \
+        and (ovh is None or ovh <= 2.0)
+  if not ok:
+    sys.stderr.write("feed_bench --wire GATES FAILED: parity=%s "
+                     "reduction=%s speedup=%s overhead=%s%%\n"
+                     % (parity, reduction, speedup, ovh))
+    return 1
+  return 0
+
+
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument("--steps", type=int, default=60)
@@ -769,6 +1175,16 @@ def main():
                   help="paired fixed-depth prefetcher vs autotuned "
                        "datapipe graph on the skewed hot-stage-rotating "
                        "workload (fused train loop consumer)")
+  ap.add_argument("--wire", action="store_true",
+                  help="paired wire-efficiency legs: pushdown, "
+                       "per-column wire encodings, adaptive envelope "
+                       "budget (queue transport, batch-parity gated)")
+  ap.add_argument("--wire-target", type=int, default=1 << 18,
+                  help="--wire: adaptive leg's TOS_FEED_TARGET_BYTES "
+                       "(256 KiB: deep enough to cut envelope count ~10x "
+                       "on the compressed stream, small enough to keep "
+                       "several envelopes in flight inside the queue's "
+                       "backpressure window)")
   ap.add_argument("--unroll", type=int, default=8,
                   help="--graph: fused train-loop unroll (slab depth)")
   ap.add_argument("--graph-heavy", type=int, default=24,
@@ -787,6 +1203,8 @@ def main():
       args.steps, args.batch, args.chunk, args.reps = 8, 32, 32, 1
   if args.graph:
     sys.exit(graph_main(args))
+  if args.wire:
+    sys.exit(wire_main(args))
   _pin_to_core(0)   # before jax's first use so XLA threads inherit it
   if obs_metrics.enabled():
     # the obs-overhead A/B (BENCH_NOTES) must price the device tier too:
