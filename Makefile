@@ -9,6 +9,7 @@ PY ?= python
 	train-bench-smoke dryrun mosaic-gate validate clean chaos chaos-serve \
 	serve-bench-chaos serve-bench-prefix obs-smoke obs-top-smoke \
 	bench-check fleet-chaos serve-bench-fleet serve-bench-fleet-smoke \
+	serve-bench-fleet-xhost serve-bench-fleet-xhost-smoke \
 	feed-bench-graph feed-bench-graph-smoke feed-bench-wire \
 	feed-bench-wire-smoke slo-smoke elastic-chaos \
 	train-bench-groups train-bench-groups-smoke deploy-chaos \
@@ -128,12 +129,14 @@ train-bench-groups-smoke:
 
 # fast pre-commit gate: static analysis + style + the fast test subset +
 # the obs plumbing smokes + the train-loop fusion smoke + the serving
-# fleet (replica-kill chaos suite + router/zero-shed-swap bench smoke) +
+# fleet (replica-kill chaos suite + router/zero-shed-swap bench smoke +
+# the cross-host plane smoke over real executor processes) +
 # the datapipe graph smoke (bit-parity through the autotuned executor) +
 # the elastic-training plane (group-kill chaos suite + groups bench smoke)
 # (`--changed` variant for iteration: `python -m tools.analyze --changed`)
 check: analyze obs-smoke obs-top-smoke slo-smoke train-bench-smoke \
-	fleet-chaos serve-bench-fleet-smoke feed-bench-graph-smoke \
+	fleet-chaos serve-bench-fleet-smoke serve-bench-fleet-xhost-smoke \
+	feed-bench-graph-smoke \
 	feed-bench-wire-smoke \
 	elastic-chaos train-bench-groups-smoke deploy-chaos \
 	serve-bench-deploy-smoke
@@ -155,11 +158,14 @@ chaos:
 chaos-serve:
 	$(PY) -m pytest tests/test_serving.py -q -m chaos
 
-# fleet fault injection only (TOS_CHAOS_FLEET): replica kill mid-decode,
-# ejection, cross-replica failover replay bit-parity, stream dedup
-# across the replica hop — docs/ROBUSTNESS.md §Fleet; tier-1 (not slow)
+# fleet fault injection only (TOS_CHAOS_FLEET + TOS_CHAOS_HOST): replica
+# kill mid-decode, ejection, cross-replica failover replay bit-parity,
+# stream dedup across the replica hop — plus the CROSS-HOST leg
+# (tests/test_remote.py): ServingHost executor killed/partitioned under
+# TOS_CHAOS_HOST, ejection + replay across the process boundary —
+# docs/ROBUSTNESS.md §Fleet, §Cross-host serving; tier-1 (not slow)
 fleet-chaos:
-	$(PY) -m pytest tests/test_fleet.py -q -m chaos
+	$(PY) -m pytest tests/test_fleet.py tests/test_remote.py -q -m chaos
 
 # ServingFleet (N replicas + mid-run rolling swap) vs a single engine on
 # the seeded Zipf workload; parity + zero-shed gated; writes the
@@ -173,6 +179,21 @@ serve-bench-fleet:
 serve-bench-fleet-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  $(PY) tools/serve_bench.py --fleet --smoke
+
+# the SAME fleet over ServingHost EXECUTOR PROCESSES behind the
+# rendezvous wire: paired in-process vs cross-host, a v1→v2 rolling swap
+# across the process boundary, and a TOS_CHAOS_HOST mid-decode kill leg
+# (ejection + bit-identical failover replay + post-kill zero-shed swap);
+# writes the artifact + a serve_bench_fleet_xhost history line
+serve-bench-fleet-xhost:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --fleet --cross-host \
+	  --json-out bench_artifacts/serve_bench_fleet_xhost.json
+
+# cross-host plane plumbing check: tiny hosts, all four gates
+serve-bench-fleet-xhost-smoke:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  $(PY) tools/serve_bench.py --fleet --cross-host --smoke
 
 # continuous-deployment fault injection only (TOS_CHAOS_DEPLOY):
 # controller killed at canary/promote/rollback boundaries + poisoned

@@ -443,6 +443,12 @@ class Server(MessageSocket):
     #: default) answers those verbs with an ERROR reply — the control
     #: plane never requires the training plane to exist.
     self.sync_plane = None
+    #: driver-attached ``serving.remote.ServingHostPlane`` serving the
+    #: SHREG/SHSYNC/SHBYE verbs (cross-host serving: executor-resident
+    #: ServingEngines syncing with driver-side RemoteReplica proxies).
+    #: None (the default) answers those verbs with an ERROR reply — the
+    #: control plane never requires the serving plane to exist.
+    self.serving_plane = None
     #: HEALTH obs/alert enrichment failures (counted, never raised)
     self.health_obs_failures = 0
     self._listener: Optional[socket.socket] = None
@@ -645,6 +651,16 @@ class Server(MessageSocket):
         except Exception as e:  # noqa: BLE001 - reply stays groups-free
           self.health_obs_failures += 1
           logger.warning("sync-plane status for HEALTH failed: %s", e)
+      shplane = self.serving_plane
+      if shplane is not None:
+        # cross-host serving topology (per-host liveness + load): what
+        # wire_health_probe keys replica ejection on and obs_top renders
+        # as host[...] rows — same best-effort contract
+        try:
+          reply["hosts"] = shplane.status()
+        except Exception as e:  # noqa: BLE001 - reply stays hosts-free
+          self.health_obs_failures += 1
+          logger.warning("serving-plane status for HEALTH failed: %s", e)
       self.send(sock, reply)
     elif mtype == "QINFO":
       self.send(sock, {"type": "COUNT",
@@ -691,6 +707,23 @@ class Server(MessageSocket):
           self.send(sock, plane.handle(msg))
         except Exception as e:  # noqa: BLE001 - reported to the caller
           logger.warning("sync plane failed on %s: %s", mtype, e)
+          self.send(sock, {"type": "ERROR", "error": str(e)})
+    elif mtype in ("SHREG", "SHSYNC", "SHBYE"):
+      # cross-host serving: executor-resident ServingHosts register,
+      # sync (events out / commands in) and depart over the rendezvous
+      # plane (ISSUE 20). Delegated to the attached ServingHostPlane
+      # (the sync_plane pattern) so this module stays free of any
+      # serving dependency; a plane bug degrades to an ERROR reply the
+      # host surfaces, never a dead serve loop.
+      shplane = self.serving_plane
+      if shplane is None:
+        self.send(sock, {"type": "ERROR",
+                         "error": "no serving plane attached for %s" % mtype})
+      else:
+        try:
+          self.send(sock, shplane.handle(msg))
+        except Exception as e:  # noqa: BLE001 - reported to the caller
+          logger.warning("serving plane failed on %s: %s", mtype, e)
           self.send(sock, {"type": "ERROR", "error": str(e)})
     elif mtype == "STOP":
       logger.info("rendezvous server received STOP")

@@ -181,6 +181,7 @@ _SAMPLED = ("train.steps", "train.unroll", "feed.batches", "feed.fetch_s",
             "fleet.replicas_total", "fleet.replicas_active",
             "fleet.replicas_draining", "fleet.queue_depth",
             "fleet.occupancy",
+            "serve.hosts_total", "serve.hosts_alive",
             "training.groups_total", "training.groups_active",
             "training.sync_ms",
             "deploy.state", "deploy.version", "deploy.candidate",
@@ -366,6 +367,7 @@ class AnomalyDetector(object):
         new.extend(self._check_serve_crash_loop(eid, dq, span, now))
         new.extend(self._check_kv_pages(eid, dq, span, now))
         new.extend(self._check_fleet(eid, dq, span, now))
+        new.extend(self._check_hosts(eid, dq, span, now))
         new.extend(self._check_groups(eid, dq, span, now))
         new.extend(self._check_deploy(eid, dq, span, now))
         new.extend(self._check_mem_slope(eid, dq, span, now))
@@ -556,6 +558,32 @@ class AnomalyDetector(object):
         "serving fleet on executor %d saturated at full strength: %d "
         "queued request(s) across %d replicas at occupancy %.2f — "
         "scale up: add a replica" % (eid, int(depth), int(active), occ))
+
+  def _check_hosts(self, eid, dq, span, now) -> List[dict]:
+    """``host_lost``: the cross-host serving plane is syncing fewer
+    ServingHosts than it has registered — a host process died, was
+    preempted, or is partitioned past ``TOS_HOST_TIMEOUT``. Distinct
+    from ``fleet_saturated`` on purpose: saturation fires only at FULL
+    strength (every replica alive, goodput-bound — the scale-up
+    signal); a lost host is missing capacity regardless of load (the
+    restore-capacity signal), so this keys purely on the alive/total
+    gap and carries the fleet's load gauges as evidence to make the
+    distinction legible in the alert itself."""
+    latest = dq[-1][1]
+    total = latest.get("serve.hosts_total")
+    alive = latest.get("serve.hosts_alive")
+    if total is None or alive is None or total <= 0 or alive >= total:
+      return []
+    return self._fire(
+        "host_lost", eid, span, now,
+        {"hosts_alive": alive, "hosts_total": total,
+         "fleet_queue_depth": latest.get("fleet.queue_depth") or 0.0,
+         "fleet_occupancy": latest.get("fleet.occupancy") or 0.0},
+        "cross-host serving plane on executor %d syncing %d/%d host(s) "
+        "— a ServingHost died or is partitioned; its replica is being "
+        "ejected and its accepted requests failover-replayed: restore "
+        "the host (this is lost capacity, not saturation)"
+        % (eid, int(alive), int(total)))
 
   def _check_groups(self, eid, dq, span, now) -> List[dict]:
     """The elastic-training pair (``parallel.groups``): ``group_lost``

@@ -32,6 +32,12 @@ Public surface:
   obs/SLO deltas) → PROMOTE or ROLLBACK+quarantine, zero-shed end to
   end, with :meth:`resume` converging the fleet after a controller
   death (docs/ROBUSTNESS.md §Continuous deployment).
+* :class:`~tensorflowonspark_tpu.serving.host.ServingHost` /
+  :class:`~tensorflowonspark_tpu.serving.remote.RemoteReplica` — the
+  cross-host serving plane: executor-resident engines syncing over the
+  rendezvous wire (SHREG/SHSYNC/SHBYE) with driver-side replica
+  proxies, so the SAME fleet routes/ejects/failover-replays/swaps
+  across process boundaries (docs/ROBUSTNESS.md §Cross-host serving).
 
 Decode-speed stack (docs/PERFORMANCE.md §"Paged KV, prefix cache &
 speculative decode"): ``TOS_SERVE_PAGE_SIZE`` pages the KV slab,
@@ -53,6 +59,13 @@ from tensorflowonspark_tpu.serving.deploy import (            # noqa: F401
     ENV_DEPLOY_BAKE, ENV_DEPLOY_POLL, ENV_DEPLOY_SLICE,
     ENV_DEPLOY_SPOT_CHECKS, ENV_DEPLOY_SWAP_TIMEOUT,
     ENV_DEPLOY_TTFT_RATIO, ControllerKilled, DeploymentController)
+from tensorflowonspark_tpu.serving.host import (              # noqa: F401
+    ENV_HOST_BUILD, ENV_HOST_SYNC, ServingHost, build_engine_from_manifest,
+    cfg_wire, make_serving_host_main, run_host_thread, start_host_process)
+from tensorflowonspark_tpu.serving.remote import (            # noqa: F401
+    ENV_HOST_ADMIT, ENV_HOST_CHUNK, ENV_HOST_START, ENV_HOST_TIMEOUT,
+    RemoteReplica, RemoteRequest, ServingHostPlane, attach_serving_plane,
+    remote_engine_factory, wire_health_probe)
 from tensorflowonspark_tpu.serving.fleet import (             # noqa: F401
     ENV_FLEET_ADMIT_TIMEOUT, ENV_FLEET_MAX_FAILOVERS,
     ENV_FLEET_MAX_REPLICAS, ENV_FLEET_POLL, ENV_FLEET_PROBE_FAILS,

@@ -74,6 +74,29 @@ Env vars (all optional; absent ⇒ every hook is a no-op):
     catch: parity fails, the version is quarantined, never promoted);
     ``stall`` sleeps at the boundary (a slow controller hop).
 
+``TOS_CHAOS_HOST`` = ``"point[@host][#nth]:kill"``,
+    ``"...:stall:seconds"`` or ``"...:partition:seconds"`` (comma-sep)
+    Host-granularity fault for the cross-host serving plane
+    (``serving.host`` consults :func:`host_fault` at each ``sync``
+    round with the host id as index — point ``sync`` ticks every
+    round, point ``decode`` only on rounds with requests in flight,
+    so a ``decode`` kill lands mid-decode by construction however
+    long the engine build took): ``kill`` SIGKILLs the whole
+    ServingHost EXECUTOR PROCESS at that boundary — engine, accepted
+    requests, rendezvous client, everything, exactly like a preempted
+    host (the driver-side fleet must eject its RemoteReplica and
+    failover-replay bit-identically; docs/ROBUSTNESS.md §Cross-host
+    serving); ``stall`` sleeps the host's sync loop inline (a slow
+    host; the engine keeps decoding, the wire goes quiet briefly);
+    ``partition`` makes the host skip ALL wire I/O for ``seconds``
+    while the engine keeps decoding — a network partition, not a
+    death: tokens buffer host-side and the driver sees silence, so
+    past ``TOS_HOST_TIMEOUT`` the partition is indistinguishable from
+    host death and MUST be handled identically (ejection + replay).
+    E.g. ``"sync@1#30:kill"`` kills host 1 at its 30th sync round;
+    ``"decode@1#3:kill"`` kills host 1 on its 3rd sync round with
+    live requests — i.e. *kill host N mid-decode*.
+
 ``TOS_CHAOS_GROUP`` = ``"kill[@group][#nth]"`` or
     ``"stall[@group][#nth]:seconds"`` (comma-separated)
     Group-granularity fault for elastic multi-group training
@@ -105,6 +128,7 @@ ENV_SERVE = "TOS_CHAOS_SERVE"
 ENV_FLEET = "TOS_CHAOS_FLEET"
 ENV_GROUP = "TOS_CHAOS_GROUP"
 ENV_DEPLOY = "TOS_CHAOS_DEPLOY"
+ENV_HOST = "TOS_CHAOS_HOST"
 
 
 class InjectedFault(RuntimeError):
@@ -118,7 +142,7 @@ _rv_counts = {}
 _lock = threading.Lock()
 
 _KNOWN_ENV = (ENV_KILL, ENV_STALL, ENV_RV_DROP, ENV_RV_DELAY, ENV_SERVE,
-              ENV_FLEET, ENV_GROUP, ENV_DEPLOY)
+              ENV_FLEET, ENV_GROUP, ENV_DEPLOY, ENV_HOST)
 _ENV_PREFIX = "TOS_CHAOS_"
 #: cache of the last validated env signature (validation is consulted from
 #: hot paths like the rendezvous client's per-request chaos check)
@@ -214,6 +238,13 @@ def check_config() -> None:
       raise ValueError("%s: malformed deploy spec %r (want "
                        "'point[@index][#nth]:kill', '...:poison' or "
                        "'...:stall:seconds')" % (ENV_DEPLOY, spec))
+  for spec in _split_specs(os.environ.get(ENV_HOST)):
+    try:
+      _parse_host_spec(spec)
+    except ValueError:
+      raise ValueError("%s: malformed host spec %r (want "
+                       "'point[@host][#nth]:kill', '...:stall:seconds' or "
+                       "'...:partition:seconds')" % (ENV_HOST, spec))
   _validated = sig
 
 
@@ -331,6 +362,28 @@ def _parse_deploy_spec(spec: str):
       raise ValueError(spec)
     return target, action, None
   if action == "stall":
+    if len(parts) != 3:
+      raise ValueError(spec)
+    return target, action, float(parts[2])
+  raise ValueError(spec)
+
+
+def _parse_host_spec(spec: str):
+  """``"point[@host][#nth]:kill"``, ``"...:stall:seconds"`` or
+  ``"...:partition:seconds"`` → ((name, host, nth), action,
+  secs_or_None). The deploy grammar shape with a timed second hard
+  action: ``partition`` carries a duration (how long the host's wire
+  goes dark) but is NOT an inline stall — the caller keeps decoding."""
+  parts = spec.split(":")
+  if len(parts) < 2 or not parts[0]:
+    raise ValueError(spec)
+  target = _parse_point_spec(parts[0])
+  action = parts[1]
+  if action == "kill":
+    if len(parts) != 2:
+      raise ValueError(spec)
+    return target, action, None
+  if action in ("stall", "partition"):
     if len(parts) != 3:
       raise ValueError(spec)
     return target, action, float(parts[2])
@@ -555,6 +608,54 @@ def deploy_fault(name: str, index: Optional[int] = None) -> Optional[str]:
     logger.warning("chaos: %s verdict at deploy point %r index %r "
                    "(occurrence %d)", action, name, index, nth)
     return action
+  return None
+
+
+def host_fault(name: str, index: Optional[int] = None):
+  """Deterministic serving-host fault site (``serving.host`` consults
+  ``sync`` at each sync-round boundary with the host id as ``index``):
+  returns ``("kill", None)`` when a ``TOS_CHAOS_HOST`` kill spec
+  matches this invocation — the CALLER then SIGKILLs its own process
+  (the whole executor dies the way a preempted host does: no cleanup,
+  the wire just goes silent) — or ``("partition", seconds)``: the
+  caller skips all wire I/O for that long while its engine keeps
+  decoding (a network partition, not a death). Stall specs sleep
+  inline (a slow host) and return None, as does a disarmed/unmatched
+  consult.
+
+  Counters mirror :func:`fleet_fault`: a GLOBAL per-point count (specs
+  without ``@host``) and a per-host one (specs with it: "this host's
+  nth sync round").
+  """
+  _first_consult()
+  spec_env = os.environ.get(ENV_HOST)
+  if not spec_env:
+    return None
+  check_config()
+  point = "host." + name
+  with _lock:
+    gcount = _counts[(point, None)] = _counts.get((point, None), 0) + 1
+    icount = gcount
+    if index is not None:
+      icount = _counts[(point, index)] = \
+          _counts.get((point, index), 0) + 1
+  for spec in _split_specs(spec_env):
+    (sname, sindex, nth), action, secs = _parse_host_spec(spec)
+    if sname != name:
+      continue
+    if sindex is None:
+      if gcount != nth:
+        continue
+    elif sindex != index or icount != nth:
+      continue
+    if action == "stall":
+      logger.warning("chaos: stalling %.2fs at host point %r host %r "
+                     "(occurrence %d)", secs, name, index, nth)
+      time.sleep(secs)
+      continue
+    logger.warning("chaos: %s verdict at host point %r host %r "
+                   "(occurrence %d)", action, name, index, nth)
+    return action, secs
   return None
 
 

@@ -805,6 +805,46 @@ def test_tos012_real_wire_is_complete():
       result["scopes"]["TOS012"]
 
 
+def test_tos012_serving_verbs_ride_the_wire_contract():
+  # the cross-host serving plane extended the wire: SHREG/SHSYNC/SHBYE
+  # are first-class verbs, so a rendezvous server missing a serving
+  # dispatch arm drifts exactly like a missing SYNC
+  from tools.analyze import contracts
+  assert {"SHREG", "SHSYNC", "SHBYE"} <= set(contracts.WIRE_VERBS)
+  arms = "\n".join('    elif mtype == "%s":\n      pass' % v
+                   for v in contracts.WIRE_VERBS if v != "SHSYNC")
+  src = ('class Server(object):\n'
+         '  def _handle(self, sock, msg):\n'
+         '    mtype = msg.get("type")\n'
+         '    if mtype == "NOP":\n'
+         '      pass\n' + arms + '\n')
+  result = analyze_sources({"fixture/control/rendezvous.py": src})
+  details = {f.detail for f in result["findings"] if f.rule == "TOS012"}
+  assert details == {"verb:SHSYNC:no-dispatch-arm"}
+
+
+TOS012_SERVING_CLIENT = '''
+class Client(object):
+  def register_host(self):
+    return self._request({"type": "SHREG", "host_id": 0})
+'''
+
+
+def test_tos012_serving_client_send_is_checked():
+  # a ServingHost-style client sending a serving verb passes only when
+  # the server actually dispatches it
+  server_ok = TOS012_SERVER.replace(
+      'elif mtype in ("SYNC", "SYNCQ"):',
+      'elif mtype in ("SYNC", "SYNCQ", "SHREG", "SHSYNC", "SHBYE"):')
+  result = analyze_sources({"fixture/server.py": server_ok,
+                            "fixture/client.py": TOS012_SERVING_CLIENT})
+  assert "TOS012" not in rules_of(result)
+  bad = analyze_sources({"fixture/server.py": TOS012_SERVER,
+                         "fixture/client.py": TOS012_SERVING_CLIENT})
+  details = [f.detail for f in bad["findings"] if f.rule == "TOS012"]
+  assert details == ["verb:SHREG:unhandled"]
+
+
 # --- TOS013: chaos-point coverage -------------------------------------------
 
 TOS013_GOOD = '''

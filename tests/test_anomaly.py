@@ -488,6 +488,42 @@ class TestFleetDetectors:
     assert [a["alert"] for a in alerts] == ["fleet_degraded"]
 
 
+class TestHostLostDetector:
+  def test_host_lost_fires_when_a_serving_host_stops_syncing(self):
+    """The cross-host serving plane syncing fewer ServingHosts than it
+    registered = an executor host died or is partitioned past
+    TOS_HOST_TIMEOUT — lost capacity (restore the host), distinct from
+    fleet saturation (add a replica)."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__hosts_total=2, serve__hosts_alive=2)
+    det.poll(now=0.0)
+    sink.set(0, serve__hosts_total=2, serve__hosts_alive=1,
+             fleet__queue_depth=4, fleet__occupancy=0.8)
+    alerts = det.poll(now=10.0)
+    assert [a["alert"] for a in alerts] == ["host_lost"]
+    assert alerts[0]["evidence"]["hosts_alive"] == 1
+    assert alerts[0]["evidence"]["hosts_total"] == 2
+    assert "lost capacity" in alerts[0]["message"]
+
+  def test_all_hosts_alive_stays_quiet(self):
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__hosts_total=2, serve__hosts_alive=2)
+    det.poll(now=0.0)
+    sink.set(0, serve__hosts_total=2, serve__hosts_alive=2)
+    assert det.poll(now=10.0) == []
+
+  def test_no_serving_plane_is_exempt(self):
+    """Executors without the plane's gauges never trip the detector."""
+    sink = FakeSink(eids=(0,))
+    det = _detector(sink)
+    sink.set(0, serve__queue_depth=3)
+    det.poll(now=0.0)
+    sink.set(0, serve__queue_depth=3)
+    assert det.poll(now=10.0) == []
+
+
 class TestGroupDetectors:
   def test_group_lost_fires_below_full_strength(self):
     """An elastic GroupSet running fewer active groups than it has ever

@@ -231,6 +231,47 @@ class TestServeBenchFleetSmoke:
     assert result["fleet"]["p99_s"] >= result["fleet"]["p50_s"]
 
 
+class TestServeBenchFleetCrossHostSmoke:
+  @pytest.mark.slow  # make check runs serve-bench-fleet-xhost-smoke directly; tier-1 budget
+  def test_cross_host_smoke_parity_swap_and_host_kill_gates(self):
+    """`serve_bench --fleet --cross-host --smoke` runs the SAME
+    ServingFleet over RemoteReplica proxies whose engines live in
+    spawned ServingHost executor processes (registry-built, behind the
+    rendezvous wire), paired against the in-process leg on the same
+    seeded workload. Gates re-proven here: bit-parity across the
+    process boundary, a zero-shed rolling swap over the wire, and the
+    TOS_CHAOS_HOST leg where a host is SIGKILLed mid-decode — ejection,
+    bit-identical failover replay, then a post-kill zero-shed swap on
+    the survivor."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "serve_bench.py"),
+         "--fleet", "--cross-host", "--smoke"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["metric"] == \
+        "serving_fleet_cross_host_vs_in_process_tokens_per_sec"
+    assert result["parity_ok"] is True
+    assert result["zero_shed"] is True
+    assert result["swap_ok"] is True
+    assert result["chaos_ok"] is True
+    assert result["chaos"]["sigkilled"] is True
+    assert result["chaos"]["ejected"] is True
+    assert result["chaos"]["failovers"] >= 1
+    assert result["chaos"]["shed"] == 0
+    assert result["swap"]["swapped"] == result["workload"]["replicas"]
+    assert result["in_process"]["tok_s"] > 0
+    assert result["cross_host"]["tok_s"] > 0
+
+
 class TestServeBenchDeploySmoke:
   def test_deploy_smoke_chaos_kill_and_poison_gates(self):
     """`serve_bench --deploy --smoke` drives the REAL continuous-deploy

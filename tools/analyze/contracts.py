@@ -42,7 +42,7 @@ from tools.analyze.engine import RepoModel
 from tools.analyze.rules import Finding
 
 #: bumped when a rule's logic changes; the incremental cache keys on it
-RULE_VERSIONS = {"TOS011": 1, "TOS012": 1, "TOS013": 1, "TOS014": 1}
+RULE_VERSIONS = {"TOS011": 1, "TOS012": 2, "TOS013": 1, "TOS014": 1}
 
 # the metric catalogue + consumers living outside the analyzed package;
 # read from disk when present so the contract sees the whole surface
@@ -59,7 +59,8 @@ _METRIC_NAME = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_<>]+)+$")
 # on must have a Server._handle arm (TOS001's blocking-verb set is the
 # transport methods; this is the message vocabulary riding them)
 WIRE_VERBS = ("REG", "BEAT", "OBS", "HEALTH", "QINFO", "QUERY", "LIST",
-              "BARRIER", "BQUERY", "SYNC", "SYNCQ", "GROUP", "STOP")
+              "BARRIER", "BQUERY", "SYNC", "SYNCQ", "GROUP",
+              "SHREG", "SHSYNC", "SHBYE", "STOP")
 _VERB_RE = re.compile(r"^[A-Z][A-Z_]{1,30}$")
 
 _CHAOS_PREFIX = "TOS_CHAOS_"

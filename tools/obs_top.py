@@ -192,6 +192,10 @@ def build_snapshot(reply, prev=None, dt=0.0):
           # the detector): served version, candidate in flight, rollback
           # and parity counters
           "deploy": reply.get("deploy"),
+          # the cross-host serving plane (serving.remote attaches
+          # ServingHostPlane.status() to the HEALTH reply): per-host
+          # liveness, engine generation/version and load
+          "hosts": reply.get("hosts"),
           "has_obs": bool(obs), "has_alert_ring": alerts is not None}
 
 
@@ -230,6 +234,36 @@ def _fmt_deploy(dep):
     if dep.get(key):
       parts.append("%s %d" % (lbl, dep[key]))
   return "deploy[" + " | ".join(parts) + "]"
+
+
+def _fmt_hosts(hosts):
+  """Compact ``host[...]`` lines from the HEALTH-wire serving-plane
+  status (``serving.remote.ServingHostPlane.status``): the alive/total
+  headline plus one row per host — state, engine generation/version,
+  queue depth and throughput — so a ``lost`` row pins which executor
+  the fleet is ejecting and failover-replaying away from."""
+  rows = []
+  ids = sorted(hosts, key=lambda h: int(h))
+  alive = sum(1 for h in ids if hosts[h].get("alive"))
+  rows.append("hosts[%d/%d alive]" % (alive, len(ids)))
+  for hid in ids:
+    st = hosts[hid]
+    parts = [str(st.get("state") or "?")]
+    if st.get("generation"):
+      ver = st.get("version")
+      parts.append("gen %d%s" % (st["generation"],
+                                 " v%d" % ver if ver else ""))
+    if st.get("alive"):
+      parts.append("q %d" % (st.get("queue_depth") or 0))
+      tps = st.get("tokens_per_sec")
+      if tps:
+        parts.append("%.0f tok/s" % tps)
+      if st.get("requests"):
+        parts.append("%d req" % st["requests"])
+    else:
+      parts.append("age %.1fs" % (st.get("age") or 0.0))
+    rows.append("host[%s | %s]" % (hid, " | ".join(parts)))
+  return rows
 
 
 def _fmt_slo(slo):
@@ -363,6 +397,10 @@ def render(snap, clear=True):
   if dep:
     lines.append("")
     lines.append(_fmt_deploy(dep))
+  hosts = snap.get("hosts")
+  if hosts:
+    lines.append("")
+    lines.extend(_fmt_hosts(hosts))
   alerts = snap.get("alerts") or []
   lines.append("")
   if alerts:

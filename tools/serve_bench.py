@@ -37,6 +37,14 @@ p50/p99 latency and GATES the fleet claims: zero accepted requests shed
 through the swap, every output bit-identical to its single-request
 decode, zero cross-replica replay mismatches.
 
+`--fleet --cross-host` runs the SAME fleet over executor-resident
+`ServingHost` processes behind the rendezvous wire (`serving.host` /
+`serving.remote`, docs/ROBUSTNESS.md §Cross-host serving): paired
+in-process vs cross-host passes, a v1→v2 rolling swap ACROSS the
+process boundary (registry-built models), and a chaos leg where
+`TOS_CHAOS_HOST` SIGKILLs one host mid-decode — ejection, bit-identical
+failover replay and a post-kill zero-shed swap are all hard gates.
+
 `--chaos` measures the engine's SELF-HEALING cost (docs/ROBUSTNESS.md):
 the same workload runs paired — one clean pass, one with deterministic
 `TOS_CHAOS_SERVE` faults injected into the decode dispatch — through
@@ -50,6 +58,7 @@ Usage: python tools/serve_bench.py [--batch 8] [--prompt 128] [--steps 128]
        python tools/serve_bench.py --compare [--smoke] [--json-out f.json]
        python tools/serve_bench.py --chaos [--smoke] [--json-out f.json]
        python tools/serve_bench.py --fleet [--smoke] [--json-out f.json]
+       python tools/serve_bench.py --fleet --cross-host [--smoke]
 """
 
 import argparse
@@ -751,6 +760,245 @@ def run_fleet(args):
   return 0 if (parity_ok and zero_shed) else 3
 
 
+# --- cross-host fleet mode (--fleet --cross-host) ---------------------------
+
+#: sync rounds WITH requests in flight before the chaos kill fires on
+#: the target host — the ``decode`` point only ticks while the host
+#: holds live requests, so this lands mid-decode on every machine
+#: whatever the engine build/jit-warm phases cost (utils/chaos.py)
+_XHOST_KILL_NTH = 25
+
+
+def _run_xhost_swap_pass(fleet, workload, factory, version):
+  """Submit the workload, fire a rolling swap ACROSS the process
+  boundary while those requests are in flight (each host drains, frees
+  its reservation, and the replacement proxy rebuilds the commanded
+  registry version on it), then collect. Returns
+  (outs, stats delta, swap report)."""
+  snap = fleet.stats_snapshot()
+  frids = [fleet.submit(p, max_new_tokens=b) for p, b in workload]
+  swap = fleet.rolling_swap(timeout=600.0, engine_factory=factory,
+                            version=version)
+  outs = [fleet.result(fr, timeout=600) for fr in frids]
+  return outs, snap.delta(), swap
+
+
+def run_fleet_xhost(args):
+  """Paired in-process vs CROSS-HOST fleet, then a chaos kill leg.
+
+  Leg L: ServingFleet over in-process engines (the PR 12 baseline).
+  Leg X: the SAME fleet code over RemoteReplica proxies whose engines
+  live in spawned ServingHost executor processes behind the rendezvous
+  wire — parity + a mid-run rolling swap (v1→v2 through the registry,
+  cross-process) gated zero-shed. Leg C: fresh chaos-armed hosts; the
+  first host SIGKILLs itself mid-decode (``TOS_CHAOS_HOST``) — the
+  fleet must eject it, failover-replay bit-identically, and a
+  subsequent rolling swap across the process boundary must shed zero.
+  """
+  import tempfile
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from tensorflowonspark_tpu.control import rendezvous
+  from tensorflowonspark_tpu.models import transformer as tfm
+  from tensorflowonspark_tpu.serving import (
+      ModelRegistry, ServingEngine, ServingFleet)
+  from tensorflowonspark_tpu.serving import host as host_mod
+  from tensorflowonspark_tpu.serving import remote as remote_mod
+  from tensorflowonspark_tpu.utils import chaos
+
+  shape = _FLEET_SMOKE if args.smoke else _FLEET_FULL
+  if args.requests:
+    shape = dict(shape, requests=args.requests)
+  if args.replicas:
+    shape = dict(shape, replicas=args.replicas)
+  replicas = shape["replicas"]
+  cfg = tfm.TransformerConfig(
+      vocab_size=shape["vocab"], num_layers=shape["layers"],
+      num_heads=shape["heads"], d_model=shape["d_model"],
+      d_ff=shape["d_ff"], max_seq_len=shape["max_seq"], remat=False,
+      dtype=jnp.float32)   # f32: the bit-parity gates must be exact
+  eos_id = 2
+  state = tfm.create_state(jax.random.PRNGKey(0), cfg, seq_len=16)
+  workload = make_workload(shape, args.seed)
+  useful = _reference_streams(state.params, cfg, workload, eos_id)
+  total_useful = float(sum(len(s) for s in useful))
+  refs = [np.concatenate([p, r]) for (p, _), r in zip(workload, useful)]
+
+  def mismatches(outs):
+    return sum(1 for o, r in zip(outs, refs)
+               if o is None or o.shape != r.shape or not bool((o == r).all()))
+
+  serve_opts = dict(num_slots=shape["slots"], eos_id=eos_id, pad_id=0,
+                    horizon=shape["horizon"])
+  host_timeout = 180.0
+  t0 = time.perf_counter()
+  server = rendezvous.Server(count=1)
+  addr = server.start()
+  plane = remote_mod.attach_serving_plane(server)
+  probe = remote_mod.wire_health_probe(addr)
+  procs = []
+  with tempfile.TemporaryDirectory(prefix="tos-xhost-registry-") as root:
+    reg = ModelRegistry(root)
+    # v2 republishes the SAME params at a later step: the swap leg must
+    # be output-invariant, so parity stays the one gate for everything
+    extra = {"model_cfg": host_mod.cfg_wire(cfg), "serve_opts": serve_opts}
+    v1 = reg.publish(state.params, step=100, extra=extra)
+    v2 = reg.publish(state.params, step=200, extra=extra)
+    try:
+      # ---- leg L: in-process fleet (the wire-free baseline) ----------------
+      lfleet = ServingFleet(
+          lambda: ServingEngine(state.params, cfg, **serve_opts),
+          num_replicas=replicas).start()
+      try:
+        if not args.smoke:
+          run_fleet_pass(lfleet, workload)           # warm the jit caches
+        l_wall, _, l_outs, l_delta, _ = run_fleet_pass(lfleet, workload)
+      finally:
+        lfleet.stop()
+
+      # ---- leg X: the same fleet over executor-resident hosts --------------
+      for hid in range(replicas):
+        procs.append(host_mod.start_host_process(addr, hid,
+                                                 registry_root=root))
+      plane.await_hosts(replicas, timeout=host_timeout)
+      xfleet = ServingFleet(
+          remote_mod.remote_engine_factory(plane, version=v1),
+          num_replicas=replicas, health_probe=probe).start()
+      try:
+        for rid in xfleet.replica_states():
+          xfleet.set_replica_version(rid, v1)
+        if not args.smoke:
+          run_fleet_pass(xfleet, workload)
+        x_wall, _, x_outs, x_delta, _ = run_fleet_pass(xfleet, workload)
+        swap_outs, swap_delta, swap = _run_xhost_swap_pass(
+            xfleet, workload,
+            remote_mod.remote_engine_factory(plane, version=v2), v2)
+        swap_versions = set(xfleet.served_versions().values())
+      finally:
+        xfleet.stop()
+      # retire leg-X hosts so leg C's chaos-armed processes are the only
+      # live hosts the plane can hand out
+      for hid in range(replicas):
+        plane.enqueue(hid, {"op": "exit"})
+      for p in procs:
+        p.join(timeout=30)
+
+      # ---- leg C: kill one host mid-decode (TOS_CHAOS_HOST) ----------------
+      kill_target = 100
+      chaos_env = {chaos.ENV_HOST:
+                   "decode@%d#%d:kill" % (kill_target, _XHOST_KILL_NTH)}
+      cprocs = [host_mod.start_host_process(addr, kill_target + i,
+                                            registry_root=root,
+                                            env=chaos_env)
+                for i in range(replicas)]
+      procs.extend(cprocs)
+      plane.await_hosts(replicas, timeout=host_timeout)
+      cfleet = ServingFleet(
+          remote_mod.remote_engine_factory(plane, version=v1),
+          num_replicas=replicas, health_probe=probe).start()
+      try:
+        csnap = cfleet.stats_snapshot()
+        # no warm pass: the kill must land in a pass with real traffic
+        c_frids = [cfleet.submit(p, max_new_tokens=b) for p, b in workload]
+        c_outs = [cfleet.result(fr, timeout=600) for fr in c_frids]
+        c_delta = csnap.delta()
+        cprocs[0].join(timeout=60)
+        killed = cprocs[0].exitcode == -9          # SIGKILL, not a clean exit
+        ejected = "ejected" in cfleet.replica_states().values()
+        # the post-kill rolling swap: survivors drain + rebuild v2 across
+        # the process boundary with requests in flight, shedding nothing
+        postswap_outs, postswap_delta, postswap = _run_xhost_swap_pass(
+            cfleet, workload,
+            remote_mod.remote_engine_factory(plane, version=v2), v2)
+      finally:
+        cfleet.stop()
+    finally:
+      for hid in plane.host_ids():
+        plane.enqueue(hid, {"op": "exit"})
+      for p in procs:
+        p.join(timeout=15)
+        if p.is_alive():
+          p.terminate()
+      server.stop()
+  wall = time.perf_counter() - t0
+
+  parity_ok = (mismatches(l_outs) == 0 and mismatches(x_outs) == 0
+               and mismatches(swap_outs) == 0 and mismatches(c_outs) == 0
+               and mismatches(postswap_outs) == 0)
+  zero_shed = all(int(d.get("shed", 0)) == 0 and
+                  int(d.get("replay_mismatches", 0)) == 0
+                  for d in (l_delta, x_delta, swap_delta, c_delta,
+                            postswap_delta))
+  swap_ok = (swap["swapped"] == replicas
+             and all(r.get("drained") for r in swap["replicas"])
+             and swap_versions == {v2})
+  chaos_ok = (killed and ejected
+              and int(c_delta.get("failovers", 0)) >= 1
+              and int(c_delta.get("ejections", 0)) >= 1
+              and postswap["swapped"] == replicas - 1
+              and all(r.get("drained") for r in postswap["replicas"]
+                      if "drained" in r))
+  ok = parity_ok and zero_shed and swap_ok and chaos_ok
+  result = {
+      "metric": "serving_fleet_cross_host_vs_in_process_tokens_per_sec",
+      "mode": "smoke" if args.smoke else "full",
+      "seed": args.seed, "wall_s": round(wall, 3),
+      "workload": {"requests": shape["requests"], "slots": shape["slots"],
+                   "replicas": replicas,
+                   "useful_tokens": int(total_useful)},
+      "model": {k: shape[k] for k in ("layers", "heads", "d_model",
+                                      "d_ff", "vocab", "max_seq")},
+      "in_process": {"tok_s": round(total_useful / l_wall, 2),
+                     "wall_s": round(l_wall, 3)},
+      "cross_host": {"tok_s": round(total_useful / x_wall, 2),
+                     "wall_s": round(x_wall, 3),
+                     "dispatched": int(x_delta.get("dispatched", 0)),
+                     "retries": int(x_delta.get("retries", 0)),
+                     "plane": dict(plane.stats)},
+      "wire_relative": round((total_useful / x_wall)
+                             / max(1e-9, total_useful / l_wall), 3),
+      "swap": {"swapped": swap["swapped"],
+               "versions_after": sorted(swap_versions),
+               "shed": int(swap_delta.get("shed", 0))},
+      "chaos": {"killed_host": kill_target, "sigkilled": killed,
+                "ejected": ejected,
+                "failovers": int(c_delta.get("failovers", 0)),
+                "ejections": int(c_delta.get("ejections", 0)),
+                "replays": int(c_delta.get("replays", 0)),
+                "shed": int(c_delta.get("shed", 0)),
+                "post_kill_swapped": postswap["swapped"]},
+      "parity_ok": parity_ok, "zero_shed": zero_shed,
+      "swap_ok": swap_ok, "chaos_ok": chaos_ok,
+      "note": "the SAME ServingFleet code routed over in-process engines "
+              "vs RemoteReplica proxies whose engines run in spawned "
+              "ServingHost executor processes behind the rendezvous wire "
+              "(SHREG/SHSYNC framing, registry-built models). Gates: "
+              "bit-parity on every leg (including the v1->v2 rolling "
+              "swap ACROSS the process boundary and the chaos leg where "
+              "TOS_CHAOS_HOST SIGKILLs a host mid-decode: ejection + "
+              "failover replay + a post-kill zero-shed swap), zero shed "
+              "and zero replay mismatches everywhere. wire_relative "
+              "under 1.0 is the wire+sync tax; on one box all host "
+              "processes share the same cores, so it understates "
+              "N-executor deployment",
+  }
+  line = json.dumps(result)
+  if args.json_out:
+    with open(args.json_out, "w") as f:
+      f.write(line + "\n")
+    from tools import bench_history
+    bench_history.append_record(
+        "serve_bench_fleet_xhost", result["cross_host"]["tok_s"],
+        "%s-r%d-s%d-n%d-seed%d" % (result["mode"], shape["requests"],
+                                   shape["slots"], replicas, args.seed),
+        extra={"wire_relative": result["wire_relative"],
+               "parity_ok": parity_ok, "zero_shed": zero_shed,
+               "chaos_ok": chaos_ok})
+  print(line)
+  return 0 if ok else 3
+
+
 # --- deploy mode: continuous train→serve rollout under chaos (--deploy) -----
 
 #: deploy-mode shapes: a registry with a baseline version serving on a
@@ -1201,6 +1449,13 @@ def main():
                        "with a chaos kill mid-promote (resume must "
                        "converge, zero-shed) plus a poisoned candidate "
                        "that VERIFY must quarantine")
+  ap.add_argument("--cross-host", action="store_true",
+                  help="with --fleet: route the fleet over ServingHost "
+                       "EXECUTOR PROCESSES behind the rendezvous wire "
+                       "(serving.host/remote) — paired vs in-process, "
+                       "with a cross-process rolling swap and a "
+                       "TOS_CHAOS_HOST mid-decode kill leg, all "
+                       "parity/zero-shed gated")
   ap.add_argument("--replicas", type=int, default=0,
                   help="--fleet/--deploy replica count override")
   ap.add_argument("--chaos-spec", default=None,
@@ -1226,7 +1481,7 @@ def main():
   if args.prefix_workload:
     sys.exit(run_prefix(args))
   if args.fleet:
-    sys.exit(run_fleet(args))
+    sys.exit(run_fleet_xhost(args) if args.cross_host else run_fleet(args))
   if args.deploy:
     sys.exit(run_deploy(args))
   if args.smoke:
